@@ -1,0 +1,45 @@
+"""gelly_streaming_tpu: TPU-native single-pass streaming graph analytics.
+
+A from-scratch re-design of the capabilities of ``gelly-streaming`` (Flink's
+experimental graph-streaming API) for JAX/XLA on TPU. See SURVEY.md at the
+repo root for the structural analysis of the reference this build follows.
+
+Quick tour::
+
+    from gelly_streaming_tpu import SimpleEdgeStream, CountWindow, EdgeDirection
+
+    stream = SimpleEdgeStream(edges, window=CountWindow(1_000_000))
+    for vertex, degree in stream.get_degrees():
+        ...  # continuously-improving degree stream (per-window change-only)
+    snap = stream.slice(direction=EdgeDirection.ALL)
+    for vertex, total in snap.reduce_on_edges("sum"):
+        ...  # per-window neighborhood aggregate
+"""
+
+from .core.types import Edge, EdgeDirection, EventType, Vertex
+from .core.edgeblock import EdgeBlock, bucket_capacity, concat_blocks
+from .core.vertexdict import VertexDict
+from .core.window import CountWindow, EventTimeWindow, Windower, blocks_from_edges
+from .core.stream import GraphStream, SimpleEdgeStream, StreamContext
+from .core.snapshot import SnapshotStream
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Edge",
+    "EdgeDirection",
+    "EventType",
+    "Vertex",
+    "EdgeBlock",
+    "bucket_capacity",
+    "concat_blocks",
+    "VertexDict",
+    "CountWindow",
+    "EventTimeWindow",
+    "Windower",
+    "blocks_from_edges",
+    "GraphStream",
+    "SimpleEdgeStream",
+    "StreamContext",
+    "SnapshotStream",
+]

@@ -1,0 +1,6 @@
+from .types import Edge, EdgeDirection, EventType, Vertex
+from .edgeblock import EdgeBlock, bucket_capacity, concat_blocks
+from .vertexdict import VertexDict
+from .window import CountWindow, EventTimeWindow, Windower, blocks_from_edges
+from .stream import GraphStream, SimpleEdgeStream, StreamContext
+from .snapshot import SnapshotStream

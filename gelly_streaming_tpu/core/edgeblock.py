@@ -1,0 +1,193 @@
+"""EdgeBlock: the device-side unit of streaming graph data.
+
+The reference streams edges one record at a time through Flink operators
+(``SimpleEdgeStream.java``). A TPU cannot do per-record control flow: XLA
+traces a program once and wants large, static-shaped, batched tensor ops that
+tile onto the MXU/VPU. The TPU-native unit is therefore a *padded edge block*:
+
+    src : int32[capacity]   compacted source vertex ids
+    dst : int32[capacity]   compacted destination vertex ids
+    val : float32[capacity] edge values (zeros for unweighted graphs)
+    mask: bool[capacity]    True for real edges, False for padding
+
+``capacity`` is always a power of two (see :func:`bucket_capacity`) so that a
+stream of windows with varying edge counts hits only O(log N) distinct jit
+signatures instead of recompiling per window — this addresses "hard part #1"
+of SURVEY.md §7 (dynamic shapes).
+
+Vertex ids inside a block are *compact* int32 indices produced by
+:class:`~gelly_streaming_tpu.core.vertexdict.VertexDict`; raw (possibly
+64-bit, sparse) ids never reach the device. ``n_vertices`` rides along as
+static metadata so segment reductions know their output size.
+
+Design note: this struct plays the role of Flink's in-flight edge partitions
+(the data between the keyBy shuffle and the window fold,
+``SummaryBulkAggregation.java:76-80``), but materialized as dense arrays so a
+whole window is one compiled device step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bucket_capacity(n: int, minimum: int = 8) -> int:
+    """Round ``n`` up to the next power of two (>= minimum).
+
+    Capacity bucketing keeps the set of distinct jitted shapes logarithmic in
+    the maximum window size, avoiding per-window recompilation.
+    """
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EdgeBlock:
+    """A padded, masked batch of edges (one stream window or sub-window).
+
+    All arrays share the same leading dimension (the capacity). ``n_vertices``
+    is static metadata (the vertex-table capacity this block's compact ids
+    index into) so that jit treats it as a compile-time constant.
+    """
+
+    src: jax.Array  # int32[capacity]
+    dst: jax.Array  # int32[capacity]
+    val: jax.Array  # float32[capacity] (or any dtype the stream carries)
+    mask: jax.Array  # bool[capacity]
+    n_vertices: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    # ------------------------------------------------------------------ #
+    # Introspection
+    # ------------------------------------------------------------------ #
+    @property
+    def capacity(self) -> int:
+        return int(self.src.shape[-1])
+
+    def num_edges(self) -> jax.Array:
+        """Number of valid (non-padding) edges, as a device scalar."""
+        return jnp.sum(self.mask.astype(jnp.int32))
+
+    # ------------------------------------------------------------------ #
+    # Construction
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def from_arrays(
+        src: np.ndarray,
+        dst: np.ndarray,
+        val: Optional[np.ndarray] = None,
+        *,
+        n_vertices: int,
+        capacity: Optional[int] = None,
+        val_dtype=jnp.float32,
+    ) -> "EdgeBlock":
+        """Build a padded block from host arrays of compact int32 ids."""
+        n = int(np.asarray(src).shape[0])
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        if n > cap:
+            raise ValueError(f"{n} edges exceed capacity {cap}")
+        src_p = np.zeros(cap, dtype=np.int32)
+        dst_p = np.zeros(cap, dtype=np.int32)
+        val_p = np.zeros(cap, dtype=np.dtype(val_dtype))
+        mask_p = np.zeros(cap, dtype=bool)
+        src_p[:n] = src
+        dst_p[:n] = dst
+        if val is not None:
+            val_p[:n] = val
+        mask_p[:n] = True
+        return EdgeBlock(
+            src=jnp.asarray(src_p),
+            dst=jnp.asarray(dst_p),
+            val=jnp.asarray(val_p),
+            mask=jnp.asarray(mask_p),
+            n_vertices=int(n_vertices),
+        )
+
+    # ------------------------------------------------------------------ #
+    # Host-side materialization (for tests / emission)
+    # ------------------------------------------------------------------ #
+    def to_host(self):
+        """Return (src, dst, val) numpy arrays with padding stripped.
+
+        ``val`` may be a pytree of arrays (e.g. after a tuple-valued
+        ``map_edges``); masking is applied leaf-wise.
+        """
+        mask = np.asarray(self.mask)
+        val = jax.tree.map(lambda a: np.asarray(a)[mask], self.val)
+        return (
+            np.asarray(self.src)[mask],
+            np.asarray(self.dst)[mask],
+            val,
+        )
+
+    def with_vertices(self, n_vertices: int) -> "EdgeBlock":
+        return dataclasses.replace(self, n_vertices=int(n_vertices))
+
+
+def concat_blocks(blocks: Sequence[EdgeBlock], capacity: Optional[int] = None) -> EdgeBlock:
+    """Concatenate blocks into one (host-side; used by window re-bucketing).
+
+    Pytree-valued ``val`` (e.g. after a tuple-valued ``map_edges``) is
+    concatenated leaf-wise with dtypes preserved.
+    """
+    srcs, dsts, vals = [], [], []
+    n_vertices = 0
+    for b in blocks:
+        s, d, v = b.to_host()
+        srcs.append(s)
+        dsts.append(d)
+        vals.append(v)
+        n_vertices = max(n_vertices, b.n_vertices)
+    if not srcs:
+        return EdgeBlock.from_arrays(
+            np.zeros(0, np.int32), np.zeros(0, np.int32), None,
+            n_vertices=n_vertices, capacity=capacity,
+        )
+    src = np.concatenate(srcs)
+    dst = np.concatenate(dsts)
+    val = jax.tree.map(lambda *leaves: np.concatenate(leaves), *vals)
+    return from_arrays_tree(src, dst, val, n_vertices=n_vertices, capacity=capacity)
+
+
+def from_arrays_tree(
+    src: np.ndarray,
+    dst: np.ndarray,
+    val: Any,
+    *,
+    n_vertices: int,
+    capacity: Optional[int] = None,
+) -> EdgeBlock:
+    """Like :meth:`EdgeBlock.from_arrays` but with a pytree ``val`` whose
+    leaf dtypes are preserved (padding with zeros of each leaf's dtype)."""
+    n = int(np.asarray(src).shape[0])
+    cap = capacity if capacity is not None else bucket_capacity(n)
+    if n > cap:
+        raise ValueError(f"{n} edges exceed capacity {cap}")
+
+    def pad_leaf(a):
+        a = np.asarray(a)
+        out = np.zeros((cap,) + a.shape[1:], dtype=a.dtype)
+        out[:n] = a
+        return jnp.asarray(out)
+
+    src_p = np.zeros(cap, dtype=np.int32)
+    dst_p = np.zeros(cap, dtype=np.int32)
+    mask_p = np.zeros(cap, dtype=bool)
+    src_p[:n] = src
+    dst_p[:n] = dst
+    mask_p[:n] = True
+    val_tree = jax.tree.map(pad_leaf, val) if val is not None else jnp.zeros(cap, jnp.float32)
+    return EdgeBlock(
+        src=jnp.asarray(src_p),
+        dst=jnp.asarray(dst_p),
+        val=val_tree,
+        mask=jnp.asarray(mask_p),
+        n_vertices=int(n_vertices),
+    )

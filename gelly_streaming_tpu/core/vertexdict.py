@@ -1,0 +1,115 @@
+"""VertexDict: incremental raw-id -> compact-id dictionary (the host keyBy).
+
+The reference relies on Flink's keyed state: ``keyBy(vertex)`` hash-shuffles
+records so each operator instance owns a key range, and per-key HashMaps grow
+unboundedly inside operators (e.g. degree maps ``SimpleEdgeStream.java:461-478``,
+neighborhoods ``:531-560``). On TPU, per-key state must become dense arrays
+indexed by a *compact* vertex id, because gathers/scatters over a dense
+int32 index space are what the hardware does well.
+
+``VertexDict`` is the host-side component that owns this mapping:
+
+- ``encode(raw_ids)`` maps raw (arbitrary, possibly 64-bit) vertex ids to
+  compact int32 indices, assigning fresh indices first-seen-first.
+- ``decode(idx)`` maps back for emission.
+- ``capacity`` is power-of-two bucketed so device-side vertex tables (labels,
+  degrees, ranks) reallocate only O(log V) times as the stream grows.
+
+This replaces both halves of Flink's mechanism: the hash shuffle (compaction
+is deterministic on every host, so sharding by ``compact_id % n_shards`` is a
+pure function — see ``parallel/``) and the per-key HashMap (dense vectors).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from .edgeblock import bucket_capacity
+
+
+class VertexDict:
+    """Incremental bidirectional mapping raw id <-> compact int32 index."""
+
+    def __init__(self, min_capacity: int = 8):
+        self._raw_to_idx: dict[int, int] = {}
+        self._idx_to_raw: list[int] = []
+        self._min_capacity = min_capacity
+
+    def __len__(self) -> int:
+        return len(self._idx_to_raw)
+
+    @property
+    def capacity(self) -> int:
+        """Power-of-two bucketed size for device vertex tables."""
+        return bucket_capacity(max(1, len(self._idx_to_raw)), self._min_capacity)
+
+    def encode(self, raw: np.ndarray) -> np.ndarray:
+        """Map raw ids to compact indices, assigning new ones first-seen-first.
+
+        Vectorized fast path: look up already-known ids via a single dict
+        sweep only over the novel ones.
+        """
+        raw = np.asarray(raw).ravel()
+        out = np.empty(raw.shape[0], dtype=np.int32)
+        table = self._raw_to_idx
+        rev = self._idx_to_raw
+        for i, r in enumerate(raw.tolist()):
+            idx = table.get(r)
+            if idx is None:
+                idx = len(rev)
+                table[r] = idx
+                rev.append(r)
+            out[i] = idx
+        return out
+
+    def encode_one(self, raw: int) -> int:
+        idx = self._raw_to_idx.get(raw)
+        if idx is None:
+            idx = len(self._idx_to_raw)
+            self._raw_to_idx[raw] = idx
+            self._idx_to_raw.append(raw)
+        return idx
+
+    def lookup(self, raw: int) -> int | None:
+        """Query without inserting; None if unseen."""
+        return self._raw_to_idx.get(raw)
+
+    def decode(self, idx: Iterable[int] | np.ndarray) -> np.ndarray:
+        rev = np.asarray(self._idx_to_raw, dtype=np.int64)
+        return rev[np.asarray(idx, dtype=np.int64)]
+
+    def decode_one(self, idx: int) -> int:
+        return self._idx_to_raw[int(idx)]
+
+    def raw_ids(self) -> np.ndarray:
+        """All raw ids in compact-index order."""
+        return np.asarray(self._idx_to_raw, dtype=np.int64)
+
+    def raw_table(self):
+        """Device int32 lookup table: compact index -> raw vertex id.
+
+        Lets device-side UDFs observe the same vertex ids the reference's
+        UDFs would, while all indexing stays compact int32. Raw ids must fit
+        int32; larger ids raise (re-map host-side first). Cached per dict
+        size — the table only changes when the dict grows.
+        """
+        import jax.numpy as jnp
+
+        n = len(self._idx_to_raw)
+        cached = getattr(self, "_raw_table_cache", None)
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        raw = self.raw_ids()
+        if raw.size and (
+            raw.max() > np.iinfo(np.int32).max or raw.min() < np.iinfo(np.int32).min
+        ):
+            raise ValueError(
+                "raw vertex ids exceed int32; re-map ids host-side before streaming"
+            )
+        padded = np.zeros(self.capacity, dtype=np.int32)
+        padded[: raw.size] = raw.astype(np.int32)
+        table = jnp.asarray(padded)
+        self._raw_table_cache = (n, table)
+        return table

@@ -1,0 +1,8 @@
+from .segment import (
+    segment_reduce,
+    segment_count,
+    segmented_fold,
+    segmented_reduce_generic,
+    sort_by_segment,
+)
+from .csr import CSR, build_csr, dense_neighbors, sorted_neighbor_matrix

@@ -1,0 +1,2 @@
+from .mesh import EDGE_AXIS, MODEL_AXIS, edge_sharding, make_mesh, replicated
+from . import comm

@@ -1,0 +1,139 @@
+"""Collective-communication layer: the TPU-native Flink shuffle.
+
+The reference has zero transport code of its own — all communication is
+implicit in Flink dataflow edges over Netty TCP (SURVEY.md §2.6): hash
+shuffles (``keyBy``), broadcast, gather-to-one (``timeWindowAll`` /
+``setParallelism(1)``), and the tree-reduce topology built by re-keying
+(``SummaryTreeReduce.java:95-123``).
+
+This module is the explicit equivalent over ICI, built on ``shard_map`` +
+XLA collectives. Mapping (reference -> here):
+
+- flat global reduce (``timeWindowAll().reduce`` + parallelism-1 ``Merger``,
+  ``SummaryBulkAggregation.java:81-83``)  ->  :func:`all_reduce` (psum/pmin/
+  pmax over a mesh axis; every shard gets the result — strictly stronger
+  than the reference's single-task funnel).
+- tree reduce (``SummaryTreeReduce.enhance()``)  ->  :func:`tree_all_reduce`,
+  a log2(p) ``ppermute`` butterfly provided for topology parity/testing; on
+  real ICI the flat collective is already ring/tree-optimal, so the engine
+  uses :func:`all_reduce` by default.
+- broadcast (``edges.broadcast()``, ``BroadcastTriangleCount.java:42``) ->
+  replication (no sharding) or :func:`all_gather`.
+- hash shuffle (``keyBy``)  ->  deterministic host-side bucketing by compact
+  vertex id (VertexDict) — data is *placed* correctly instead of shuffled.
+
+All functions take an ``axis_name`` and must run inside ``shard_map`` (or any
+SPMD context where the axis is bound).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax>=0.6 moved shard_map to jax.shard_map
+    from jax import shard_map as _shard_map_fn  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_fn  # type: ignore
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """Thin wrapper over jax.shard_map with relaxed varying-manual-axes checks."""
+    return _shard_map_fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=check_vma)
+
+
+# --------------------------------------------------------------------------- #
+# Flat collectives (P3 / P5 in SURVEY.md §2.5)
+# --------------------------------------------------------------------------- #
+def all_reduce(x: Any, axis_name: str, op: str = "sum") -> Any:
+    """All-reduce a pytree across a mesh axis (sum/min/max)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    raise ValueError(f"unknown all_reduce op {op!r}")
+
+
+def all_gather(x: Any, axis_name: str, axis: int = 0, tiled: bool = False) -> Any:
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def axis_index(axis_name: str) -> jax.Array:
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+# --------------------------------------------------------------------------- #
+# Tree reduction (P4): ppermute butterfly, parity with SummaryTreeReduce
+# --------------------------------------------------------------------------- #
+def tree_all_reduce(
+    x: Any,
+    axis_name: str,
+    combine: Callable[[Any, Any], Any],
+    n_shards: int,
+) -> Any:
+    """Recursive-halving/doubling all-reduce with an arbitrary combine fn.
+
+    The reference's ``SummaryTreeReduce.enhance()`` repeatedly halves
+    parallelism (key = partition/2) and pairwise-combines partials
+    (``SummaryTreeReduce.java:95-123``). The ICI-native equivalent is a
+    butterfly: at round r every shard exchanges its partial with the shard
+    whose index differs in bit r (``ppermute``), then combines — log2(p)
+    rounds, after which *every* shard holds the global combine.
+
+    ``combine`` may be any associative pytree merge (not just an elementwise
+    monoid), which is what distinguishes this from plain psum/pmin.
+    ``n_shards`` must be a power of two (mesh axis size).
+    """
+    if n_shards & (n_shards - 1):
+        raise ValueError("tree_all_reduce requires a power-of-two axis size")
+    me = lax.axis_index(axis_name)
+    step = 1
+    while step < n_shards:
+        # Pair shards whose indices differ in the current bit: i <-> i XOR step.
+        perm = [(i, i ^ step) for i in range(n_shards)]
+        partner = jax.tree.map(lambda leaf: lax.ppermute(leaf, axis_name, perm), x)
+        x = combine(x, partner)
+        step *= 2
+    del me
+    return x
+
+
+# --------------------------------------------------------------------------- #
+# Sharded segment reduction: the engine's cross-shard combine primitive
+# --------------------------------------------------------------------------- #
+def sharded_segment_min(
+    values: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    axis_name: str,
+) -> jax.Array:
+    """Per-shard scatter-min over a replicated vertex table, then pmin.
+
+    The building block of the distributed aggregate path: each shard folds its
+    slice of the edge block into a local V-sized table, and one ICI all-reduce
+    replaces the reference's keyBy + timeWindowAll funnel.
+    """
+    local = jax.ops.segment_min(values, segment_ids, num_segments=num_segments)
+    return lax.pmin(local, axis_name)
+
+
+def sharded_segment_sum(
+    values: jax.Array,
+    segment_ids: jax.Array,
+    num_segments: int,
+    axis_name: str,
+) -> jax.Array:
+    local = jax.ops.segment_sum(values, segment_ids, num_segments=num_segments)
+    return lax.psum(local, axis_name)

@@ -1,0 +1,70 @@
+"""Device mesh construction and sharding helpers.
+
+The reference's parallelism is implicit in Flink: operator parallelism plus
+Netty shuffles (SURVEY.md §2.5-2.6). Here parallelism is explicit and
+declarative: a ``jax.sharding.Mesh`` over TPU chips with named axes, and
+shardings annotated on edge blocks / vertex tables; XLA inserts the ICI
+collectives.
+
+Axis conventions used throughout the framework:
+
+- ``"edges"`` — the data-parallel axis: edge blocks are split along their
+  capacity dimension (the analog of the reference's edge-partition
+  data-parallelism, ``SummaryBulkAggregation.java:76-80``).
+- ``"model"`` — feature/model parallel axis for the GNN layers (tensor
+  parallelism over the feature dimension); unused (size 1) for the pure
+  analytics workloads.
+
+On a single chip both axes have size 1 and everything degenerates gracefully.
+Multi-chip testing runs on a virtual CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) — the moral
+equivalent of the reference's in-process Flink mini-cluster
+(SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+EDGE_AXIS = "edges"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_edge_shards: Optional[int] = None,
+    n_model_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 2-D (edges, model) mesh over the available devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_edge_shards is None:
+        n_edge_shards = len(devs) // n_model_shards
+    n = n_edge_shards * n_model_shards
+    if n > len(devs):
+        raise ValueError(
+            f"requested {n} devices ({n_edge_shards}x{n_model_shards}) "
+            f"but only {len(devs)} available"
+        )
+    grid = np.asarray(devs[:n]).reshape(n_edge_shards, n_model_shards)
+    return Mesh(grid, (EDGE_AXIS, MODEL_AXIS))
+
+
+def edge_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for EdgeBlock arrays: split capacity across the edge axis."""
+    return NamedSharding(mesh, P(EDGE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (vertex tables, summaries)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_block_spec():
+    """PartitionSpec pytree for an EdgeBlock (all leaf arrays edge-sharded)."""
+    from ..core.edgeblock import EdgeBlock  # local import to avoid cycle
+
+    return EdgeBlock(src=P(EDGE_AXIS), dst=P(EDGE_AXIS), val=P(EDGE_AXIS), mask=P(EDGE_AXIS), n_vertices=0)

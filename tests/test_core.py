@@ -1,0 +1,68 @@
+"""Unit tests for core building blocks: EdgeBlock, VertexDict, Windower."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import (
+    CountWindow,
+    EdgeBlock,
+    EventTimeWindow,
+    VertexDict,
+    Windower,
+    bucket_capacity,
+    concat_blocks,
+)
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 8
+    assert bucket_capacity(8) == 8
+    assert bucket_capacity(9) == 16
+    assert bucket_capacity(1000) == 1024
+
+
+def test_vertexdict_roundtrip():
+    d = VertexDict()
+    idx = d.encode(np.array([100, 7, 100, 42]))
+    assert idx.tolist() == [0, 1, 0, 2]
+    assert d.decode([0, 1, 2]).tolist() == [100, 7, 42]
+    assert len(d) == 3
+    assert d.capacity == 8
+    # growth buckets in powers of two
+    d.encode(np.arange(1000, 1020))
+    assert d.capacity == 32
+
+
+def test_edgeblock_padding():
+    b = EdgeBlock.from_arrays(
+        np.array([0, 1, 2]), np.array([1, 2, 0]), np.array([1.0, 2.0, 3.0]),
+        n_vertices=4,
+    )
+    assert b.capacity == 8
+    assert int(b.num_edges()) == 3
+    s, d, v = b.to_host()
+    assert s.tolist() == [0, 1, 2]
+    assert d.tolist() == [1, 2, 0]
+    assert v.tolist() == [1.0, 2.0, 3.0]
+
+
+def test_count_windower(sample_edges):
+    w = Windower(CountWindow(3))
+    blocks = list(w.blocks(sample_edges))
+    assert [int(np.asarray(b.mask).sum()) for b in blocks] == [3, 3, 1]
+    # compact ids assigned first-seen: 1->0, 2->1, 3->2, 4->3, 5->4
+    assert w.vertex_dict.decode([0, 1, 2, 3, 4]).tolist() == [1, 2, 3, 4, 5]
+
+
+def test_event_time_windower():
+    edges = [(1, 2, 0.0, 10), (2, 3, 0.0, 15), (3, 4, 0.0, 25), (4, 5, 0.0, 40)]
+    w = Windower(EventTimeWindow(10, timestamp_fn=lambda e: e[3]))
+    blocks = list(w.blocks(edges))
+    assert [int(np.asarray(b.mask).sum()) for b in blocks] == [2, 1, 1]
+
+
+def test_concat_blocks(sample_edges):
+    w = Windower(CountWindow(3))
+    blocks = list(w.blocks(sample_edges))
+    merged = concat_blocks(blocks)
+    assert int(np.asarray(merged.mask).sum()) == 7
