@@ -1,0 +1,2 @@
+from .summary import SummaryAggregation, SummaryBulkAggregation, SummaryTreeReduce
+from . import checkpoint

@@ -1,0 +1,104 @@
+"""Checkpoint / resume for carried aggregation state.
+
+The reference's only fault-tolerance hook is ``Merger implements
+ListCheckpointed<S>`` — the running global summary is snapshotted/restored by
+Flink checkpointing (``SummaryAggregation.java:93,127-135``); window-fold
+partials ride on Flink managed state implicitly. SURVEY.md §5 notes the TPU
+surface is equally small: (summary pytree + vertex dictionary + window
+position) per stream.
+
+This module serializes that surface with numpy only (no orbax dependency for
+a kilobyte-scale state): a pytree of arrays goes to ``.npz`` plus a JSON
+treedef; the vertex dictionary saves its raw-id table (compact ids are
+first-seen ordinal, so the table alone reconstructs it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.vertexdict import VertexDict
+
+
+def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
+    """Write a pytree of arrays to ``path.npz`` + ``path.json``."""
+    leaves, treedef = jax.tree.flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    np.savez(path + ".npz", **arrays)
+    with open(path + ".json", "w") as f:
+        json.dump({"treedef": str(treedef), "n_leaves": len(leaves),
+                   "meta": meta or {}}, f)
+
+
+def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:
+    """Read arrays back into the structure of ``like`` (same treedef).
+
+    Returns (tree, meta). The treedef string in the sidecar is a consistency
+    check only — unflattening uses ``like``'s structure.
+    """
+    with open(path + ".json") as f:
+        info = json.load(f)
+    data = np.load(path + ".npz")
+    leaves = [data[f"leaf_{i}"] for i in range(info["n_leaves"])]
+    _, treedef = jax.tree.flatten(like)
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves but template has "
+            f"{treedef.num_leaves}"
+        )
+    return jax.tree.unflatten(treedef, leaves), info.get("meta", {})
+
+
+def save_vertex_dict(path: str, vdict: VertexDict) -> None:
+    np.save(path + ".vdict.npy", vdict.raw_ids())
+
+
+def load_vertex_dict(path: str) -> VertexDict:
+    raw = np.load(path + ".vdict.npy")
+    d = VertexDict()
+    d.encode(raw)
+    return d
+
+
+def save_aggregation(path: str, aggregation, vdict: Optional[VertexDict] = None) -> None:
+    """Checkpoint an aggregation's running summary (+ optional dict).
+
+    Device aggregations serialize as array pytrees; host-state aggregations
+    (``device=False``, e.g. the spanner's adjacency map) pickle their summary
+    object instead — np.asarray would wrap it in an object array that
+    ``np.load`` refuses to read back.
+    """
+    if aggregation.device:
+        save_pytree(path, aggregation.snapshot_state(), meta={"vcap": aggregation._vcap})
+    else:
+        import pickle
+
+        with open(path + ".pkl", "wb") as f:
+            pickle.dump(aggregation._summary, f)
+    if vdict is not None:
+        save_vertex_dict(path, vdict)
+
+
+def restore_aggregation(path: str, aggregation, template: Any = None) -> Optional[VertexDict]:
+    """Restore a checkpointed summary into ``aggregation``.
+
+    For device aggregations ``template`` must be a pytree with the same
+    structure as the state (e.g. ``aggregation.initial_state(vcap)``); host
+    aggregations unpickle and ignore it. Returns the restored VertexDict if
+    one was saved alongside, else None.
+    """
+    if aggregation.device:
+        state, meta = load_pytree(path, template)
+        aggregation.restore_state(state, vcap=meta.get("vcap"))
+    else:
+        import pickle
+
+        with open(path + ".pkl", "rb") as f:
+            aggregation._summary = pickle.load(f)
+    vd_path = path + ".vdict.npy"
+    return load_vertex_dict(path) if os.path.exists(vd_path) else None

@@ -1,0 +1,245 @@
+"""The summary-aggregation engine: per-window fold + cross-shard combine +
+carried global summary.
+
+TPU-native re-design of the reference's L3 engine (``SummaryAggregation.java``,
+``SummaryBulkAggregation.java``, ``SummaryTreeReduce.java``). The reference's
+dataflow per window:
+
+    stamp partition -> keyBy -> per-partition window fold(updateFun)
+    -> timeWindowAll -> reduce(combineFun) -> Merger (parallelism 1,
+    running summary, ListCheckpointed) -> optional transform
+
+Here the same roles map to:
+
+    shard the window's EdgeBlock over the mesh edge axis
+    -> per-shard ``update`` from ``initial_state`` (the window fold)
+    -> cross-shard ``combine`` via collectives (flat stack-and-fold for the
+       bulk engine; log2(p) ppermute butterfly for the tree engine)
+    -> host-carried running summary combined per window (the Merger)
+    -> ``transform`` for emission.
+
+Differences, by design (SURVEY.md §7 "semantic deltas"): the Merger emits
+per *window*, not per incoming partial; every shard holds the global result
+after the collective (the reference funnels to one subtask).
+
+Subclasses supply the five state hooks (initial/update/combine/grow/
+transform); ``device=False`` marks host-state aggregations (spanner,
+matching) whose update/combine run on host records instead of device arrays.
+
+Checkpoint surface (the reference's only fault-tolerance hook — ``Merger
+implements ListCheckpointed``, ``SummaryAggregation.java:127-135``):
+``snapshot_state()`` / ``restore_state()`` capture and restore the running
+summary; see ``aggregate/checkpoint.py`` for (de)serialization.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.edgeblock import EdgeBlock
+from ..parallel import comm
+from ..parallel.mesh import EDGE_AXIS
+from jax.sharding import PartitionSpec as P
+
+
+class SummaryAggregation(abc.ABC):
+    """Abstract engine config (``SummaryAggregation.java:22-137``).
+
+    Parameters
+    ----------
+    transient_state:
+        When True the running summary resets after each emission
+        (``SummaryAggregation.java:113-115``).
+    mesh:
+        Optional ``jax.sharding.Mesh`` with an ``"edges"`` axis; falls back
+        to the stream context's mesh, else single-device execution.
+    """
+
+    #: False for host-state aggregations (update/combine get host edge arrays)
+    device: bool = True
+
+    def __init__(self, transient_state: bool = False, mesh=None):
+        self.transient_state = transient_state
+        self.mesh = mesh
+        self._summary = None
+        self._vcap = 0
+        self._jit_update = None
+        self._jit_combine = None
+        self._shard_fn = None
+
+    # ------------------------------------------------------------------ #
+    # State protocol (the updateFun / combineFun / transform slots)
+    # ------------------------------------------------------------------ #
+    @abc.abstractmethod
+    def initial_state(self, vcap: int) -> Any:
+        """Fresh per-window fold state (the ``initialValue`` analog)."""
+
+    def grow_state(self, state: Any, old_vcap: int, new_vcap: int) -> Any:
+        """Re-size carried state when the vertex capacity bucket grows."""
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement grow_state to stream "
+            "beyond its initial vertex capacity"
+        )
+
+    @abc.abstractmethod
+    def update(self, state: Any, src, dst, val, mask) -> Any:
+        """Fold one (shard of a) window into the state (``EdgesFold`` role).
+
+        Device aggregations receive device arrays; host aggregations receive
+        numpy arrays with padding already stripped.
+        """
+
+    @abc.abstractmethod
+    def combine(self, a: Any, b: Any) -> Any:
+        """Associative merge of two states (``combineFun`` role)."""
+
+    def transform(self, state: Any, vdict) -> Any:
+        """Map the running summary to the emitted record (optional)."""
+        return state
+
+    # ------------------------------------------------------------------ #
+    # Engine
+    # ------------------------------------------------------------------ #
+    def _resolve_mesh(self, stream):
+        mesh = self.mesh if self.mesh is not None else stream.get_context().mesh
+        if mesh is None:
+            return None
+        if EDGE_AXIS not in mesh.shape or mesh.shape[EDGE_AXIS] == 1:
+            return None
+        return mesh
+
+    def _window_partial(self, block: EdgeBlock, vcap: int, mesh) -> Any:
+        """Compute one window's aggregate (the keyBy->fold->reduce pipeline)."""
+        if self._jit_update is None:
+            self._jit_update = jax.jit(
+                lambda st, s, d, v, m: self.update(st, s, d, v, m)
+            )
+            self._jit_combine = jax.jit(self.combine)
+            self._shard_fn = None
+
+        if mesh is None:
+            return self._jit_update(
+                self.initial_state(vcap), block.src, block.dst, block.val, block.mask
+            )
+        p = mesh.shape[EDGE_AXIS]
+        tree = self._is_tree()
+        # Build the shard-mapped callable once and reuse across windows — jax
+        # caches compilations per shape, so same-capacity windows don't
+        # retrace (the whole point of capacity bucketing).
+        if self._shard_fn is None:
+            init = self.initial_state(vcap)
+
+            def shard_fn(src, dst, val, mask):
+                part = self.update(init, src, dst, val, mask)
+                if tree:
+                    return comm.tree_all_reduce(part, EDGE_AXIS, self.combine, p)
+                return jax.tree.map(lambda x: x[None], part)
+
+            in_specs = (P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS))
+            out_specs = jax.tree.map(lambda _: P() if tree else P(EDGE_AXIS), init)
+            self._shard_fn = jax.jit(
+                comm.shard_map(shard_fn, mesh, in_specs, out_specs)
+            )
+        out = self._shard_fn(block.src, block.dst, block.val, block.mask)
+        if tree:
+            return out
+        # bulk: stacked partials [p, ...] -> flat sequential combine (the
+        # timeWindowAll gather analog)
+        result = jax.tree.map(lambda x: x[0], out)
+        for i in range(1, p):
+            result = self._jit_combine(result, jax.tree.map(lambda x: x[i], out))
+        return result
+
+    def _is_tree(self) -> bool:
+        return False
+
+    def run(self, stream) -> Iterator[Any]:
+        """Drive the aggregation over the stream's windows
+        (``SummaryAggregation.run`` / ``SummaryBulkAggregation.java:68-90``)."""
+        mesh = self._resolve_mesh(stream) if self.device else None
+        vdict = stream.vertex_dict
+        for block in stream.blocks():
+            if self.device:
+                vcap = block.n_vertices
+                if self._summary is None:
+                    self._vcap = vcap
+                    self._summary = self.initial_state(vcap)
+                elif vcap > self._vcap:
+                    self._summary = self.grow_state(self._summary, self._vcap, vcap)
+                    self._vcap = vcap
+                    self._jit_update = self._jit_combine = None  # shapes changed
+                    self._shard_fn = None
+                partial = self._window_partial(block, vcap, mesh)
+                self._summary = self._jit_combine(self._summary, partial)
+            else:
+                src, dst, val = block.to_host()
+                raw_s = vdict.decode(src)
+                raw_d = vdict.decode(dst)
+                if self._summary is None:
+                    self._summary = self.initial_state(0)
+                partial = self.update(
+                    self.initial_state(0), raw_s, raw_d, val, None
+                )
+                self._summary = self.combine(self._summary, partial)
+            yield self.transform(self._summary, vdict)
+            if self.transient_state:
+                self._summary = (
+                    self.initial_state(self._vcap) if self.device else self.initial_state(0)
+                )
+
+    # ------------------------------------------------------------------ #
+    # Checkpoint surface (ListCheckpointed analog)
+    # ------------------------------------------------------------------ #
+    def snapshot_state(self) -> Any:
+        """The running summary, as a host pytree
+        (``SummaryAggregation.java:127-130`` snapshotState)."""
+        return jax.tree.map(np.asarray, self._summary)
+
+    def infer_vcap(self, state: Any) -> int:
+        """Vertex capacity implied by a state pytree (override when the
+        leading dim is not the base vertex capacity, e.g. double covers)."""
+        leaves = jax.tree.leaves(state)
+        return int(leaves[0].shape[0]) if leaves else 0
+
+    def restore_state(self, state: Any, vcap: Optional[int] = None) -> None:
+        """Restore a summary captured by :meth:`snapshot_state`
+        (``SummaryAggregation.java:132-135`` restoreState)."""
+        self._summary = jax.tree.map(jnp.asarray, state) if self.device else state
+        if vcap is not None:
+            self._vcap = vcap
+        elif self.device:
+            self._vcap = self.infer_vcap(self._summary)
+
+
+class SummaryBulkAggregation(SummaryAggregation):
+    """Flat-combine engine (``SummaryBulkAggregation.java:51-131``):
+    per-shard fold, then a stack-and-fold global combine — the analog of the
+    ``timeWindowAll`` gather + reduce + Merger tail."""
+
+    def _is_tree(self) -> bool:
+        return False
+
+
+class SummaryTreeReduce(SummaryAggregation):
+    """Tree-combine engine (``SummaryTreeReduce.java:47-160``): the shard
+    partials merge through a log2(p) ppermute butterfly
+    (:func:`gelly_streaming_tpu.parallel.comm.tree_all_reduce`), the ICI
+    equivalent of ``enhance()``'s recursive parallelism-halving. ``degree``
+    is accepted for API parity; the butterfly's fan-in is fixed at 2, which
+    is what ``enhance()`` degenerates to as well (key = partition/2,
+    ``SummaryTreeReduce.java:95-123``)."""
+
+    def __init__(self, transient_state: bool = False, mesh=None, degree: int = 2):
+        super().__init__(transient_state=transient_state, mesh=mesh)
+        self.degree = degree
+
+    def _cross_shard_combine(self, partials):  # pragma: no cover - via _window_partial
+        return partials
+
+    def _is_tree(self) -> bool:
+        return True

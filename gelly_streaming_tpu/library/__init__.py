@@ -1,0 +1,3 @@
+from .connected_components import ConnectedComponents, ConnectedComponentsTree
+from .bipartiteness import BipartitenessCheck
+from .spanner import Spanner

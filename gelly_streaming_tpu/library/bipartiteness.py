@@ -1,0 +1,41 @@
+"""Streaming bipartiteness check via the signed double cover.
+
+Replaces ``library/BipartitenessCheck.java:39-133`` + its ``Candidates``
+merge machinery with CC over the signed double cover (see
+``summaries/candidates.py``): bipartite iff no vertex's (+) and (-) cover
+nodes share a component. The update/combine are the same dense label kernels
+as CC, over a 2*vcap table; emission reproduces the reference's
+``(true,{...})`` / ``(false,{})`` output format.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..aggregate.summary import SummaryBulkAggregation
+from ..summaries.candidates import Candidates, cover_fold, cover_grow, init_cover
+from ..summaries.labels import label_combine
+
+
+class BipartitenessCheck(SummaryBulkAggregation):
+    """Single-pass bipartiteness (``library/BipartitenessCheck.java``)."""
+
+    def initial_state(self, vcap: int):
+        return init_cover(max(1, vcap))
+
+    def grow_state(self, state, old_vcap: int, new_vcap: int):
+        return cover_grow(state, old_vcap, new_vcap)
+
+    def update(self, state, src, dst, val, mask):
+        vcap = state["labels"].shape[0] // 2
+        return cover_fold(state, src, dst, mask, vcap)
+
+    def combine(self, a, b):
+        return label_combine(a, b)
+
+    def infer_vcap(self, state) -> int:
+        # the cover table has 2*vcap rows
+        return state["labels"].shape[0] // 2
+
+    def transform(self, state, vdict) -> Candidates:
+        return Candidates.from_cover(state, self.infer_vcap(state), vdict)

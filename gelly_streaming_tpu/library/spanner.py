@@ -1,0 +1,55 @@
+"""Streaming k-spanner (host-state aggregation).
+
+Behavioral parity with ``library/Spanner.java:40-118``: per edge, if the
+spanner already connects the endpoints within k hops the edge is dropped,
+else added (``UpdateLocal``); partial spanners merge smaller-into-larger
+under the same bounded-BFS test (``CombineSpanners``).
+
+The per-edge decision is sequential in arrival order and irregular (bounded
+BFS) — the reference runs it inside a window fold, and SURVEY.md §7 (build
+step 5) keeps it host-side here, plugged into the engine as a host-state
+summary (``device=False``). A device-side hop-limited relaxation variant is
+a future optimization, not a capability gap: the API and semantics match.
+"""
+
+from __future__ import annotations
+
+from ..aggregate.summary import SummaryBulkAggregation
+from ..summaries.adjacency import AdjacencyListGraph
+
+
+class Spanner(SummaryBulkAggregation):
+    """k-spanner over the edge stream (``library/Spanner.java``)."""
+
+    device = False
+
+    def __init__(self, k: int, transient_state: bool = False):
+        super().__init__(transient_state=transient_state)
+        self.k = k
+
+    def initial_state(self, vcap: int) -> AdjacencyListGraph:
+        return AdjacencyListGraph()
+
+    def grow_state(self, state, old_vcap, new_vcap):
+        return state
+
+    def update(self, g: AdjacencyListGraph, src, dst, val, mask) -> AdjacencyListGraph:
+        """Arrival-order fold (``Spanner.UpdateLocal.foldEdges``)."""
+        for u, v in zip(src.tolist(), dst.tolist()):
+            if not g.bounded_bfs(u, v, self.k):
+                g.add_edge(u, v)
+        return g
+
+    def combine(self, g1: AdjacencyListGraph, g2: AdjacencyListGraph) -> AdjacencyListGraph:
+        """Merge smaller into larger (``Spanner.CombineSpanners.reduce``)."""
+        if len(g1.adj) < len(g2.adj):
+            g1, g2 = g2, g1
+        for u, v in g2.edges():
+            if not g1.bounded_bfs(u, v, self.k):
+                g1.add_edge(u, v)
+        return g1
+
+    def transform(self, g: AdjacencyListGraph, vdict) -> AdjacencyListGraph:
+        # Emit a snapshot copy: the running summary keeps mutating across
+        # windows, and emissions must stay stable once yielded.
+        return g.copy()

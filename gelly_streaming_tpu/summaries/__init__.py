@@ -1,0 +1,4 @@
+from .disjointset import DisjointSet
+from .labels import Components, cc_fold, grow_labels, init_labels, label_combine
+from .candidates import Candidates, cover_fold, cover_grow, init_cover
+from .adjacency import AdjacencyListGraph

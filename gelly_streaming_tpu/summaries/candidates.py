@@ -1,0 +1,143 @@
+"""Bipartiteness state: signed double cover over dense labels.
+
+The reference tracks 2-colored candidate components in a nested
+TreeMap structure with sign-flipping merges and a global failure latch
+(``summaries/Candidates.java:27-197``). SURVEY.md §7 replaces the whole
+structure with a classic reduction: run connected components on the *signed
+double cover* — every vertex v becomes two cover nodes (v,+) and (v,-), and
+every edge (u,v) becomes cover edges (u,+)-(v,-) and (u,-)-(v,+). The graph
+is bipartite iff no vertex's two cover nodes land in the same component.
+That turns all of ``Candidates``' pointer logic into the same dense label
+kernels CC uses (``summaries/labels.py``), sharing its collectives.
+
+Layout: cover node (v,+) = index v, (v,-) = index v + vcap, in a label table
+of size 2*vcap.
+
+:class:`Candidates` is the host-side emission object, reproducing the
+reference's output format byte-for-byte: ``(true,{1={1=(1,true), ...}})`` /
+``(false,{})`` (golden strings in ``BipartitenessCheckTest.java:19-21`` and
+``NonBipartitnessCheckTest.java:19-20``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .labels import _propagate, init_labels
+
+
+def init_cover(vcap: int) -> Dict[str, jax.Array]:
+    """Fresh signed-double-cover label state (2*vcap cover nodes)."""
+    return init_labels(2 * vcap)
+
+
+def cover_fold(
+    state: Dict[str, jax.Array],
+    src: jax.Array,
+    dst: jax.Array,
+    mask: jax.Array,
+    vcap: int,
+) -> Dict[str, jax.Array]:
+    """Fold a window's edges into the cover labels.
+
+    Edge (u,v) adds cover constraints (u,+)~(v,-) and (u,-)~(v,+)
+    — the dense replacement for ``Candidates.add`` / ``merge``
+    (``Candidates.java:52-139``).
+    """
+    u = jnp.concatenate([src, src + vcap])
+    w = jnp.concatenate([dst + vcap, dst])
+    m = jnp.concatenate([mask, mask])
+    labels = _propagate(state["labels"], u, w, m)
+    touched = state["touched"].at[src].max(mask).at[dst].max(mask)
+    return {"labels": labels, "touched": touched}
+
+
+def cover_grow(state: Dict[str, jax.Array], old_vcap: int, new_vcap: int) -> Dict[str, jax.Array]:
+    """Re-index the cover when the vertex capacity bucket grows.
+
+    Cover node (v,-) moves from v+old_vcap to v+new_vcap, and label *values*
+    pointing into the negative half must shift by the same amount.
+    """
+    if new_vcap <= old_vcap:
+        return state
+    lab = np.asarray(state["labels"])
+    tch = np.asarray(state["touched"])
+    new_lab = np.arange(2 * new_vcap, dtype=np.int32)
+    new_tch = np.zeros(2 * new_vcap, dtype=bool)
+    shifted = np.where(lab >= old_vcap, lab - old_vcap + new_vcap, lab)
+    new_lab[:old_vcap] = shifted[:old_vcap]
+    new_lab[new_vcap : new_vcap + old_vcap] = shifted[old_vcap:]
+    new_tch[:old_vcap] = tch[:old_vcap]
+    new_tch[new_vcap : new_vcap + old_vcap] = tch[old_vcap:]
+    return {"labels": jnp.asarray(new_lab), "touched": jnp.asarray(new_tch)}
+
+
+class Candidates:
+    """Host emission object with reference-format string output.
+
+    ``success`` False means an odd cycle was found; the map is then empty
+    (``Candidates.fail``, ``Candidates.java:194-196``). On success the map is
+    component -> {vertex: (vertex, sign)} with the component keyed by its
+    smallest raw vertex id, that root colored ``true``, and every other
+    vertex's sign = (same cover side as the root).
+    """
+
+    def __init__(self, success: bool, components: Dict[int, Dict[int, bool]]):
+        self.success = success
+        self.components = components
+
+    @staticmethod
+    def from_cover(state: Dict[str, jax.Array], vcap: int, vdict) -> "Candidates":
+        labels = np.asarray(state["labels"])
+        touched = np.asarray(state["touched"])
+        n = len(vdict)
+        seen = np.nonzero(touched[:n])[0]
+        pos = labels[seen]
+        neg = labels[seen + vcap]
+        if np.any(pos == neg):
+            return Candidates(False, {})
+        # Base component id: the min cover label of the pair identifies the
+        # base component (each base component owns exactly 2 cover comps).
+        base = np.minimum(pos, neg)
+        comps: Dict[int, Dict[int, bool]] = {}
+        for b in np.unique(base):
+            members = seen[base == b]
+            raws = np.asarray([vdict.decode_one(int(c)) for c in members])
+            order = np.argsort(raws)
+            members, raws = members[order], raws[order]
+            root = members[0]  # min raw id
+            root_side = labels[root]
+            signs = labels[members] == root_side
+            comps[int(raws[0])] = {
+                int(r): bool(s) for r, s in zip(raws.tolist(), signs.tolist())
+            }
+        return Candidates(True, comps)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Candidates)
+            and self.success == other.success
+            and self.components == other.components
+        )
+
+    def __str__(self) -> str:
+        if not self.success:
+            return "(false,{})"
+        outer = ", ".join(
+            "%d={%s}"
+            % (
+                comp,
+                ", ".join(
+                    "%d=(%d,%s)" % (v, v, "true" if s else "false")
+                    for v, s in sorted(vs.items())
+                ),
+            )
+            for comp, vs in sorted(self.components.items())
+        )
+        return "(true,{%s})" % outer
+
+    __repr__ = __str__

@@ -1,0 +1,151 @@
+"""Dense label propagation: the device-side union-find replacement.
+
+SURVEY.md §7's core bet: the reference's ``DisjointSet`` pointer-chasing
+(``summaries/DisjointSet.java``) densifies into an int32 ``labels[V]`` array
+where ``labels[v]`` is the (compact) index of the smallest vertex known
+reachable from ``v``. Per window, min-label propagation with pointer jumping
+runs to fixpoint inside a ``lax.while_loop`` — the Shiloach-Vishkin-style
+hook-and-shortcut scheme that maps onto gathers/scatter-mins the TPU
+executes as dense vector ops.
+
+Key kernels:
+
+- :func:`cc_fold` — fold one EdgeBlock into a label table (the ``UpdateCC``
+  analog, ``library/ConnectedComponents.java:83-86``).
+- :func:`label_combine` — merge two label tables. NOTE: elementwise min is
+  NOT sufficient (a link recorded in only one table can be dropped); the
+  correct merge treats both tables as pointer graphs — edges (v, a[v]) and
+  (v, b[v]) — and re-runs the fixpoint (the ``CombineCC``/``DisjointSet.
+  merge`` analog).
+- :func:`grow_labels` — extend a table when the vertex dictionary grows.
+
+All kernels are jit-compatible pure functions over (labels, touched) pairs;
+``touched`` tracks which vertices have appeared in any edge so emission can
+skip never-seen singletons (matching the reference, whose DisjointSet only
+contains vertices from processed edges).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def init_labels(vcap: int) -> Dict[str, jax.Array]:
+    """Fresh state: every vertex its own component, nothing touched."""
+    return {
+        "labels": jnp.arange(vcap, dtype=jnp.int32),
+        "touched": jnp.zeros(vcap, dtype=bool),
+    }
+
+
+def _propagate(labels: jax.Array, u: jax.Array, v: jax.Array, mask: jax.Array) -> jax.Array:
+    """Min-label fixpoint over the constraint edges (u[i] ~ v[i] where mask).
+
+    Each iteration: hook (scatter-min of min(label_u, label_v) onto both
+    endpoints) + shortcut (pointer jump ``labels[labels]``), until no label
+    changes. Padding rows carry +inf updates (no-ops under min).
+    """
+
+    def body(state):
+        lab, _ = state
+        lu = lab[u]
+        lv = lab[v]
+        m = jnp.where(mask, jnp.minimum(lu, lv), _I32_MAX)
+        new = lab.at[u].min(m).at[v].min(m)
+        new = new[new]  # shortcut: one round of pointer jumping
+        return new, jnp.any(new != lab)
+
+    def cond(state):
+        return state[1]
+
+    labels, _ = lax.while_loop(cond, body, (labels, jnp.bool_(True)))
+    return labels
+
+
+def cc_fold(state: Dict[str, jax.Array], src: jax.Array, dst: jax.Array, mask: jax.Array) -> Dict[str, jax.Array]:
+    """Fold one window's edges into the label table (per-shard update)."""
+    labels = _propagate(state["labels"], src, dst, mask)
+    ones = mask
+    touched = state["touched"].at[src].max(ones).at[dst].max(ones)
+    return {"labels": labels, "touched": touched}
+
+
+def label_combine(a: Dict[str, jax.Array], b: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Merge two label tables into the labels of the union graph.
+
+    Correctness: the union's constraints are exactly the pointer edges
+    (v, a.labels[v]) and (v, b.labels[v]); re-running the fixpoint over those
+    2V edges yields CC of the union. (Plain elementwise min would lose links:
+    with a = [.., 5~3], b = [.., 5~1], min drops the 3~5 link.)
+    """
+    la, lb = a["labels"], b["labels"]
+    V = la.shape[0]
+    iota = jnp.arange(V, dtype=jnp.int32)
+    u = jnp.concatenate([iota, iota])
+    w = jnp.concatenate([la, lb])
+    labels = _propagate(jnp.minimum(la, lb), u, w, jnp.ones(2 * V, bool))
+    return {"labels": labels, "touched": a["touched"] | b["touched"]}
+
+
+def grow_labels(state: Dict[str, jax.Array], new_vcap: int) -> Dict[str, jax.Array]:
+    """Extend the table when the vertex dictionary bucket grows."""
+    old = state["labels"].shape[0]
+    if new_vcap <= old:
+        return state
+    ext = jnp.arange(old, new_vcap, dtype=jnp.int32)
+    return {
+        "labels": jnp.concatenate([state["labels"], ext]),
+        "touched": jnp.concatenate([state["touched"], jnp.zeros(new_vcap - old, bool)]),
+    }
+
+
+# --------------------------------------------------------------------------- #
+# Host-side emission
+# --------------------------------------------------------------------------- #
+class Components:
+    """Host view of a label table: the TPU stand-in for the emitted
+    ``DisjointSet`` (``library/ConnectedComponents.java:41``).
+
+    ``components`` maps the component's representative (min *raw* vertex id)
+    to the sorted raw member list. ``__str__`` matches the Java map format
+    the reference's test parser reads (``DisjointSet.java:139-153``).
+    """
+
+    def __init__(self, components: Dict[int, List[int]]):
+        self.components = components
+
+    @staticmethod
+    def from_labels(state: Dict[str, jax.Array], vdict) -> "Components":
+        labels = np.asarray(state["labels"])
+        touched = np.asarray(state["touched"])
+        n = len(vdict)
+        groups: Dict[int, List[int]] = {}
+        for c in np.nonzero(touched[:n])[0].tolist():
+            groups.setdefault(int(labels[c]), []).append(int(vdict.decode_one(c)))
+        return Components(
+            {min(members): sorted(members) for members in groups.values()}
+        )
+
+    def num_components(self) -> int:
+        return len(self.components)
+
+    def component_sets(self) -> List[frozenset]:
+        return [frozenset(m) for m in self.components.values()]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Components) and self.components == other.components
+
+    def __str__(self) -> str:
+        inner = ", ".join(
+            f"{root}={members}" for root, members in sorted(self.components.items())
+        )
+        return "{" + inner + "}"
+
+    __repr__ = __str__
