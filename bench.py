@@ -1,0 +1,126 @@
+"""Benchmark: streaming Connected Components edges/sec (BASELINE config #2).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: a synthetic power-law edge stream is discretized into fixed-capacity
+windows; each window is folded into the dense label table on device
+(``gelly_streaming_tpu.summaries.labels.cc_fold``) and merged into the running
+summary — the TPU-native equivalent of the reference's flagship path
+(``SummaryBulkAggregation.run`` → ``DisjointSet.union``/``merge``,
+``SummaryBulkAggregation.java:68-90``).
+
+``vs_baseline``: ratio against a measured in-process per-edge union-find
+(path compression + union by rank over dicts — the same data structure and
+one-record-at-a-time execution model as the reference's
+``summaries/DisjointSet.java``, minus JVM/Flink overheads). The reference
+publishes no numbers (BASELINE.md), so the baseline is measured, not quoted.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def make_stream(n_vertices: int, n_edges: int, seed: int = 7):
+    """Power-law-ish random edge stream (Zipf endpoints, like social graphs)."""
+    rng = np.random.default_rng(seed)
+    # Zipf via inverse-CDF over a permuted vertex set; clip to range.
+    u = rng.random(n_edges)
+    v = rng.random(n_edges)
+    a = 0.75  # skew
+    src = np.minimum((n_vertices * u**a * rng.random(n_edges)).astype(np.int64), n_vertices - 1)
+    dst = np.minimum((n_vertices * v**a * rng.random(n_edges)).astype(np.int64), n_vertices - 1)
+    return src.astype(np.int32), dst.astype(np.int32)
+
+
+def bench_tpu(src, dst, n_vertices: int, window: int) -> float:
+    """Return edges/sec for the device streaming-CC path."""
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.summaries.labels import cc_fold, init_labels, label_combine
+
+    n_edges = src.shape[0]
+
+    @jax.jit
+    def step(summary, s, d, m):
+        part = cc_fold(init_labels(n_vertices), s, d, m)
+        return label_combine(summary, part)
+
+    n_win = n_edges // window
+    blocks = [
+        (
+            jnp.asarray(src[i * window : (i + 1) * window]),
+            jnp.asarray(dst[i * window : (i + 1) * window]),
+            jnp.ones(window, bool),
+        )
+        for i in range(n_win)
+    ]
+    summary = init_labels(n_vertices)
+    # warm-up compile on the first block
+    warm = step(summary, *blocks[0])
+    jax.block_until_ready(warm)
+
+    t0 = time.perf_counter()
+    for s, d, m in blocks:
+        summary = step(summary, s, d, m)
+    jax.block_until_ready(summary)
+    dt = time.perf_counter() - t0
+    lab = np.asarray(summary["labels"])
+    assert (lab[lab] == lab).all()
+    return n_win * window / dt
+
+
+def bench_cpu_baseline(src, dst, sample: int) -> float:
+    """Per-edge union-find (the reference's execution model) edges/sec."""
+    parent = {}
+    rank = {}
+
+    def find(x):
+        root = x
+        while parent.get(root, root) != root:
+            root = parent[root]
+        while parent.get(x, x) != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    t0 = time.perf_counter()
+    for s, d in zip(src[:sample].tolist(), dst[:sample].tolist()):
+        rs, rd = find(s), find(d)
+        if rs != rd:
+            if rank.get(rs, 0) < rank.get(rd, 0):
+                rs, rd = rd, rs
+            parent[rd] = rs
+            if rank.get(rs, 0) == rank.get(rd, 0):
+                rank[rs] = rank.get(rs, 0) + 1
+    dt = time.perf_counter() - t0
+    return sample / dt
+
+
+def main():
+    n_vertices = 1 << 18  # 262k
+    window = 1 << 18  # 262k edges/window
+    n_windows = 8
+    n_edges = window * n_windows
+
+    src, dst = make_stream(n_vertices, n_edges)
+    tpu_eps = bench_tpu(src, dst, n_vertices, window)
+    cpu_eps = bench_cpu_baseline(src, dst, sample=min(n_edges, 500_000))
+
+    print(
+        json.dumps(
+            {
+                "metric": "streaming_cc_edges_per_sec",
+                "value": round(tpu_eps, 1),
+                "unit": "edges/sec",
+                "vs_baseline": round(tpu_eps / cpu_eps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -38,20 +38,38 @@ def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
 def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:
     """Read arrays back into the structure of ``like`` (same treedef).
 
-    Returns (tree, meta). The treedef string in the sidecar is a consistency
-    check only — unflattening uses ``like``'s structure.
+    Returns (tree, meta). Rejects a checkpoint whose stored treedef, leaf
+    count, or leaf shapes disagree with ``like`` — restoring one summary kind
+    into another must fail at load time, not corrupt state silently.
     """
     with open(path + ".json") as f:
         info = json.load(f)
     data = np.load(path + ".npz")
     leaves = [data[f"leaf_{i}"] for i in range(info["n_leaves"])]
-    _, treedef = jax.tree.flatten(like)
+    like_leaves, treedef = jax.tree.flatten(like)
     if treedef.num_leaves != len(leaves):
         raise ValueError(
             f"checkpoint has {len(leaves)} leaves but template has "
             f"{treedef.num_leaves}"
         )
+    if info.get("treedef") and info["treedef"] != str(treedef):
+        raise ValueError(
+            f"checkpoint treedef {info['treedef']} does not match template "
+            f"treedef {treedef}"
+        )
+    for i, (stored, want) in enumerate(zip(leaves, like_leaves)):
+        if np.shape(want) != stored.shape:
+            raise ValueError(
+                f"checkpoint leaf {i} has shape {stored.shape} but template "
+                f"expects {np.shape(want)}"
+            )
     return jax.tree.unflatten(treedef, leaves), info.get("meta", {})
+
+
+def load_meta(path: str) -> dict:
+    """Read just the sidecar metadata (e.g. ``vcap``) without the arrays."""
+    with open(path + ".json") as f:
+        return json.load(f).get("meta", {})
 
 
 def save_vertex_dict(path: str, vdict: VertexDict) -> None:
@@ -87,12 +105,21 @@ def save_aggregation(path: str, aggregation, vdict: Optional[VertexDict] = None)
 def restore_aggregation(path: str, aggregation, template: Any = None) -> Optional[VertexDict]:
     """Restore a checkpointed summary into ``aggregation``.
 
-    For device aggregations ``template`` must be a pytree with the same
-    structure as the state (e.g. ``aggregation.initial_state(vcap)``); host
-    aggregations unpickle and ignore it. Returns the restored VertexDict if
-    one was saved alongside, else None.
+    For device aggregations the template defaults to
+    ``aggregation.initial_state(vcap)`` with ``vcap`` read from the sidecar
+    metadata — a resume site needs only the path and a fresh aggregation
+    object. Pass ``template`` explicitly only for states whose structure
+    ``initial_state`` does not produce. Host aggregations unpickle and ignore
+    it. Returns the restored VertexDict if one was saved alongside, else None.
     """
     if aggregation.device:
+        if template is None:
+            vcap = load_meta(path).get("vcap")
+            if vcap is None:
+                raise ValueError(
+                    f"checkpoint {path} has no vcap metadata; pass template="
+                )
+            template = aggregation.initial_state(vcap)
         state, meta = load_pytree(path, template)
         aggregation.restore_state(state, vcap=meta.get("vcap"))
     else:

@@ -69,6 +69,7 @@ class SummaryAggregation(abc.ABC):
         self._summary = None
         self._vcap = 0
         self._jit_update = None
+        self._stack_combine = None
         self._jit_combine = None
         self._shard_fn = None
 
@@ -148,12 +149,32 @@ class SummaryAggregation(abc.ABC):
         out = self._shard_fn(block.src, block.dst, block.val, block.mask)
         if tree:
             return out
-        # bulk: stacked partials [p, ...] -> flat sequential combine (the
-        # timeWindowAll gather analog)
-        result = jax.tree.map(lambda x: x[0], out)
-        for i in range(1, p):
-            result = self._jit_combine(result, jax.tree.map(lambda x: x[i], out))
-        return result
+        # bulk: stacked partials [p, ...] -> one jitted log-depth pairwise
+        # reduction (the timeWindowAll gather analog) — a single dispatch
+        # instead of p-1 host round trips
+        if self._stack_combine is None:
+
+            def stacked_reduce(stacked):
+                n = p
+                while n > 1:
+                    half = n // 2
+                    lo = jax.tree.map(lambda x: x[:half], stacked)
+                    hi = jax.tree.map(lambda x: x[half : 2 * half], stacked)
+                    merged = jax.vmap(self.combine)(lo, hi)
+                    if n % 2:
+                        stacked = jax.tree.map(
+                            lambda m, x: jnp.concatenate([m, x[2 * half : n]]),
+                            merged,
+                            stacked,
+                        )
+                        n = half + 1
+                    else:
+                        stacked = merged
+                        n = half
+                return jax.tree.map(lambda x: x[0], stacked)
+
+            self._stack_combine = jax.jit(stacked_reduce)
+        return self._stack_combine(out)
 
     def _is_tree(self) -> bool:
         return False
@@ -174,6 +195,7 @@ class SummaryAggregation(abc.ABC):
                     self._vcap = vcap
                     self._jit_update = self._jit_combine = None  # shapes changed
                     self._shard_fn = None
+                    self._stack_combine = None
                 partial = self._window_partial(block, vcap, mesh)
                 self._summary = self._jit_combine(self._summary, partial)
             else:
@@ -237,9 +259,6 @@ class SummaryTreeReduce(SummaryAggregation):
     def __init__(self, transient_state: bool = False, mesh=None, degree: int = 2):
         super().__init__(transient_state=transient_state, mesh=mesh)
         self.degree = degree
-
-    def _cross_shard_combine(self, partials):  # pragma: no cover - via _window_partial
-        return partials
 
     def _is_tree(self) -> bool:
         return True

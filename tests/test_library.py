@@ -148,11 +148,10 @@ def test_checkpoint_restore(tmp_path):
     path = str(tmp_path / "ckpt")
     checkpoint.save_aggregation(path, agg, stream.vertex_dict)
 
-    # restore into a fresh aggregation and continue with the remaining edges
+    # restore into a fresh aggregation (template inferred from sidecar vcap)
+    # and continue with the remaining edges
     agg2 = ConnectedComponents()
-    vdict = checkpoint.restore_aggregation(
-        path, agg2, template=agg2.initial_state(agg._vcap)
-    )
+    vdict = checkpoint.restore_aggregation(path, agg2)
     assert vdict is not None
     assert vdict.raw_ids().tolist() == stream.vertex_dict.raw_ids().tolist()[: len(vdict)]
     # continue the stream from the checkpoint: same dict, remaining edges
@@ -162,3 +161,22 @@ def test_checkpoint_restore(tmp_path):
     cont = SimpleEdgeStream(_blocks=lambda: wi.blocks(iter(CC_EDGES[3:])), _vdict=vdict)
     comps = final_emission(cont, agg2)
     assert sorted(comps.component_sets()) == sorted(CC_EXPECTED)
+
+
+def test_checkpoint_rejects_mismatched_restore(tmp_path):
+    """Restoring one summary kind into another fails at load time
+    (treedef/shape validation in ``checkpoint.load_pytree``)."""
+    import pytest
+
+    from gelly_streaming_tpu.aggregate import checkpoint
+    from gelly_streaming_tpu.library import BipartitenessCheck
+
+    stream = SimpleEdgeStream(CC_EDGES, window=CountWindow(3))
+    agg = ConnectedComponents()
+    next(stream.aggregate(agg))
+    path = str(tmp_path / "ckpt")
+    checkpoint.save_aggregation(path, agg, stream.vertex_dict)
+
+    other = BipartitenessCheck()
+    with pytest.raises(ValueError):
+        checkpoint.restore_aggregation(path, other)
