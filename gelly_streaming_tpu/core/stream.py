@@ -443,6 +443,33 @@ class SimpleEdgeStream(GraphStream):
         (``SimpleEdgeStream.java:100-102`` -> ``SummaryAggregation.run``)."""
         return summary_aggregation.run(self)
 
+    def build_neighborhood(self, directed: bool = False) -> Iterator[Tuple]:
+        """Per-edge neighborhood snapshots (``SimpleEdgeStream.java:531-560``).
+
+        Emits ``(src, trg, neighbors)`` per processed edge — both directions
+        when ``directed=False`` (the reference pre-applies ``undirected()``)
+        — where ``neighbors`` is the sorted tuple of ``src``'s raw-id
+        adjacency *as of that edge's arrival* (inclusive): the reference's
+        per-vertex TreeSet state, arrival order preserved. API-parity host
+        path; the device triangle pipeline
+        (``library/triangles.py:ExactTriangleCount``) never materializes
+        these snapshots.
+        """
+        adj: dict = {}
+
+        def emit(a, b):
+            adj.setdefault(a, set()).add(b)
+            return (a, b, tuple(sorted(adj[a])))
+
+        for block in self.blocks():
+            s, d, _ = block.to_host()
+            raw_s = self._vdict.decode(s)
+            raw_d = self._vdict.decode(d)
+            for a, b in zip(raw_s.tolist(), raw_d.tolist()):
+                yield emit(a, b)
+                if not directed:
+                    yield emit(b, a)
+
     def slice(
         self,
         window: Optional[WindowPolicy] = None,

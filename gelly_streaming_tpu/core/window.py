@@ -41,6 +41,25 @@ class WindowPolicy:
     """Base class for window assignment policies."""
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowInfo:
+    """Host-side metadata for one emitted window (the ``TimeWindow`` analog).
+
+    ``start``/``end`` are event-time bounds (end exclusive, Flink-style) for
+    event-time windows, None for count windows; ``index`` counts emitted
+    windows from 0 either way.
+    """
+
+    index: int
+    start: Optional[float]
+    end: Optional[float]
+
+    @property
+    def max_timestamp(self) -> Optional[float]:
+        """Inclusive end, matching Flink's ``TimeWindow.maxTimestamp()``."""
+        return None if self.end is None else self.end - 1
+
+
 @dataclasses.dataclass
 class CountWindow(WindowPolicy):
     """Tumbling window of a fixed number of edges."""
@@ -106,16 +125,31 @@ class Windower:
 
     def blocks(self, edges: Iterable[Tuple]) -> Iterator[EdgeBlock]:
         """Yield one EdgeBlock per tumbling window."""
+        for _, block in self.blocks_with_info(edges):
+            yield block
+
+    def blocks_with_info(
+        self, edges: Iterable[Tuple]
+    ) -> Iterator[Tuple["WindowInfo", EdgeBlock]]:
+        """Like :meth:`blocks` but paired with host-side window metadata.
+
+        The metadata stays OUT of the EdgeBlock pytree on purpose: a
+        per-window id inside the block would be a static leaf changing every
+        window and defeat jit caching. Flink's analog is the ``TimeWindow``
+        handed to window functions (``SnapshotStream.java:146``).
+        """
         policy = self.policy
+        index = 0
         if isinstance(policy, CountWindow):
             buf: list[Tuple] = []
             for e in edges:
                 buf.append(e)
                 if len(buf) >= policy.size:
-                    yield self._make_block(buf)
+                    yield WindowInfo(index, None, None), self._make_block(buf)
+                    index += 1
                     buf = []
             if buf:
-                yield self._make_block(buf)
+                yield WindowInfo(index, None, None), self._make_block(buf)
         elif isinstance(policy, EventTimeWindow):
             if policy.timestamp_fn is None:
                 raise ValueError(
@@ -131,14 +165,19 @@ class Windower:
                     current = w
                 if w != current:
                     if buf:
-                        yield self._make_block(buf)
+                        yield self._info(index, current), self._make_block(buf)
+                        index += 1
                     buf = []
                     current = w
                 buf.append(e)
             if buf:
-                yield self._make_block(buf)
+                yield self._info(index, current), self._make_block(buf)
         else:
             raise TypeError(f"unknown window policy {policy!r}")
+
+    def _info(self, index: int, time_slot: int) -> "WindowInfo":
+        size = self.policy.size
+        return WindowInfo(index, time_slot * size, (time_slot + 1) * size)
 
 
 def blocks_from_edges(

@@ -1,3 +1,4 @@
 from .connected_components import ConnectedComponents, ConnectedComponentsTree
 from .bipartiteness import BipartitenessCheck
 from .spanner import Spanner
+from .triangles import ExactTriangleCount, WindowTriangles

@@ -1,0 +1,205 @@
+"""Triangle counting workloads: per-window exact and streaming exact.
+
+TPU-native re-designs of two reference examples:
+
+- :class:`WindowTriangles` — exact triangle count per time slice
+  (``example/WindowTriangles.java:60-139``). The reference generates
+  O(Σdeg²) wedge *candidates* per vertex and joins them against real edges
+  across two more shuffles; here each slice is one compiled
+  sorted-adjacency-intersection step (``ops/triangles.py``), emitting
+  ``(count, window_max_timestamp)`` pairs exactly like the reference's
+  final ``timeWindowAll().sum(0)`` stream.
+
+- :class:`ExactTriangleCount` — single-pass exact local + global triangle
+  count over the whole stream (``example/ExactTriangleCount.java:41-207``).
+  The reference pairs per-edge neighborhood snapshots in keyed state so a
+  triangle is counted exactly once — when its last edge arrives. Here each
+  accumulated edge carries an *arrival rank*; per window, one device step
+  counts for every new edge the common neighbors whose closing edges both
+  have smaller rank (same once-per-triangle semantics, batched). Duplicate
+  edges are dropped (the reference's TreeSet adjacency is likewise
+  duplicate-insensitive). Emission is per-window change-only: ``(vertex,
+  running_count)`` for every vertex whose count changed, and ``(-1,
+  running_total)`` — the reference's ``SumAndEmitCounters`` stream
+  (``ExactTriangleCount.java:121-134``) at window granularity
+  (SURVEY.md §7 "semantic deltas").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.edgeblock import bucket_capacity
+from ..core.window import CountWindow, WindowPolicy, Windower
+from ..ops.triangles import (
+    ranked_triangle_update,
+    sorted_ranked_rows,
+    window_triangle_count,
+)
+
+GLOBAL_KEY = -1  # the reference's "total" counter vertex id
+
+
+def _pad(a: np.ndarray, cap: int) -> np.ndarray:
+    out = np.zeros(cap, a.dtype)
+    out[: len(a)] = a
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _window_step(src, dst, mask, num_vertices: int, max_degree: int):
+    return window_triangle_count(src, dst, mask, num_vertices, max_degree)
+
+
+@functools.partial(jax.jit, static_argnums=(8, 9))
+def _streaming_step(
+    acc_u, acc_v, acc_rank, acc_mask,
+    new_u, new_v, new_rank, new_mask,
+    num_vertices: int, max_degree: int,
+    counts,
+):
+    ids, ranks = sorted_ranked_rows(
+        acc_u, acc_v, acc_rank, acc_mask, num_vertices, max_degree
+    )
+    return ranked_triangle_update(
+        ids, ranks, new_u, new_v, new_rank, new_mask, counts
+    )
+
+
+class WindowTriangles:
+    """Exact triangles per tumbling window.
+
+    ``run(edges)`` yields ``(count, max_timestamp)`` per window —
+    ``max_timestamp`` is the inclusive window end for event-time windows
+    (Flink's ``TimeWindow.maxTimestamp()``), the window index for count
+    windows.
+    """
+
+    def __init__(self, window: WindowPolicy):
+        self.window = window
+
+    def run(self, edges: Iterable[Tuple]) -> Iterator[Tuple[int, Optional[float]]]:
+        windower = Windower(self.window)
+        for info, block in windower.blocks_with_info(edges):
+            s, d, _ = block.to_host()
+            max_deg = _max_undirected_degree(s, d, block.n_vertices)
+            total, _ = _window_step(
+                block.src, block.dst, block.mask, block.n_vertices, max_deg
+            )
+            ts = info.max_timestamp if info.max_timestamp is not None else info.index
+            yield int(total), ts
+
+
+def _max_undirected_degree(s: np.ndarray, d: np.ndarray, num_vertices: int) -> int:
+    """Degree bucket (power of two) for the dense neighbor rows."""
+    deg = np.bincount(s, minlength=num_vertices) + np.bincount(
+        d, minlength=num_vertices
+    )
+    return bucket_capacity(int(deg.max()) if deg.size else 0)
+
+
+class ExactTriangleCount:
+    """Single-pass exact local + global triangle counting.
+
+    ``run(stream)`` consumes a ``SimpleEdgeStream`` and yields, per window, a
+    list of ``(raw_vertex_id, running_count)`` for changed vertices plus
+    ``(GLOBAL_KEY, running_total)`` when it changed.
+    """
+
+    def __init__(self):
+        # host carry: canonical accumulated edges in arrival order + dedup key
+        self._u = np.zeros(0, np.int32)
+        self._v = np.zeros(0, np.int32)
+        self._seen_keys = np.zeros(0, np.int64)  # sorted
+        self._deg = np.zeros(0, np.int64)
+        # device carry
+        self._counts = None
+        self._total = 0
+
+    def run(self, stream) -> Iterator[List[Tuple[int, int]]]:
+        vdict = stream.vertex_dict
+        for block in stream.blocks():
+            s, d, _ = block.to_host()
+            vcap = block.n_vertices
+            new_u, new_v = self._dedup_new(s, d)
+            yield self._process(new_u, new_v, vcap, vdict)
+
+    # ------------------------------------------------------------------ #
+    def _dedup_new(self, s: np.ndarray, d: np.ndarray):
+        """Canonicalize, drop self-loops and edges seen before (order kept)."""
+        u = np.minimum(s, d).astype(np.int64)
+        v = np.maximum(s, d).astype(np.int64)
+        ok = u != v
+        u, v = u[ok], v[ok]
+        key = (u << 32) | v
+        # in-window first-occurrence dedup, arrival order preserved
+        _, first_idx = np.unique(key, return_index=True)
+        first_idx.sort()
+        u, v, key = u[first_idx], v[first_idx], key[first_idx]
+        # drop edges already accumulated
+        pos = np.searchsorted(self._seen_keys, key)
+        pos_c = np.minimum(pos, max(len(self._seen_keys) - 1, 0))
+        dup = (
+            (self._seen_keys[pos_c] == key) if len(self._seen_keys) else
+            np.zeros(len(key), bool)
+        )
+        u, v, key = u[~dup], v[~dup], key[~dup]
+        self._seen_keys = np.sort(np.concatenate([self._seen_keys, key]))
+        return u.astype(np.int32), v.astype(np.int32)
+
+    def _process(self, new_u, new_v, vcap: int, vdict) -> List[Tuple[int, int]]:
+        n_old = len(self._u)
+        self._u = np.concatenate([self._u, new_u])
+        self._v = np.concatenate([self._v, new_v])
+        if vcap > len(self._deg):
+            self._deg = np.concatenate(
+                [self._deg, np.zeros(vcap - len(self._deg), np.int64)]
+            )
+        np.add.at(self._deg, new_u, 1)
+        np.add.at(self._deg, new_v, 1)
+        if self._counts is None:
+            self._counts = jnp.zeros(vcap, jnp.int32)
+        elif vcap > self._counts.shape[0]:
+            self._counts = jnp.concatenate(
+                [self._counts, jnp.zeros(vcap - self._counts.shape[0], jnp.int32)]
+            )
+        if len(new_u) == 0:
+            return []
+
+        n_acc = len(self._u)
+        acc_cap = bucket_capacity(n_acc)
+        new_cap = bucket_capacity(len(new_u))
+        max_deg = bucket_capacity(int(self._deg[:vcap].max()))
+        acc_u = _pad(self._u, acc_cap)
+        acc_v = _pad(self._v, acc_cap)
+        acc_rank = _pad(np.arange(n_acc, dtype=np.int32), acc_cap)
+        acc_mask = np.zeros(acc_cap, bool)
+        acc_mask[:n_acc] = True
+        new_rank = _pad(np.arange(n_old, n_acc, dtype=np.int32), new_cap)
+        new_mask = np.zeros(new_cap, bool)
+        new_mask[: len(new_u)] = True
+
+        old_counts = self._counts
+        self._counts, delta = _streaming_step(
+            jnp.asarray(acc_u), jnp.asarray(acc_v),
+            jnp.asarray(acc_rank), jnp.asarray(acc_mask),
+            jnp.asarray(_pad(new_u, new_cap)), jnp.asarray(_pad(new_v, new_cap)),
+            jnp.asarray(new_rank), jnp.asarray(new_mask),
+            vcap, max_deg,
+            old_counts,
+        )
+        changed = np.nonzero(
+            np.asarray(self._counts) != np.asarray(old_counts)
+        )[0]
+        out = [(int(vdict.decode_one(c)), int(np.asarray(self._counts)[c]))
+               for c in changed]
+        delta = int(delta)
+        if delta:
+            self._total += delta
+            out.append((GLOBAL_KEY, self._total))
+        return out

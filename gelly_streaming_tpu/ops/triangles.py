@@ -1,0 +1,164 @@
+"""Triangle-counting kernels: sorted-adjacency intersection on dense rows.
+
+TPU-native replacement for the reference's two triangle paths:
+
+- ``example/WindowTriangles.java:86-139`` materializes O(Σdeg²) wedge
+  candidates per window and joins them against real edges — a blowup
+  SURVEY.md §7 explicitly avoids. Here a window's triangles are counted by
+  intersecting the sorted neighbor rows of each edge's endpoints
+  (:func:`window_triangle_count`): O(E·D·logD) dense vector work.
+- ``example/ExactTriangleCount.java:74-116`` pairs per-edge neighborhood
+  snapshots in keyed state so each triangle is counted exactly once, when its
+  last edge arrives. The TPU form (:func:`ranked_triangle_update`) keeps an
+  *arrival rank* per accumulated edge and counts, for each new edge, common
+  neighbors whose two closing edges both have smaller rank — the same
+  "closed by the final edge" semantics, batched per window.
+
+All kernels take dense ``[V, D]`` neighbor matrices (see
+``ops/csr.py:sorted_neighbor_matrix``); invalid slots hold +INT_MAX so
+binary search never matches them.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .csr import CSR, build_csr, dense_neighbors
+
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+def canonicalize(src: jax.Array, dst: jax.Array, mask: jax.Array):
+    """(min,max) edge ordering, self-loops masked off
+    (``ExactTriangleCount.java:136-146`` ProjectCanonicalEdges)."""
+    u = jnp.minimum(src, dst)
+    v = jnp.maximum(src, dst)
+    return u, v, mask & (u != v)
+
+
+def dedup_canonical(u: jax.Array, v: jax.Array, mask: jax.Array, num_vertices: int):
+    """Mask duplicate canonical edges within a block. Returns (u, v, mask)
+    with duplicates masked off. Two-key ``lax.sort`` — no composite int64
+    key, which would overflow with x64 disabled."""
+    del num_vertices
+    iota = jnp.arange(u.shape[0], dtype=jnp.int32)
+    u_m = jnp.where(mask, u, _BIG)
+    v_m = jnp.where(mask, v, _BIG)
+    su, sv, si = jax.lax.sort((u_m, v_m, iota), num_keys=2)
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), (su[1:] != su[:-1]) | (sv[1:] != sv[:-1])]
+    )
+    keep = jnp.zeros_like(mask).at[si].set(first)
+    return u, v, mask & keep
+
+
+def sorted_ranked_rows(
+    u: jax.Array,
+    v: jax.Array,
+    rank: jax.Array,
+    mask: jax.Array,
+    num_vertices: int,
+    max_degree: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Build ``(nbr_ids[V, D], nbr_ranks[V, D])`` rows sorted by neighbor id.
+
+    Input is the *canonical* edge list; both directions are materialized so a
+    vertex's row holds its full undirected neighborhood. Invalid slots hold
+    +INT_MAX ids (rank irrelevant there).
+    """
+    key = jnp.concatenate([u, v])
+    nbr = jnp.concatenate([v, u])
+    rk = jnp.concatenate([rank, rank])
+    m = jnp.concatenate([mask, mask])
+    csr = build_csr(key, nbr, rk, m, num_vertices)
+    nbr_mat, rank_mat, valid = dense_neighbors(csr, max_degree)
+    ids = jnp.where(valid, nbr_mat, _BIG)
+    order = jnp.argsort(ids, axis=1)
+    ids = jnp.take_along_axis(ids, order, axis=1)
+    ranks = jnp.take_along_axis(rank_mat, order, axis=1)
+    return ids, ranks
+
+
+def _row_membership(rows_a: jax.Array, rows_b: jax.Array):
+    """For each element of rows_a[i], its position and presence in rows_b[i].
+
+    Both inputs ``[E, D]`` with rows sorted ascending. Returns (pos, found);
+    +INT_MAX sentinels never count as found.
+    """
+
+    def one(a, b):
+        pos = jnp.searchsorted(b, a)
+        pos_c = jnp.clip(pos, 0, b.shape[0] - 1)
+        found = (b[pos_c] == a) & (a != _BIG)
+        return pos_c, found
+
+    return jax.vmap(one)(rows_a, rows_b)
+
+
+def window_triangle_count(
+    src: jax.Array,
+    dst: jax.Array,
+    mask: jax.Array,
+    num_vertices: int,
+    max_degree: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact triangle count of one window's edge block.
+
+    Returns ``(total, per_vertex[V])`` where ``per_vertex[w]`` is the number
+    of window triangles containing ``w``. Each triangle is seen once per edge
+    (3×) by the intersection, so both outputs divide by 3.
+    """
+    u, v, m = canonicalize(src, dst, mask)
+    u, v, m = dedup_canonical(u, v, m, num_vertices)
+    rank = jnp.zeros_like(u)  # unranked: every edge intersects the full rows
+    ids, _ = sorted_ranked_rows(u, v, rank, m, num_vertices, max_degree)
+    rows_u = jnp.where(m[:, None], ids[u], _BIG)
+    rows_v = ids[v]
+    pos, found = _row_membership(rows_u, rows_v)
+    c = found.sum(axis=1)
+    per_vertex = jnp.zeros(num_vertices, jnp.int32)
+    w_ids = jnp.where(found, rows_u, 0)
+    per_vertex = per_vertex.at[w_ids.reshape(-1)].add(
+        found.reshape(-1).astype(jnp.int32)
+    )
+    per_vertex = per_vertex.at[u].add(jnp.where(m, c, 0).astype(jnp.int32))
+    per_vertex = per_vertex.at[v].add(jnp.where(m, c, 0).astype(jnp.int32))
+    total = jnp.where(m, c, 0).sum() // 3
+    return total.astype(jnp.int32), per_vertex // 3
+
+
+def ranked_triangle_update(
+    nbr_ids: jax.Array,
+    nbr_ranks: jax.Array,
+    u: jax.Array,
+    v: jax.Array,
+    rank: jax.Array,
+    mask: jax.Array,
+    counts: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Count the triangles *closed by* a batch of new edges.
+
+    ``nbr_ids``/``nbr_ranks`` describe the ACCUMULATED graph (new edges
+    included); a new edge (u, v) of arrival rank r closes triangle
+    (u, v, w) iff edges (u, w) and (v, w) both arrived strictly earlier.
+    Updates the running per-vertex ``counts`` (each triangle vertex +1 —
+    the ``(w,1)/(u,c)/(v,c)`` emissions of
+    ``ExactTriangleCount.java:85-106``) and returns ``(counts, delta)``
+    where delta is this batch's new-triangle total (the ``(-1, c)`` stream).
+    """
+    rows_u = jnp.where(mask[:, None], nbr_ids[u], _BIG)
+    ranks_u = nbr_ranks[u]
+    rows_v = nbr_ids[v]
+    ranks_v = nbr_ranks[v]
+    pos, found = _row_membership(rows_u, rows_v)
+    r = rank[:, None]
+    match = found & (ranks_u < r) & (jnp.take_along_axis(ranks_v, pos, axis=1) < r)
+    c = match.sum(axis=1).astype(jnp.int32)
+    w_ids = jnp.where(match, rows_u, 0)
+    counts = counts.at[w_ids.reshape(-1)].add(match.reshape(-1).astype(jnp.int32))
+    cm = jnp.where(mask, c, 0)
+    counts = counts.at[u].add(cm).at[v].add(cm)
+    return counts, cm.sum().astype(jnp.int32)
