@@ -2,3 +2,11 @@ from .connected_components import ConnectedComponents, ConnectedComponentsTree
 from .bipartiteness import BipartitenessCheck
 from .spanner import Spanner
 from .triangles import ExactTriangleCount, WindowTriangles
+from .degrees import DegreeDistribution
+from .sampling import BroadcastTriangleCount, IncidenceSamplingTriangleCount
+from .matching import (
+    CentralizedWeightedMatching,
+    MatchingEvent,
+    MatchingEventType,
+)
+from .iterative_cc import IterativeConnectedComponents

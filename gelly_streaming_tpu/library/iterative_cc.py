@@ -1,0 +1,47 @@
+"""Iterative (label-emitting) connected components.
+
+TPU-native re-design of ``example/IterativeConnectedComponents.java:56-168``,
+the reference's feedback-loop CC variant: a streaming iteration whose keyed
+state maps component-id -> member set, emitting corrected ``(vertex,
+componentId)`` pairs as labels shrink (componentId = min raw vertex id in
+the component, ``:116-121``).
+
+The TPU form needs no feedback edge: the engine's per-window
+``lax.while_loop`` min-label propagation IS the iteration (SURVEY.md §2.5
+P7), so this is the shared CC device path
+(``library/connected_components.py``) with a per-vertex change-only label
+emission layered on top — per window, every vertex whose component id
+changed is re-emitted, which is exactly the reference's "corrected labels"
+stream at window granularity (SURVEY.md §7 semantic deltas).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from .connected_components import ConnectedComponents
+
+
+class IterativeConnectedComponents:
+    """``run(stream)`` yields, per window, the changed ``(vertex,
+    component_id)`` pairs; ``labels()`` returns the full current mapping."""
+
+    def __init__(self, mesh=None):
+        self._agg = ConnectedComponents(mesh=mesh)
+        self._labels: Dict[int, int] = {}
+
+    def run(self, stream) -> Iterator[List[Tuple[int, int]]]:
+        for comps in self._agg.run(stream):
+            new_labels: Dict[int, int] = {}
+            for root, members in comps.components.items():
+                for v in members:
+                    new_labels[v] = root
+            changed = [
+                (v, c) for v, c in sorted(new_labels.items())
+                if self._labels.get(v) != c
+            ]
+            self._labels = new_labels
+            yield changed
+
+    def labels(self) -> Dict[int, int]:
+        return dict(self._labels)

@@ -1,0 +1,111 @@
+"""Tests for weighted matching and iterative (label-emitting) CC."""
+
+import numpy as np
+
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow
+from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
+from gelly_streaming_tpu.library.matching import (
+    CentralizedWeightedMatching,
+    MatchingEvent,
+    MatchingEventType,
+)
+
+
+def test_matching_replace_rule():
+    """An edge replaces collisions iff w > 2*sum(collision weights)
+    (``CentralizedWeightedMatching.java:95-107``)."""
+    m = CentralizedWeightedMatching()
+    events = list(m.run([(1, 2, 10.0), (2, 3, 15.0), (2, 3, 25.0)]))
+    # (1,2,10) added; (2,3,15) collides with 10, 15 <= 20 -> rejected;
+    # (2,3,25) collides with 10, 25 > 20 -> replaces
+    assert [e.type for e in events] == [
+        MatchingEventType.ADD,
+        MatchingEventType.REMOVE,
+        MatchingEventType.ADD,
+    ]
+    assert m.total_weight() == 25.0
+    assert {(e.src, e.dst) for e in m.matching()} == {(2, 3)}
+
+
+def test_matching_two_collisions():
+    m = CentralizedWeightedMatching()
+    list(m.run([(1, 2, 5.0), (3, 4, 6.0)]))
+    # (2,3) collides with both; needs > 2*(5+6)=22
+    assert list(m.run([(2, 3, 22.0)])) == []
+    events = list(m.run([(2, 3, 23.0)]))
+    assert [e.type for e in events] == [
+        MatchingEventType.REMOVE,
+        MatchingEventType.REMOVE,
+        MatchingEventType.ADD,
+    ]
+    assert m.total_weight() == 23.0
+
+
+def test_matching_approximation_bound_random():
+    """Total matched weight is within the 1/6 bound of the optimum on small
+    random graphs (brute-force optimum)."""
+    import itertools
+
+    rng = np.random.default_rng(2)
+    for trial in range(3):
+        edges = [
+            (int(a), int(b), float(w))
+            for (a, b), w in zip(
+                rng.integers(0, 8, size=(12, 2)), rng.uniform(1, 100, 12)
+            )
+            if a != b
+        ]
+        m = CentralizedWeightedMatching()
+        list(m.run(edges))
+        got = m.total_weight()
+        best = 0.0
+        # brute force maximum weight matching over edge subsets
+        for r in range(1, 5):
+            for sub in itertools.combinations(edges, r):
+                verts = [v for s, d, _ in sub for v in (s, d)]
+                if len(set(verts)) == 2 * len(sub):
+                    best = max(best, sum(w for _, _, w in sub))
+        assert got >= best / 6.0, (trial, got, best)
+
+
+def test_matching_accepts_stream():
+    stream = SimpleEdgeStream([(1, 2, 3.0), (3, 4, 4.0)], window=CountWindow(1))
+    m = CentralizedWeightedMatching()
+    events = list(m.run(stream))
+    assert len(events) == 2
+    assert m.total_weight() == 7.0
+
+
+CC_EDGES = [
+    (1, 2, 0.0), (1, 3, 0.0), (2, 3, 0.0),
+    (6, 7, 0.0), (8, 9, 0.0), (3, 5, 0.0),
+]
+
+
+def test_iterative_cc_labels_shrink_to_min_raw_id():
+    stream = SimpleEdgeStream(CC_EDGES, window=CountWindow(2))
+    icc = IterativeConnectedComponents()
+    emissions = list(icc.run(stream))
+    # final labels: {1,2,3,5}->1, {6,7}->6, {8,9}->8
+    assert icc.labels() == {1: 1, 2: 1, 3: 1, 5: 1, 6: 6, 7: 6, 8: 8, 9: 8}
+    # every window emits only changes; vertex 5 appears once, labeled 1
+    flat = [p for e in emissions for p in e]
+    assert flat.count((5, 1)) == 1
+    # vertex ids never get a label larger than themselves
+    for v, c in flat:
+        assert c <= v
+
+
+def test_iterative_cc_merge_relabels_larger_component_id():
+    """Two components merging re-emits the losing side with the smaller id
+    (the reference's merge() emission, ``IterativeConnectedComponents.java:143-167``)."""
+    edges = [(5, 6, 0.0), (1, 2, 0.0), (2, 6, 0.0)]
+    stream = SimpleEdgeStream(edges, window=CountWindow(1))
+    icc = IterativeConnectedComponents()
+    w1, w2, w3 = list(icc.run(stream))
+    assert set(w1) == {(5, 5), (6, 5)}
+    assert set(w2) == {(1, 1), (2, 1)}
+    # merge: component 5 collapses into 1; vertices 5,6 re-emitted
+    assert set(w3) == {(5, 1), (6, 1)}
+    assert icc.labels() == {1: 1, 2: 1, 5: 1, 6: 1}
