@@ -1,0 +1,181 @@
+"""Streaming GraphSAGE: GNN layers over the window stream (BASELINE #5).
+
+Not in the reference (it has no ML component) — BASELINE.json adds a
+"Streaming GraphSAGE layer over the window stream (GNN-style
+reduceOnEdges)". The layer is designed MXU-first:
+
+- Neighbor aggregation is a masked mean over edge messages — the same
+  ``segment_sum`` primitive as ``reduce_on_edges`` (P2 parallelism), feeding
+  two large ``[V, F] @ [F, F']`` matmuls (self + neighbor paths) that run on
+  the MXU in bfloat16 (params/activations bf16, accumulation f32 via
+  ``preferred_element_type``).
+- Multi-chip: edge messages shard over the ``"edges"`` mesh axis (DP), the
+  output feature dimension of the weights over ``"model"`` (TP); the
+  aggregation all-reduces over the edge axis only
+  (:func:`make_sharded_train_step`), so collectives ride ICI.
+
+Plain-pytree parameters (no flax dependency), matching the rest of the
+framework.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.edgeblock import bucket_capacity
+
+
+def init_graphsage(
+    key,
+    dims: List[int],
+    dtype=jnp.bfloat16,
+) -> List[Dict[str, jax.Array]]:
+    """He-initialized stack of SAGE layers; ``dims = [in, h1, ..., out]``."""
+    params = []
+    for i, (fi, fo) in enumerate(zip(dims[:-1], dims[1:])):
+        key, k1, k2 = jax.random.split(key, 3)
+        scale = jnp.sqrt(2.0 / fi).astype(jnp.float32)
+        params.append(
+            {
+                "w_self": (jax.random.normal(k1, (fi, fo)) * scale).astype(dtype),
+                "w_nbr": (jax.random.normal(k2, (fi, fo)) * scale).astype(dtype),
+                "b": jnp.zeros((fo,), dtype),
+            }
+        )
+    return params
+
+
+def mean_aggregate(h, src, dst, mask, num_vertices: int):
+    """Masked mean of in-neighbor features: messages flow src -> dst."""
+    m = mask.astype(h.dtype)
+    msgs = h[src] * m[:, None]
+    agg = jnp.zeros((num_vertices, h.shape[1]), h.dtype).at[dst].add(msgs)
+    cnt = jnp.zeros(num_vertices, h.dtype).at[dst].add(m)
+    return agg / jnp.maximum(cnt, 1.0)[:, None]
+
+
+def sage_layer(params, h, src, dst, mask, *, activation=jax.nn.relu):
+    """One GraphSAGE layer: act(h @ W_self + mean_nbr(h) @ W_nbr + b)."""
+    agg = mean_aggregate(h, src, dst, mask, h.shape[0])
+    out = (
+        jnp.dot(h, params["w_self"], preferred_element_type=jnp.float32)
+        + jnp.dot(agg, params["w_nbr"], preferred_element_type=jnp.float32)
+        + params["b"].astype(jnp.float32)
+    )
+    return activation(out).astype(h.dtype)
+
+
+def sage_forward(params_stack, h, src, dst, mask):
+    """Full model: all layers, last layer linear (no activation)."""
+    n = len(params_stack)
+    for i, p in enumerate(params_stack):
+        act = jax.nn.relu if i < n - 1 else (lambda x: x)
+        h = sage_layer(p, h, src, dst, mask, activation=act)
+    return h
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _forward_jit(params_stack, h, src, dst, mask):
+    return sage_forward(params_stack, h, src, dst, mask)
+
+
+def make_sharded_train_step(mesh, n_layers_dims, lr=1e-2):
+    """Build a jitted multi-chip training step: DP over the edge axis, TP
+    over the output-feature dimension of every weight.
+
+    Returns ``(step_fn, shard_params_fn)``; ``step_fn(params, h, src, dst,
+    mask, targets) -> (params, loss)``. Shardings are expressed as
+    ``NamedSharding`` constraints so XLA inserts the psum/all-gathers.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import EDGE_AXIS, MODEL_AXIS
+
+    wsh = NamedSharding(mesh, P(None, MODEL_AXIS))
+    bsh = NamedSharding(mesh, P(MODEL_AXIS))
+    esh = NamedSharding(mesh, P(EDGE_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def shard_params(params_stack):
+        return [
+            {
+                "w_self": jax.device_put(p["w_self"], wsh),
+                "w_nbr": jax.device_put(p["w_nbr"], wsh),
+                "b": jax.device_put(p["b"], bsh),
+            }
+            for p in params_stack
+        ]
+
+    def loss_fn(params, h, src, dst, mask, targets):
+        out = sage_forward(params, h, src, dst, mask)
+        return jnp.mean((out - targets.astype(out.dtype)) ** 2)
+
+    @jax.jit
+    def step(params, h, src, dst, mask, targets):
+        h = jax.lax.with_sharding_constraint(h, rep)
+        src = jax.lax.with_sharding_constraint(src, esh)
+        dst = jax.lax.with_sharding_constraint(dst, esh)
+        mask = jax.lax.with_sharding_constraint(mask, esh)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, h, src, dst, mask, targets
+        )
+        params = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(p.dtype),
+            params,
+            grads,
+        )
+        return params, loss
+
+    return step, shard_params
+
+
+class StreamingGraphSAGE:
+    """Embeddings over the accumulated streaming graph, one forward per
+    window (the window stream analog of a deployed GNN encoder).
+
+    ``run(stream, features)`` carries the accumulated edge set; per window
+    it re-embeds all seen vertices with the current graph. ``features`` maps
+    raw vertex id -> feature vector (missing vertices get zeros).
+    """
+
+    def __init__(self, params_stack, feature_dim: int):
+        self.params = params_stack
+        self.feature_dim = feature_dim
+        self._src = np.zeros(0, np.int32)
+        self._dst = np.zeros(0, np.int32)
+
+    def run(self, stream, features: Dict[int, np.ndarray]) -> Iterator[jax.Array]:
+        vdict = stream.vertex_dict
+        dtype = self.params[0]["w_self"].dtype
+        for block in stream.blocks():
+            s, d, _ = block.to_host()
+            self._src = np.concatenate([self._src, s.astype(np.int32)])
+            self._dst = np.concatenate([self._dst, d.astype(np.int32)])
+            vcap = block.n_vertices
+            n = len(vdict)
+            h = np.zeros((vcap, self.feature_dim), np.float32)
+            raw = vdict.decode(np.arange(n))
+            for i, rv in enumerate(raw):
+                f = features.get(int(rv))
+                if f is not None:
+                    h[i] = f
+            cap = bucket_capacity(len(self._src))
+            src = np.zeros(cap, np.int32)
+            dst = np.zeros(cap, np.int32)
+            mask = np.zeros(cap, bool)
+            src[: len(self._src)] = self._src
+            dst[: len(self._dst)] = self._dst
+            mask[: len(self._src)] = True
+            out = _forward_jit(
+                self.params,
+                jnp.asarray(h, dtype),
+                jnp.asarray(src),
+                jnp.asarray(dst),
+                jnp.asarray(mask),
+            )
+            yield out[:n]
