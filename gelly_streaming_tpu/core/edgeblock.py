@@ -131,6 +131,42 @@ class EdgeBlock:
         return dataclasses.replace(self, n_vertices=int(n_vertices))
 
 
+class EdgeAccumulator:
+    """Device-resident growing edge list at bucketed capacity.
+
+    The carried-graph workloads (incremental PageRank, streaming GraphSAGE,
+    triangles) accumulate every window's edges; this keeps the arrays ON
+    DEVICE and appends only the new window via ``dynamic_update_slice``, so
+    per-window host->device transfer is O(new edges), not O(total).
+    Capacity grows in power-of-two buckets (bounded recompiles downstream).
+    """
+
+    def __init__(self):
+        self.src = jnp.zeros(0, jnp.int32)
+        self.dst = jnp.zeros(0, jnp.int32)
+        self.n_edges = 0
+
+    def append(self, s: np.ndarray, d: np.ndarray) -> None:
+        n_new = len(s)
+        total = self.n_edges + n_new
+        cap = bucket_capacity(total)
+        if cap > self.src.shape[0]:
+            pad = jnp.zeros(cap - self.src.shape[0], jnp.int32)
+            self.src = jnp.concatenate([self.src, pad])
+            self.dst = jnp.concatenate([self.dst, pad])
+        if n_new:
+            self.src = jax.lax.dynamic_update_slice(
+                self.src, jnp.asarray(s, jnp.int32), (self.n_edges,)
+            )
+            self.dst = jax.lax.dynamic_update_slice(
+                self.dst, jnp.asarray(d, jnp.int32), (self.n_edges,)
+            )
+        self.n_edges = total
+
+    def mask(self) -> jax.Array:
+        return jnp.arange(self.src.shape[0]) < self.n_edges
+
+
 def concat_blocks(blocks: Sequence[EdgeBlock], capacity: Optional[int] = None) -> EdgeBlock:
     """Concatenate blocks into one (host-side; used by window re-bucketing).
 

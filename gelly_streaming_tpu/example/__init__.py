@@ -1,0 +1,3 @@
+"""Example programs (the reference's L6 layer, ``example/`` — 10 CLI
+programs, ``SURVEY.md`` §2.4) plus the two BASELINE additions
+(incremental PageRank, streaming GraphSAGE)."""

@@ -1,0 +1,41 @@
+"""Bipartiteness check CLI (``example/BipartitenessCheckExample.java:40-125``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.stream import SimpleEdgeStream
+from ..core.window import CountWindow
+from ..library import BipartitenessCheck
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(edges, window_size: int, output_path: Optional[str] = None):
+    stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    last = None
+    for cand in stream.aggregate(BipartitenessCheck()):
+        last = cand
+    write_lines(output_path, [str(last)] if last is not None else [])
+    return last
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (2, 3):
+            print(
+                "Usage: bipartiteness_check <input edges path> "
+                "<merge window size (edges)> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), args[2] if len(args) > 2 else None)
+    else:
+        usage(
+            "bipartiteness_check",
+            "<input edges path> <merge window size (edges)> [output path]",
+        )
+        run(default_chain_edges(), 100)
+
+
+if __name__ == "__main__":
+    run_main(main)

@@ -1,0 +1,49 @@
+"""Broadcast triangle-count estimate CLI
+(``example/BroadcastTriangleCount.java:180-230``; defaults
+vertexCount=1000, samples=10000 from ``:216-217``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.window import CountWindow
+from ..library.sampling import BroadcastTriangleCount
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+DEFAULT_VERTEX_COUNT = 1000
+DEFAULT_SAMPLES = 10000
+
+
+def run(
+    edges,
+    vertex_count: int,
+    samples: int,
+    output_path: Optional[str] = None,
+    estimator_cls=BroadcastTriangleCount,
+):
+    est = estimator_cls(vertex_count=vertex_count, samples=samples)
+    results = list(est.run(edges))
+    write_lines(output_path, [f"({m},{e})" for m, e in results])
+    return results
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (3, 4):
+            print(
+                "Usage: broadcast_triangle_count <input edges path> "
+                "<vertex count> <samples> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), int(args[2]), args[3] if len(args) > 3 else None)
+    else:
+        usage(
+            "broadcast_triangle_count",
+            "<input edges path> <vertex count> <samples> [output path]",
+        )
+        run(default_chain_edges(), DEFAULT_VERTEX_COUNT, DEFAULT_SAMPLES)
+
+
+if __name__ == "__main__":
+    run_main(main)

@@ -1,0 +1,53 @@
+"""Streaming Connected Components CLI
+(``example/ConnectedComponentsExample.java:49-169``).
+
+The reference merges per-window DisjointSets and prints the flattened
+component sets per print window; here each window emits the running
+:class:`Components` summary and the last state per print interval is
+written, one component per line (``root=[members]``, the DisjointSet
+``toString`` format its test parses).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.stream import SimpleEdgeStream
+from ..core.window import CountWindow
+from ..library import ConnectedComponents
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(edges, window_size: int, output_path: Optional[str] = None):
+    stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    last = None
+    for comps in stream.aggregate(ConnectedComponents()):
+        last = comps
+    lines = [
+        f"{root}={members}"
+        for root, members in sorted(last.components.items())
+    ] if last else []
+    write_lines(output_path, lines)
+    return last
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (2, 3):
+            print(
+                "Usage: connected_components <input edges path> "
+                "<merge window size (edges)> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), args[2] if len(args) > 2 else None)
+    else:
+        usage(
+            "connected_components",
+            "<input edges path> <merge window size (edges)> [output path]",
+        )
+        run(default_chain_edges(), 100)
+
+
+if __name__ == "__main__":
+    run_main(main)

@@ -1,0 +1,42 @@
+"""Dynamic degree distribution CLI
+(``example/DegreeDistribution.java:42-73``). Input lines: ``src trg +`` /
+``src trg -``; output: ``(degree,count)`` change lines per window."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.window import CountWindow
+from ..library.degrees import DegreeDistribution
+from .common import read_edges, run_main, usage, write_lines
+
+
+def run(events, window_size: int, output_path: Optional[str] = None):
+    dd = DegreeDistribution(CountWindow(window_size))
+    lines = []
+    for changes in dd.run(events):
+        lines.extend(f"({d},{c})" for d, c in changes)
+    write_lines(output_path, lines)
+    return dd
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (2, 3):
+            print(
+                "Usage: degree_distribution <input events path> "
+                "<window size (events)> [output path]"
+            )
+            return
+        events = read_edges(args[0], n_fields=3, val_fn=str)
+        run(events, int(args[1]), args[2] if len(args) > 2 else None)
+    else:
+        usage(
+            "degree_distribution",
+            "<input events path> <window size (events)> [output path]",
+        )
+        run([(1, 2, "+"), (2, 3, "+"), (1, 3, "+"), (2, 3, "-")], 1)
+
+
+if __name__ == "__main__":
+    run_main(main)

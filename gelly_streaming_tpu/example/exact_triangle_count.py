@@ -1,0 +1,44 @@
+"""Exact streaming triangle count CLI
+(``example/ExactTriangleCount.java:44-66``). Output: the final
+``(vertex,count)`` lines, vertex -1 being the global total."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.stream import SimpleEdgeStream
+from ..core.window import CountWindow
+from ..library.triangles import ExactTriangleCount
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(edges, window_size: int, output_path: Optional[str] = None):
+    stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    final = {}
+    for emissions in ExactTriangleCount().run(stream):
+        final.update(dict(emissions))
+    lines = [f"({v},{c})" for v, c in sorted(final.items())]
+    write_lines(output_path, lines)
+    return final
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (2, 3):
+            print(
+                "Usage: exact_triangle_count <input edges path> "
+                "<window size (edges)> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), args[2] if len(args) > 2 else None)
+    else:
+        usage(
+            "exact_triangle_count",
+            "<input edges path> <window size (edges)> [output path]",
+        )
+        run(default_chain_edges(), 100)
+
+
+if __name__ == "__main__":
+    run_main(main)

@@ -1,0 +1,43 @@
+"""Incidence-sampling triangle-count estimate CLI
+(``example/IncidenceSamplingTriangleCount.java:38-60``)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..library.sampling import IncidenceSamplingTriangleCount
+from .broadcast_triangle_count import (
+    DEFAULT_SAMPLES,
+    DEFAULT_VERTEX_COUNT,
+    run as _run_shared,
+)
+from .common import default_chain_edges, read_edges, run_main, usage
+
+
+def run(edges, vertex_count, samples, output_path=None):
+    return _run_shared(
+        edges, vertex_count, samples, output_path,
+        estimator_cls=IncidenceSamplingTriangleCount,
+    )
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (3, 4):
+            print(
+                "Usage: incidence_sampling_triangle_count <input edges path> "
+                "<vertex count> <samples> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), int(args[2]), args[3] if len(args) > 3 else None)
+    else:
+        usage(
+            "incidence_sampling_triangle_count",
+            "<input edges path> <vertex count> <samples> [output path]",
+        )
+        run(default_chain_edges(), DEFAULT_VERTEX_COUNT, DEFAULT_SAMPLES)
+
+
+if __name__ == "__main__":
+    run_main(main)

@@ -1,0 +1,45 @@
+"""Incremental PageRank CLI (BASELINE config #4; no reference analog).
+Output: final ``(vertex,rank)`` lines, 6 decimals."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.stream import SimpleEdgeStream
+from ..core.window import CountWindow
+from ..library.pagerank import IncrementalPageRank
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(edges, window_size: int, output_path: Optional[str] = None):
+    stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    pr = IncrementalPageRank()
+    for emission in pr.run(stream):
+        pass
+    ranks = pr.ranks()
+    write_lines(
+        output_path, [f"({v},{r:.6f})" for v, r in sorted(ranks.items())]
+    )
+    return pr
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (2, 3):
+            print(
+                "Usage: incremental_pagerank <input edges path> "
+                "<window size (edges)> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), args[2] if len(args) > 2 else None)
+    else:
+        usage(
+            "incremental_pagerank",
+            "<input edges path> <window size (edges)> [output path]",
+        )
+        run(default_chain_edges(), 25)
+
+
+if __name__ == "__main__":
+    run_main(main)

@@ -1,0 +1,44 @@
+"""Iterative (label-emitting) CC CLI
+(``example/IterativeConnectedComponents.java:52-63``). Output:
+``(vertex,componentId)`` corrected-label lines."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.stream import SimpleEdgeStream
+from ..core.window import CountWindow
+from ..library.iterative_cc import IterativeConnectedComponents
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(edges, window_size: int, output_path: Optional[str] = None):
+    stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    icc = IterativeConnectedComponents()
+    lines = []
+    for changed in icc.run(stream):
+        lines.extend(f"({v},{c})" for v, c in changed)
+    write_lines(output_path, lines)
+    return icc
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (2, 3):
+            print(
+                "Usage: iterative_connected_components <input edges path> "
+                "<window size (edges)> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), args[2] if len(args) > 2 else None)
+    else:
+        usage(
+            "iterative_connected_components",
+            "<input edges path> <window size (edges)> [output path]",
+        )
+        run(default_chain_edges(), 10)
+
+
+if __name__ == "__main__":
+    run_main(main)

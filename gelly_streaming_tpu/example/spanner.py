@@ -1,0 +1,45 @@
+"""k-Spanner CLI (``example/SpannerExample.java:49-166``; default k=3 from
+``:80``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.stream import SimpleEdgeStream
+from ..core.window import CountWindow
+from ..library import Spanner
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(edges, window_size: int, k: int = 3, output_path: Optional[str] = None):
+    stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    last = None
+    for spanner in stream.aggregate(Spanner(k=k)):
+        last = spanner
+    lines = (
+        sorted(f"{u} {v}" for u, v in last.edges()) if last is not None else []
+    )
+    write_lines(output_path, lines)
+    return last
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (3, 4):
+            print(
+                "Usage: spanner <input edges path> <merge window size (edges)> "
+                "<k> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), int(args[2]), args[3] if len(args) > 3 else None)
+    else:
+        usage(
+            "spanner",
+            "<input edges path> <merge window size (edges)> <k> [output path]",
+        )
+        run(default_chain_edges(), 100, 3)
+
+
+if __name__ == "__main__":
+    run_main(main)

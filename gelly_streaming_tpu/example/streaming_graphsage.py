@@ -1,0 +1,68 @@
+"""Streaming GraphSAGE encoder CLI (BASELINE config #5; no reference
+analog). Embeds the accumulated graph once per window with random
+features; output: the final embedding norms per vertex."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.stream import SimpleEdgeStream
+from ..core.window import CountWindow
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(
+    edges,
+    window_size: int,
+    feature_dim: int = 32,
+    output_path: Optional[str] = None,
+    seed: int = 0,
+):
+    import jax
+
+    from ..models.graphsage import StreamingGraphSAGE, init_graphsage
+
+    params = init_graphsage(jax.random.PRNGKey(seed), [feature_dim, 64, 32])
+    rng = np.random.default_rng(seed)
+    verts = sorted({v for e in edges for v in e[:2]})
+    feats = {v: rng.normal(size=feature_dim).astype(np.float32) for v in verts}
+    stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    sage = StreamingGraphSAGE(params, feature_dim=feature_dim)
+    out = None
+    for out in sage.run(stream, feats):
+        pass
+    if out is None:  # empty stream: no windows, nothing to embed
+        write_lines(output_path, [])
+        return None
+    norms = np.linalg.norm(np.asarray(out, np.float32), axis=1)
+    vdict = stream.vertex_dict
+    raw = vdict.decode(np.arange(len(norms)))
+    write_lines(
+        output_path,
+        [f"({int(v)},{n:.4f})" for v, n in zip(raw, norms)],
+    )
+    return out
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) not in (2, 3):
+            print(
+                "Usage: streaming_graphsage <input edges path> "
+                "<window size (edges)> [output path]"
+            )
+            return
+        edges = read_edges(args[0])
+        run(edges, int(args[1]), output_path=args[2] if len(args) > 2 else None)
+    else:
+        usage(
+            "streaming_graphsage",
+            "<input edges path> <window size (edges)> [output path]",
+        )
+        run(default_chain_edges(), 25)
+
+
+if __name__ == "__main__":
+    run_main(main)

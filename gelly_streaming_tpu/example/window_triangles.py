@@ -1,0 +1,40 @@
+"""Window triangle count CLI (``example/WindowTriangles.java:40-160``).
+
+Input lines: ``src trg timestamp`` (event time, like the reference's
+``AscendingTimestampExtractor`` path); output lines ``(count,windowMaxTs)``
+— the format ``WindowTrianglesITCase`` compares.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.window import EventTimeWindow
+from ..library.triangles import WindowTriangles
+from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+
+
+def run(edges, window_time: float, output_path: Optional[str] = None):
+    wt = WindowTriangles(EventTimeWindow(window_time, timestamp_fn=lambda e: e[2]))
+    results = list(wt.run(edges))
+    write_lines(output_path, [f"({c},{int(ts)})" for c, ts in results])
+    return results
+
+
+def main(args: List[str]) -> None:
+    if args:
+        if len(args) != 3:
+            print(
+                "Usage: window_triangles <input edges path> <output path> "
+                "<window time>"
+            )
+            return
+        edges = read_edges(args[0], n_fields=3)
+        run(edges, float(args[2]), args[1])
+    else:
+        usage("window_triangles", "<input edges path> <output path> <window time>")
+        run(default_chain_edges(), 300.0)
+
+
+if __name__ == "__main__":
+    run_main(main)

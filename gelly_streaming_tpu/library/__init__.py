@@ -10,3 +10,4 @@ from .matching import (
     MatchingEventType,
 )
 from .iterative_cc import IterativeConnectedComponents
+from .pagerank import IncrementalPageRank

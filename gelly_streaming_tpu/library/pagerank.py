@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.edgeblock import bucket_capacity
+from ..core.edgeblock import EdgeAccumulator
 
 
 class PageRankEmission(NamedTuple):
@@ -40,16 +40,17 @@ class PageRankEmission(NamedTuple):
 
 @functools.partial(jax.jit, static_argnums=(5,), static_argnames=("max_iter",))
 def _pagerank_fixpoint(
-    ranks, src, dst, mask, n_seen, num_vertices: int,
+    ranks, src, dst, n_edges, n_seen, num_vertices: int,
     damping=0.85, tol=1e-6, max_iter: int = 100,
 ):
     """Warm-started power iteration to fixpoint on the accumulated edges.
 
-    ``num_vertices`` is the (static) capacity; ``n_seen`` the dynamic count
-    of real vertices — capacity slots beyond it are held at rank 0 and get
-    neither teleport nor dangling mass, so ranks over the seen vertices sum
-    to 1 regardless of padding.
+    ``num_vertices`` is the (static) capacity; ``n_seen``/``n_edges`` the
+    dynamic real counts — capacity slots beyond them are held at rank 0 /
+    masked out and get neither teleport nor dangling mass, so ranks over
+    the seen vertices sum to 1 regardless of padding.
     """
+    mask = jnp.arange(src.shape[0]) < n_edges
     m = mask.astype(ranks.dtype)
     active = jnp.arange(num_vertices) < n_seen
     n = jnp.maximum(n_seen, 1).astype(ranks.dtype)
@@ -89,8 +90,7 @@ class IncrementalPageRank:
         self.damping = damping
         self.tol = tol
         self.max_iter = max_iter
-        self._src = np.zeros(0, np.int32)
-        self._dst = np.zeros(0, np.int32)
+        self._edges = EdgeAccumulator()
         self._ranks = None
         self._vdict = None
 
@@ -98,8 +98,7 @@ class IncrementalPageRank:
         self._vdict = stream.vertex_dict
         for w, block in enumerate(stream.blocks()):
             s, d, _ = block.to_host()
-            self._src = np.concatenate([self._src, s.astype(np.int32)])
-            self._dst = np.concatenate([self._dst, d.astype(np.int32)])
+            self._edges.append(s, d)
             vcap = block.n_vertices
             n_seen = len(self._vdict)
             if self._ranks is None:
@@ -116,18 +115,11 @@ class IncrementalPageRank:
                     active & (self._ranks == 0.0), 1.0 / n_seen, self._ranks
                 )
                 self._ranks = self._ranks / self._ranks.sum()
-            cap = bucket_capacity(len(self._src))
-            src = np.zeros(cap, np.int32)
-            dst = np.zeros(cap, np.int32)
-            mask = np.zeros(cap, bool)
-            src[: len(self._src)] = self._src
-            dst[: len(self._dst)] = self._dst
-            mask[: len(self._src)] = True
             self._ranks, delta, iters = _pagerank_fixpoint(
                 self._ranks,
-                jnp.asarray(src),
-                jnp.asarray(dst),
-                jnp.asarray(mask),
+                self._edges.src,
+                self._edges.dst,
+                jnp.int32(self._edges.n_edges),
                 jnp.int32(n_seen),
                 vcap,
                 damping=self.damping,

@@ -1,0 +1,8 @@
+from .graphsage import (
+    StreamingGraphSAGE,
+    init_graphsage,
+    make_sharded_train_step,
+    mean_aggregate,
+    sage_forward,
+    sage_layer,
+)

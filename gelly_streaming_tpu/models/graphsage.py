@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.edgeblock import bucket_capacity
+from ..core.edgeblock import EdgeAccumulator
 
 
 def init_graphsage(
@@ -79,12 +79,12 @@ def sage_forward(params_stack, h, src, dst, mask):
     return h
 
 
-@functools.partial(jax.jit, static_argnums=())
+@jax.jit
 def _forward_jit(params_stack, h, src, dst, mask):
     return sage_forward(params_stack, h, src, dst, mask)
 
 
-def make_sharded_train_step(mesh, n_layers_dims, lr=1e-2):
+def make_sharded_train_step(mesh, lr=1e-2):
     """Build a jitted multi-chip training step: DP over the edge axis, TP
     over the output-feature dimension of every weight.
 
@@ -146,36 +146,43 @@ class StreamingGraphSAGE:
     def __init__(self, params_stack, feature_dim: int):
         self.params = params_stack
         self.feature_dim = feature_dim
-        self._src = np.zeros(0, np.int32)
-        self._dst = np.zeros(0, np.int32)
+        # accumulated graph + feature matrix carried ON DEVICE at bucketed
+        # capacity; per window only new edges / new vertices' feature rows
+        # transfer host->device
+        self._edges = EdgeAccumulator()
+        self._h = None
+        self._n_seen = 0
 
     def run(self, stream, features: Dict[int, np.ndarray]) -> Iterator[jax.Array]:
         vdict = stream.vertex_dict
         dtype = self.params[0]["w_self"].dtype
         for block in stream.blocks():
             s, d, _ = block.to_host()
-            self._src = np.concatenate([self._src, s.astype(np.int32)])
-            self._dst = np.concatenate([self._dst, d.astype(np.int32)])
+            self._edges.append(s, d)
             vcap = block.n_vertices
             n = len(vdict)
-            h = np.zeros((vcap, self.feature_dim), np.float32)
-            raw = vdict.decode(np.arange(n))
+            self._extend_features(vdict, n, vcap, features, dtype)
+            out = _forward_jit(
+                self.params, self._h, self._edges.src, self._edges.dst,
+                self._edges.mask(),
+            )
+            yield out[:n]
+
+    def _extend_features(self, vdict, n: int, vcap: int, features, dtype) -> None:
+        """Fill feature rows for vertices first seen this window only."""
+        if self._h is None:
+            self._h = jnp.zeros((vcap, self.feature_dim), dtype)
+        elif vcap > self._h.shape[0]:
+            pad = jnp.zeros((vcap - self._h.shape[0], self.feature_dim), dtype)
+            self._h = jnp.concatenate([self._h, pad])
+        if n > self._n_seen:
+            raw = vdict.decode(np.arange(self._n_seen, n))
+            rows = np.zeros((n - self._n_seen, self.feature_dim), np.float32)
             for i, rv in enumerate(raw):
                 f = features.get(int(rv))
                 if f is not None:
-                    h[i] = f
-            cap = bucket_capacity(len(self._src))
-            src = np.zeros(cap, np.int32)
-            dst = np.zeros(cap, np.int32)
-            mask = np.zeros(cap, bool)
-            src[: len(self._src)] = self._src
-            dst[: len(self._dst)] = self._dst
-            mask[: len(self._src)] = True
-            out = _forward_jit(
-                self.params,
-                jnp.asarray(h, dtype),
-                jnp.asarray(src),
-                jnp.asarray(dst),
-                jnp.asarray(mask),
+                    rows[i] = f
+            self._h = jax.lax.dynamic_update_slice(
+                self._h, jnp.asarray(rows, dtype), (self._n_seen, 0)
             )
-            yield out[:n]
+            self._n_seen = n

@@ -138,7 +138,7 @@ def test_sharded_train_step_runs_on_virtual_mesh():
         pytest.skip("needs 4 virtual devices")
     mesh = make_mesh(n_edge_shards=2, n_model_shards=2)
     params = init_graphsage(jax.random.PRNGKey(2), [4, 8, 4], dtype=jnp.float32)
-    step, shard_params = make_sharded_train_step(mesh, [4, 8, 4], lr=0.1)
+    step, shard_params = make_sharded_train_step(mesh, lr=0.1)
     params = shard_params(params)
     V, E = 8, 16
     key = jax.random.PRNGKey(3)
