@@ -1,0 +1,61 @@
+"""Typed engine configuration + CLI parsing (SURVEY.md §5).
+
+The reference's "config system" is per-example positional-arg parsing with
+hard-coded defaults (``ConnectedComponentsExample.java:78-102``) and engine
+knobs as constructor params (``mergeWindowTime``, ``transientState``, tree
+``degree``). SURVEY.md §5: one small typed config object + CLI, nothing
+fancier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from ..core.window import CountWindow, EventTimeWindow, WindowPolicy
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Engine-level knobs, the analogs of the reference's ctor params."""
+
+    #: edges per merge window (CountWindow) — the mergeWindowTime analog
+    window_size: int = 1 << 16
+    #: event-time window span instead of a count window (when set)
+    window_time: Optional[float] = None
+    #: reset the running summary after each emission
+    #: (``SummaryAggregation.java:113-115``)
+    transient_state: bool = False
+    #: tree-reduce fan-in, API parity (``SummaryTreeReduce.java:75``)
+    tree_degree: int = 2
+    #: fixed EdgeBlock capacity override (else power-of-two bucketing)
+    capacity: Optional[int] = None
+    #: edge-axis shards for the device mesh (None = all devices)
+    edge_shards: Optional[int] = None
+
+    def window(self, timestamp_fn=None) -> WindowPolicy:
+        if self.window_time is not None:
+            return EventTimeWindow(self.window_time, timestamp_fn=timestamp_fn)
+        return CountWindow(self.window_size)
+
+    @staticmethod
+    def add_args(parser: argparse.ArgumentParser) -> None:
+        g = parser.add_argument_group("engine")
+        g.add_argument("--window-size", type=int, default=1 << 16)
+        g.add_argument("--window-time", type=float, default=None)
+        g.add_argument("--transient-state", action="store_true")
+        g.add_argument("--tree-degree", type=int, default=2)
+        g.add_argument("--capacity", type=int, default=None)
+        g.add_argument("--edge-shards", type=int, default=None)
+
+    @classmethod
+    def from_args(cls, ns: argparse.Namespace) -> "EngineConfig":
+        return cls(
+            window_size=ns.window_size,
+            window_time=ns.window_time,
+            transient_state=ns.transient_state,
+            tree_degree=ns.tree_degree,
+            capacity=ns.capacity,
+            edge_shards=ns.edge_shards,
+        )

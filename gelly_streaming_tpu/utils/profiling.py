@@ -1,0 +1,106 @@
+"""Per-window step timing and device tracing (SURVEY.md §5).
+
+The reference has no profiling beyond ``getNetRuntime()`` printed by one
+example (``CentralizedWeightedMatching.java:62-64``); its pom references
+measurement jars whose classes don't exist. SURVEY.md §5 directs: plan for
+``jax.profiler`` traces + per-window step timing from day one, and keep the
+reference's design stance that metrics are ordinary output streams
+(``README.md:26-32``).
+
+- :func:`profiled` wraps any per-window emission iterator and yields
+  ``(result, WindowStats)`` pairs — the metrics ARE a stream.
+- :class:`StreamProfiler` aggregates those stats (edges/sec, p50/p95
+  window latency).
+- :func:`device_trace` wraps ``jax.profiler.trace`` for TensorBoard-
+  readable TPU traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator, List, NamedTuple, Optional, Tuple
+
+
+class WindowStats(NamedTuple):
+    """One window's measurements."""
+
+    index: int
+    wall_seconds: float
+    edges: Optional[int]  # None when the source doesn't expose block sizes
+
+
+class StreamProfiler:
+    """Aggregate window stats; exposes throughput and latency percentiles."""
+
+    def __init__(self):
+        self.stats: List[WindowStats] = []
+
+    def record(self, s: WindowStats) -> None:
+        self.stats.append(s)
+
+    # ------------------------------------------------------------------ #
+    def total_edges(self) -> int:
+        return sum(s.edges or 0 for s in self.stats)
+
+    def total_seconds(self) -> float:
+        return sum(s.wall_seconds for s in self.stats)
+
+    def edges_per_sec(self) -> float:
+        t = self.total_seconds()
+        return self.total_edges() / t if t > 0 else 0.0
+
+    def latency_percentile(self, q: float) -> float:
+        """q in [0, 100]: percentile of per-window wall time (seconds)."""
+        if not self.stats:
+            return 0.0
+        xs = sorted(s.wall_seconds for s in self.stats)
+        k = min(len(xs) - 1, max(0, int(round(q / 100 * (len(xs) - 1)))))
+        return xs[k]
+
+    def summary(self) -> dict:
+        return {
+            "windows": len(self.stats),
+            "edges": self.total_edges(),
+            "edges_per_sec": self.edges_per_sec(),
+            "p50_window_s": self.latency_percentile(50),
+            "p95_window_s": self.latency_percentile(95),
+        }
+
+
+def profiled(
+    iterator: Iterator[Any],
+    profiler: Optional[StreamProfiler] = None,
+    edges_per_window: Optional[Iterator[int]] = None,
+) -> Iterator[Tuple[Any, WindowStats]]:
+    """Yield ``(result, WindowStats)`` per window of any emission stream.
+
+    Timing covers the work to produce each emission (next() call), i.e. the
+    host windowing + device step + host emission — the end-to-end per-window
+    latency BASELINE.md's p50 metric asks for.
+    """
+    prof = profiler if profiler is not None else StreamProfiler()
+    idx = 0
+    it = iter(iterator)
+    sizes = iter(edges_per_window) if edges_per_window is not None else None
+    while True:
+        t0 = time.perf_counter()
+        try:
+            result = next(it)
+        except StopIteration:
+            return
+        dt = time.perf_counter() - t0
+        n = next(sizes, None) if sizes is not None else None
+        stats = WindowStats(idx, dt, n)
+        prof.record(stats)
+        yield result, stats
+        idx += 1
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """TensorBoard-readable device trace around a block of stream steps."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,65 @@
+"""Tests for the auxiliary subsystems: profiling streams, config, types."""
+
+import argparse
+import time
+
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow, EventTimeWindow
+from gelly_streaming_tpu.library import ConnectedComponents
+from gelly_streaming_tpu.utils import (
+    EngineConfig,
+    SignedVertex,
+    StreamProfiler,
+    profiled,
+)
+
+
+def test_profiled_aggregation_stream(sample_edges):
+    stream = SimpleEdgeStream(sample_edges, window=CountWindow(3))
+    prof = StreamProfiler()
+    results = [
+        r for r, _ in profiled(stream.aggregate(ConnectedComponents()), prof)
+    ]
+    assert len(results) == 3
+    s = prof.summary()
+    assert s["windows"] == 3
+    assert s["p50_window_s"] > 0
+    assert prof.latency_percentile(95) >= prof.latency_percentile(50) >= 0
+
+
+def test_profiled_counts_edges():
+    def gen():
+        for i in range(4):
+            time.sleep(0.001)
+            yield i
+
+    prof = StreamProfiler()
+    out = list(profiled(gen(), prof, edges_per_window=iter([10, 20, 30, 40])))
+    assert [r for r, _ in out] == [0, 1, 2, 3]
+    assert prof.total_edges() == 100
+    assert prof.edges_per_sec() > 0
+
+
+def test_engine_config_window_selection():
+    cfg = EngineConfig(window_size=128)
+    assert isinstance(cfg.window(), CountWindow)
+    cfg2 = EngineConfig(window_time=300.0)
+    w = cfg2.window(timestamp_fn=lambda e: e[2])
+    assert isinstance(w, EventTimeWindow)
+    assert w.size == 300.0
+
+
+def test_engine_config_cli_roundtrip():
+    parser = argparse.ArgumentParser()
+    EngineConfig.add_args(parser)
+    ns = parser.parse_args(["--window-size", "64", "--transient-state"])
+    cfg = EngineConfig.from_args(ns)
+    assert cfg.window_size == 64
+    assert cfg.transient_state is True
+    assert cfg.tree_degree == 2
+
+
+def test_signed_vertex_reverse():
+    sv = SignedVertex(5, True)
+    assert sv.reverse() == SignedVertex(5, False)
+    assert sv.reverse().reverse() == sv
