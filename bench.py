@@ -181,10 +181,7 @@ def bench_pagerank(n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int 
     from gelly_streaming_tpu.library.pagerank import IncrementalPageRank
 
     src, dst = make_stream(n_vertices, window * n_win, seed=11)
-    edges = np.stack([src, dst], axis=1)
-    stream = SimpleEdgeStream(
-        ((int(a), int(b), 0.0) for a, b in edges), window=CountWindow(window)
-    )
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(window))
     pr = IncrementalPageRank(tol=1e-6, max_iter=50)
     t0 = time.perf_counter()
     for _ in pr.run(stream):
@@ -238,15 +235,33 @@ def main():
     }
 
     if "--all" in sys.argv:
+        # Each config runs in a FRESH subprocess: the axon TPU runtime
+        # degrades subsequent scatter executions ~250x after certain
+        # programs run in the same process (measured: a scatter-min program
+        # drops later scatter-adds from 0.06ms to 15ms), so in-process
+        # sequencing would corrupt the numbers.
+        import subprocess
+
         detail = {"headline": headline, "cpu_unionfind_eps": round(cpu_eps, 1)}
-        log("bench: continuous degrees...")
-        detail["degrees_eps"] = round(bench_degrees(src, dst, n_vertices, window), 1)
-        log("bench: window triangles (1M-edge windows)...")
-        detail["window_triangles_eps"] = round(bench_window_triangles(), 1)
-        log("bench: incremental pagerank...")
-        detail["pagerank_eps"] = round(bench_pagerank(), 1)
-        log("bench: streaming graphsage...")
-        detail["graphsage_eps"] = round(bench_graphsage(), 1)
+        for key, expr in [
+            ("degrees_eps",
+             f"import bench; s,d=bench.make_stream({n_vertices},{n_edges}); "
+             f"print(bench.bench_degrees(s,d,{n_vertices},{window}))"),
+            ("window_triangles_eps",
+             "import bench; print(bench.bench_window_triangles())"),
+            ("pagerank_eps", "import bench; print(bench.bench_pagerank())"),
+            ("graphsage_eps", "import bench; print(bench.bench_graphsage())"),
+        ]:
+            log(f"bench: {key}...")
+            out = subprocess.run(
+                [sys.executable, "-c", expr],
+                capture_output=True, text=True, timeout=420,
+            )
+            if out.returncode == 0:
+                detail[key] = round(float(out.stdout.strip().splitlines()[-1]), 1)
+            else:
+                detail[key] = None
+                log(out.stderr[-500:])
         with open("BENCH_DETAIL.json", "w") as f:
             json.dump(detail, f, indent=2)
         log(f"detail: {json.dumps(detail)}")

@@ -33,8 +33,10 @@ class VertexDict:
     """Incremental bidirectional mapping raw id <-> compact int32 index."""
 
     def __init__(self, min_capacity: int = 8):
-        self._raw_to_idx: dict[int, int] = {}
         self._idx_to_raw: list[int] = []
+        # batch-lookup index: raw ids sorted, with their compact ids aligned
+        self._sorted_raw = np.empty(0, np.int64)
+        self._sorted_idx = np.empty(0, np.int32)
         self._min_capacity = min_capacity
 
     def __len__(self) -> int:
@@ -48,33 +50,49 @@ class VertexDict:
     def encode(self, raw: np.ndarray) -> np.ndarray:
         """Map raw ids to compact indices, assigning new ones first-seen-first.
 
-        Vectorized fast path: look up already-known ids via a single dict
-        sweep only over the novel ones.
+        Fully vectorized (no per-element Python): known ids resolve by
+        binary search into the sorted index; novel ids get sequential
+        compact ids in first-appearance order and are merged in. This is
+        the host ingest hot path — it must keep up with the device.
         """
-        raw = np.asarray(raw).ravel()
-        out = np.empty(raw.shape[0], dtype=np.int32)
-        table = self._raw_to_idx
-        rev = self._idx_to_raw
-        for i, r in enumerate(raw.tolist()):
-            idx = table.get(r)
-            if idx is None:
-                idx = len(rev)
-                table[r] = idx
-                rev.append(r)
-            out[i] = idx
+        raw = np.asarray(raw, np.int64).ravel()
+        n = raw.shape[0]
+        out = np.empty(n, dtype=np.int32)
+        if n == 0:
+            return out
+        if self._sorted_raw.size:
+            pos = np.searchsorted(self._sorted_raw, raw)
+            pos_c = np.minimum(pos, self._sorted_raw.size - 1)
+            known = self._sorted_raw[pos_c] == raw
+            out[known] = self._sorted_idx[pos_c[known]]
+        else:
+            known = np.zeros(n, bool)
+        novel = ~known
+        if novel.any():
+            vals = raw[novel]
+            uniq, first_pos = np.unique(vals, return_index=True)
+            order = np.argsort(first_pos, kind="stable")
+            base = len(self._idx_to_raw)
+            id_of_uniq = np.empty(uniq.size, np.int32)
+            id_of_uniq[order] = base + np.arange(uniq.size, dtype=np.int32)
+            out[novel] = id_of_uniq[np.searchsorted(uniq, vals)]
+            self._idx_to_raw.extend(uniq[order].tolist())
+            merged_raw = np.concatenate([self._sorted_raw, uniq])
+            merged_idx = np.concatenate([self._sorted_idx, id_of_uniq])
+            o = np.argsort(merged_raw, kind="stable")
+            self._sorted_raw = merged_raw[o]
+            self._sorted_idx = merged_idx[o]
         return out
 
     def encode_one(self, raw: int) -> int:
-        idx = self._raw_to_idx.get(raw)
-        if idx is None:
-            idx = len(self._idx_to_raw)
-            self._raw_to_idx[raw] = idx
-            self._idx_to_raw.append(raw)
-        return idx
+        return int(self.encode(np.asarray([raw]))[0])
 
     def lookup(self, raw: int) -> int | None:
         """Query without inserting; None if unseen."""
-        return self._raw_to_idx.get(raw)
+        pos = int(np.searchsorted(self._sorted_raw, raw))
+        if pos < self._sorted_raw.size and self._sorted_raw[pos] == raw:
+            return int(self._sorted_idx[pos])
+        return None
 
     def decode(self, idx: Iterable[int] | np.ndarray) -> np.ndarray:
         rev = np.asarray(self._idx_to_raw, dtype=np.int64)
