@@ -142,19 +142,17 @@ def bench_degrees(src, dst, n_vertices: int, window: int) -> float:
 def bench_window_triangles(n_vertices: int = 1 << 17, window: int = 1 << 20) -> float:
     import jax
 
-    from gelly_streaming_tpu.core.edgeblock import bucket_capacity
     from gelly_streaming_tpu.library.triangles import _window_step
 
-    # Uniform-degree stream: the dense neighbor rows are sized by the max
-    # window degree, which a Zipf hub would blow past HBM. (Degree-ordered
-    # orientation to handle skewed windows is tracked as kernel work.)
-    rng = np.random.default_rng(9)
-    src = rng.integers(0, n_vertices, window * 2).astype(np.int32)
-    dst = rng.integers(0, n_vertices, window * 2).astype(np.int32)
-    deg = np.bincount(src[:window], minlength=n_vertices) + np.bincount(
-        dst[:window], minlength=n_vertices
+    # Zipf-skewed stream: the degree-oriented kernel bounds row width by
+    # the max out-degree (~sqrt(2E)), so hubs no longer size the rows.
+    from gelly_streaming_tpu.library.triangles import _oriented_degree_bucket
+
+    src, dst = make_stream(n_vertices, window * 2, seed=9)
+    max_deg = max(
+        _oriented_degree_bucket(src[:window], dst[:window], n_vertices),
+        _oriented_degree_bucket(src[window:], dst[window:], n_vertices),
     )
-    max_deg = bucket_capacity(int(deg.max()))
     import jax.numpy as jnp
 
     blocks = [
