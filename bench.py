@@ -179,12 +179,17 @@ def bench_pagerank(n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int 
     from gelly_streaming_tpu.library.pagerank import IncrementalPageRank
 
     src, dst = make_stream(n_vertices, window * n_win, seed=11)
-    stream = SimpleEdgeStream((src, dst), window=CountWindow(window))
-    pr = IncrementalPageRank(tol=1e-6, max_iter=50)
-    t0 = time.perf_counter()
-    for _ in pr.run(stream):
-        pass
-    return n_win * window / (time.perf_counter() - t0)
+
+    def one_pass():
+        stream = SimpleEdgeStream((src, dst), window=CountWindow(window))
+        pr = IncrementalPageRank(tol=1e-6, max_iter=50)
+        t0 = time.perf_counter()
+        for _ in pr.run(stream):
+            pass
+        return n_win * window / (time.perf_counter() - t0)
+
+    one_pass()  # warm pass: pays the per-capacity-bucket compiles
+    return one_pass()  # steady state (same capacities -> cached executables)
 
 
 # --------------------------------------------------------------------- #

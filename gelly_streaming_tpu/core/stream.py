@@ -147,7 +147,20 @@ class SimpleEdgeStream(GraphStream):
             windower = Windower(policy)
             self._vdict = windower.vertex_dict
             edges_it = edges
-            self._block_source = lambda: windower.blocks(iter(edges_it))
+            is_cols = isinstance(edges, np.ndarray) or (
+                isinstance(edges, (tuple, list))
+                and len(edges) >= 2
+                and all(
+                    isinstance(c, np.ndarray) and c.ndim == 1 for c in edges
+                )
+            )
+            if is_cols:
+                # numpy fast path: hand the columns straight to the
+                # Windower (iter() would hide them behind a generic
+                # iterator and fall back to per-record parsing)
+                self._block_source = lambda: windower.blocks(edges_it)
+            else:
+                self._block_source = lambda: windower.blocks(iter(edges_it))
 
     # ------------------------------------------------------------------ #
     # Plumbing

@@ -58,23 +58,31 @@ def _pagerank_fixpoint(
     out_deg = jnp.maximum(ones, 1.0)
     dangling = active & (ones == 0.0)
 
-    def body(state):
-        r, _, it = state
+    # Fixed-trip lax.scan with a converged-freeze flag instead of a
+    # while_loop: trip count is static, so every window reuses one
+    # executable regardless of how many iterations actually apply, and a
+    # frozen step costs only the already-paid vector work. (Data-dependent
+    # while_loop trip counts also interact badly with this environment's
+    # remote-TPU runtime.)
+    def body(carry, _):
+        r, done = carry
         contrib = jnp.where(mask, r[src] / out_deg[src], 0.0)
         new = jnp.zeros(num_vertices, r.dtype).at[dst].add(contrib)
         dangling_mass = jnp.sum(jnp.where(dangling, r, 0.0))
         new = (1.0 - damping) / n + damping * (new + dangling_mass / n)
         new = jnp.where(active, new, 0.0)
         delta = jnp.abs(new - r).sum()
-        return new, delta, it + 1
+        applied = ~done
+        r_out = jnp.where(done, r, new)
+        done = done | (delta <= tol)
+        return (r_out, done), (delta, applied)
 
-    def cond(state):
-        _, delta, it = state
-        return (delta > tol) & (it < max_iter)
-
-    init = (ranks, jnp.array(jnp.inf, ranks.dtype), jnp.int32(0))
-    ranks, delta, iters = jax.lax.while_loop(cond, body, init)
-    return ranks, delta, iters
+    (ranks, _), (deltas, applied) = jax.lax.scan(
+        body, (ranks, jnp.bool_(False)), None, length=max_iter
+    )
+    iters = applied.sum().astype(jnp.int32)
+    last = jnp.maximum(iters - 1, 0)
+    return ranks, deltas[last], iters
 
 
 class IncrementalPageRank:

@@ -1,0 +1,146 @@
+"""Native host-runtime components (C++ via ctypes, no pybind11).
+
+The compute path is JAX/XLA; the runtime AROUND it is native where it
+matters. Today that is file ingest (``ingest.cpp``): parsing large edge
+lists in Python is ~50x slower than the device consumes them.
+
+The shared library builds lazily on first use with ``g++ -O3`` and is
+cached next to the source; every entry point has a pure-numpy fallback so
+the package works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "ingest.cpp")
+_SO = os.path.join(_HERE, "_ingest.so")
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the ingest library; None if unavailable."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            ):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+                    check=True, capture_output=True,
+                )
+                os.replace(_SO + ".tmp", _SO)
+            lib = ctypes.CDLL(_SO)
+            i64 = ctypes.c_int64
+            p64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            pf64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+            pi32 = ctypes.POINTER(ctypes.c_int32)
+            lib.count_edges.restype = i64
+            lib.count_edges.argtypes = [ctypes.c_char_p]
+            lib.parse_edge_file.restype = i64
+            lib.parse_edge_file.argtypes = [ctypes.c_char_p, p64, p64, pf64, i64, pi32]
+            lib.parse_edge_chunk.restype = i64
+            lib.parse_edge_chunk.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(i64), p64, p64, pf64, i64, pi32,
+            ]
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def parse_edge_file(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Parse a whole edge-list file into (src, dst, val|None) columns.
+
+    Third column (value/timestamp/±flag as ±1.0) is returned when present.
+    """
+    lib = _load()
+    if lib is None:
+        return _parse_python(path)
+    n = lib.count_edges(path.encode())
+    if n < 0:
+        raise IOError(f"cannot read {path}")
+    src = np.empty(n, np.int64)
+    dst = np.empty(n, np.int64)
+    val = np.empty(n, np.float64)
+    has_val = ctypes.c_int32(0)
+    got = lib.parse_edge_file(
+        path.encode(), src, dst, val, n, ctypes.byref(has_val)
+    )
+    if got < 0:
+        raise IOError(f"cannot read {path}")
+    src, dst, val = src[:got], dst[:got], val[:got]
+    return src, dst, (val if has_val.value else None)
+
+
+def iter_edge_chunks(
+    path: str, chunk_edges: int = 1 << 20
+) -> Iterator[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+    """Stream (src, dst, val|None) column chunks from a file — the bounded-
+    memory ingest path for streams larger than RAM."""
+    lib = _load()
+    if lib is None:
+        src, dst, val = _parse_python(path)
+        for a in range(0, len(src), chunk_edges):
+            b = a + chunk_edges
+            yield src[a:b], dst[a:b], None if val is None else val[a:b]
+        return
+    offset = ctypes.c_int64(0)
+    src = np.empty(chunk_edges, np.int64)
+    dst = np.empty(chunk_edges, np.int64)
+    val = np.empty(chunk_edges, np.float64)
+    has_val = ctypes.c_int32(0)
+    while True:
+        got = lib.parse_edge_chunk(
+            path.encode(), ctypes.byref(offset), src, dst, val, chunk_edges,
+            ctypes.byref(has_val),
+        )
+        if got < 0:
+            raise IOError(f"cannot read {path}")
+        if got == 0:
+            return
+        yield (
+            src[:got].copy(),
+            dst[:got].copy(),
+            val[:got].copy() if has_val.value else None,
+        )
+
+
+def _parse_python(path: str):
+    """Numpy fallback when no C++ toolchain is available."""
+    srcs, dsts, vals = [], [], []
+    any_val = False
+    with open(path) as f:
+        for line in f:
+            parts = line.replace(",", " ").split()
+            if len(parts) < 2 or parts[0][0] in "#%":
+                continue
+            srcs.append(int(parts[0]))
+            dsts.append(int(parts[1]))
+            if len(parts) > 2:
+                any_val = True
+                t = parts[2]
+                vals.append(1.0 if t == "+" else -1.0 if t == "-" else float(t))
+            else:
+                vals.append(0.0)
+    src = np.asarray(srcs, np.int64)
+    dst = np.asarray(dsts, np.int64)
+    return src, dst, (np.asarray(vals, np.float64) if any_val else None)

@@ -1,0 +1,90 @@
+"""Native ingest tests: C++ parser vs Python fallback equivalence."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import native
+
+
+@pytest.fixture
+def edge_file(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text(
+        "# comment line\n"
+        "1 2 100\n"
+        "3\t4\t2.5\n"
+        "5,6,350\n"
+        "\n"
+        "7 8 +\n"
+        "9 10 -\n"
+        "11 12\n"  # no trailing newline handled below
+        "13 14 -3.5\n"
+    )
+    return str(p)
+
+
+def test_native_builds_and_parses(edge_file):
+    assert native.native_available(), "g++ toolchain expected in this image"
+    src, dst, val = native.parse_edge_file(edge_file)
+    assert src.tolist() == [1, 3, 5, 7, 9, 11, 13]
+    assert dst.tolist() == [2, 4, 6, 8, 10, 12, 14]
+    assert val is not None
+    assert val.tolist() == [100.0, 2.5, 350.0, 1.0, -1.0, 0.0, -3.5]
+
+
+def test_native_matches_python_fallback(edge_file):
+    ns, nd, nv = native.parse_edge_file(edge_file)
+    ps, pd, pv = native._parse_python(edge_file)
+    assert ns.tolist() == ps.tolist()
+    assert nd.tolist() == pd.tolist()
+    assert nv.tolist() == pv.tolist()
+
+
+def test_no_trailing_newline(tmp_path):
+    p = tmp_path / "e.txt"
+    p.write_text("1 2\n3 4")  # unterminated last line
+    src, dst, val = native.parse_edge_file(str(p))
+    assert src.tolist() == [1, 3]
+    assert dst.tolist() == [2, 4]
+    assert val is None
+
+
+def test_chunked_iteration_covers_whole_file(tmp_path):
+    rng = np.random.default_rng(4)
+    n = 5000
+    a = rng.integers(0, 10000, n)
+    b = rng.integers(0, 10000, n)
+    w = rng.uniform(0, 10, n).round(3)
+    p = tmp_path / "big.txt"
+    p.write_text("".join(f"{x} {y} {z}\n" for x, y, z in zip(a, b, w)))
+    chunks = list(native.iter_edge_chunks(str(p), chunk_edges=700))
+    assert len(chunks) >= 7
+    src = np.concatenate([c[0] for c in chunks])
+    dst = np.concatenate([c[1] for c in chunks])
+    val = np.concatenate([c[2] for c in chunks])
+    assert src.tolist() == a.tolist()
+    assert dst.tolist() == b.tolist()
+    np.testing.assert_allclose(val, w)
+
+
+def test_chunked_into_windower_stream(tmp_path):
+    """End to end: file -> native chunks -> Windower array path -> CC."""
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    p = tmp_path / "cc.txt"
+    p.write_text("1 2\n2 3\n6 7\n8 9\n5 6\n")
+    src, dst, _ = native.parse_edge_file(str(p))
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(2))
+    last = None
+    for last in stream.aggregate(ConnectedComponents()):
+        pass
+    assert sorted(last.component_sets()) == sorted(
+        [frozenset({1, 2, 3}), frozenset({5, 6, 7}), frozenset({8, 9})]
+    )
+
+
+def test_missing_file_raises():
+    with pytest.raises(IOError):
+        native.parse_edge_file("/nonexistent/file.txt")
