@@ -1,0 +1,53 @@
+"""Host/device overlap: background block prefetch.
+
+SURVEY.md §7 lists host↔device overlap as where p50 window latency is won:
+while the device computes window N, the host should already be parsing,
+bucketing, and padding window N+1. :func:`prefetch` runs any block (or
+emission) iterator on a daemon thread with a small bounded queue — the
+moral equivalent of Flink's pipelined exchanges between the source and the
+first keyed operator.
+
+Usage::
+
+    stream = SimpleEdgeStream(..., window=CountWindow(1 << 20))
+    for comps in agg.run(stream.prefetched()):   # or prefetch(iterator)
+        ...
+
+Exceptions raised by the producer are re-raised at the consumer's next
+pull, after the already-queued items drain.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_SENTINEL = object()
+
+
+def prefetch(iterator: Iterator[T], depth: int = 2) -> Iterator[T]:
+    """Iterate ``iterator`` on a background thread, ``depth`` items ahead."""
+    q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, depth))
+    error: list = []
+
+    def produce():
+        try:
+            for item in iterator:
+                q.put(item)
+        except BaseException as e:  # re-raised consumer-side
+            error.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            if error:
+                raise error[0]
+            return
+        yield item

@@ -176,6 +176,25 @@ class SimpleEdgeStream(GraphStream):
         """The stream's window-block iterator (single use, like a DataStream)."""
         return self._block_source()
 
+    def prefetched(self, depth: int = 2) -> "SimpleEdgeStream":
+        """Same stream with host windowing overlapped against device compute
+        (a background thread keeps ``depth`` blocks ready — SURVEY.md §7
+        host↔device overlap).
+
+        The shared VertexDict may run up to ``depth`` windows ahead of the
+        consumer; blocks snapshot their own ``n_vertices`` at creation, so
+        consumers sizing state from the block (the aggregation engine, CC,
+        degrees) are unaffected — only code reading ``len(vertex_dict)``
+        mid-stream observes the lead."""
+        from .pipeline import prefetch
+
+        source = self._block_source
+        return SimpleEdgeStream(
+            context=self.context,
+            _blocks=lambda: prefetch(source(), depth),
+            _vdict=self._vdict,
+        )
+
     def _derive(self, block_fn: Callable[[Iterator[EdgeBlock]], Iterator[EdgeBlock]]) -> "SimpleEdgeStream":
         parent_source = self._block_source
         return SimpleEdgeStream(

@@ -1,0 +1,54 @@
+"""Prefetch pipeline tests: equivalence, ordering, error propagation."""
+
+import time
+
+import pytest
+
+from gelly_streaming_tpu.core.pipeline import prefetch
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow
+from gelly_streaming_tpu.library import ConnectedComponents
+
+
+def test_prefetch_preserves_order_and_items():
+    assert list(prefetch(iter(range(100)), depth=3)) == list(range(100))
+
+
+def test_prefetch_propagates_producer_exception():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("boom")
+
+    it = prefetch(gen(), depth=1)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_prefetch_overlaps_producer_and_consumer():
+    timeline = []
+
+    def slow_producer():
+        for i in range(4):
+            time.sleep(0.02)
+            timeline.append(("produced", i, time.perf_counter()))
+            yield i
+
+    for i in prefetch(slow_producer(), depth=2):
+        time.sleep(0.02)
+        timeline.append(("consumed", i, time.perf_counter()))
+    # with overlap, total runtime < strictly-serial 4*(0.02+0.02);
+    # producer of item i+1 finishes before consumer of item i
+    produced = {i: t for kind, i, t in timeline if kind == "produced"}
+    consumed = {i: t for kind, i, t in timeline if kind == "consumed"}
+    assert produced[1] < consumed[0] + 0.015
+
+
+def test_prefetched_stream_matches_plain(sample_edges):
+    plain = SimpleEdgeStream(sample_edges, window=CountWindow(3))
+    pre = SimpleEdgeStream(sample_edges, window=CountWindow(3)).prefetched()
+    a = [str(c) for c in plain.aggregate(ConnectedComponents())]
+    b = [str(c) for c in pre.aggregate(ConnectedComponents())]
+    assert a == b
