@@ -1,6 +1,6 @@
 from .connected_components import ConnectedComponents, ConnectedComponentsTree
 from .bipartiteness import BipartitenessCheck
-from .spanner import Spanner
+from .spanner import DeviceSpanner, Spanner
 from .triangles import ExactTriangleCount, WindowTriangles
 from .degrees import DegreeDistribution
 from .sampling import BroadcastTriangleCount, IncidenceSamplingTriangleCount

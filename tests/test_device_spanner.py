@@ -1,0 +1,95 @@
+"""Device-batched k-spanner tests: validity for any windowing, host
+convergence at window=1."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow
+from gelly_streaming_tpu.library.spanner import DeviceSpanner, Spanner
+
+
+def bfs_dist(edges, a, b, cap):
+    """Host BFS distance over an edge set, capped."""
+    from collections import deque
+
+    adj = {}
+    for u, v in edges:
+        adj.setdefault(u, set()).add(v)
+        adj.setdefault(v, set()).add(u)
+    if a == b:
+        return 0
+    seen = {a}
+    q = deque([(a, 0)])
+    while q:
+        x, dist = q.popleft()
+        if dist >= cap:
+            continue
+        for y in adj.get(x, ()):
+            if y == b:
+                return dist + 1
+            if y not in seen:
+                seen.add(y)
+                q.append((y, dist + 1))
+    return cap + 1
+
+
+def assert_valid_spanner(all_edges, spanner, k):
+    """Every non-spanner edge must have a <=k-hop path in the spanner."""
+    for u, v in all_edges:
+        if u == v:
+            continue
+        e = (min(u, v), max(u, v))
+        if e not in spanner:
+            assert bfs_dist(spanner, e[0], e[1], k) <= k, e
+
+
+@pytest.mark.parametrize("window", [1, 4, 16, 64])
+@pytest.mark.parametrize("k", [2, 3])
+def test_device_spanner_valid_for_any_windowing(window, k):
+    rng = np.random.default_rng(7)
+    raw = [
+        (int(a), int(b), 0.0) for a, b in rng.integers(0, 20, size=(64, 2))
+    ]
+    stream = SimpleEdgeStream(raw, window=CountWindow(window))
+    sp = DeviceSpanner(k=k)
+    last = set()
+    for last in sp.run(stream):
+        pass
+    assert_valid_spanner(
+        [(s, d) for s, d, _ in raw], last, k
+    )
+
+
+def test_device_spanner_window1_matches_host():
+    """With one edge per window the batch degenerates to the sequential
+    fold — identical spanner to the host-exact Spanner."""
+    rng = np.random.default_rng(9)
+    raw = [
+        (int(a), int(b), 0.0)
+        for a, b in rng.integers(0, 15, size=(40, 2))
+        if a != b  # the host flavor keeps reference behavior of admitting
+        # self-loops (boundedBFS never 'finds' src from src); the device
+        # flavor drops them — compare on loop-free input
+    ]
+    k = 3
+    dev = DeviceSpanner(k=k)
+    for out in dev.run(SimpleEdgeStream(raw, window=CountWindow(1))):
+        pass
+    host_stream = SimpleEdgeStream(raw, window=CountWindow(1))
+    host_last = None
+    for host_last in host_stream.aggregate(Spanner(k=k)):
+        pass
+    host_edges = {
+        (min(u, v), max(u, v)) for u, v in host_last.edges()
+    }
+    assert dev.edges() == host_edges
+
+
+def test_device_spanner_drops_redundant_edges():
+    # triangle with k=2: the closing edge is redundant
+    edges = [(1, 2, 0.0), (2, 3, 0.0), (1, 3, 0.0)]
+    sp = DeviceSpanner(k=2)
+    for out in sp.run(SimpleEdgeStream(edges, window=CountWindow(1))):
+        pass
+    assert sp.edges() == {(1, 2), (2, 3)}
