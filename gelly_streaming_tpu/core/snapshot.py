@@ -68,6 +68,18 @@ class SnapshotStream:
     def _raw32(self) -> jax.Array:
         return self._vdict.raw_table()
 
+    def _mesh(self):
+        """The context mesh when it has a >1-wide edge axis, else None.
+        Only the monoid ``reduce_on_edges`` path shards; arrival-order
+        folds and whole-neighborhood applies are per-window single-device
+        (an arbitrary ``fold_fn`` has no cross-shard merge)."""
+        from ..parallel.mesh import EDGE_AXIS
+
+        mesh = getattr(self.context, "mesh", None)
+        if mesh is None or EDGE_AXIS not in mesh.shape or mesh.shape[EDGE_AXIS] == 1:
+            return None
+        return mesh
+
     def _emit(self, result, nonempty, vdict_size_hint: Optional[int] = None):
         """Yield (raw_vertex_id, record) for each nonempty vertex."""
         nonempty_h = np.asarray(nonempty)
@@ -119,13 +131,49 @@ class SnapshotStream:
 
         if isinstance(reduce_fn, str):
             op = reduce_fn
+            mesh = self._mesh()
 
-            @jax.jit
-            def _window(block: EdgeBlock):
-                key, _nbr, val, mask = expand_direction(block, self.direction)
-                out = segment_reduce(val, key, mask, block.n_vertices, op=op)
-                cnt = segment_count(key, mask, block.n_vertices)
-                return out, cnt > 0
+            if mesh is not None:
+                # Distributed snapshot reduce: shard the expanded edge
+                # arrays over the mesh edge axis; each shard scatter-reduces
+                # into a local V-table and one ICI all-reduce merges them —
+                # the keyBy+window funnel as a collective (SURVEY.md §2.6).
+                from jax.sharding import PartitionSpec as P
+
+                from ..parallel import comm
+                from ..parallel.mesh import EDGE_AXIS
+
+                @jax.jit
+                def _window(block: EdgeBlock):
+                    key, _nbr, val, mask = expand_direction(block, self.direction)
+                    V = block.n_vertices
+
+                    def shard_fn(key, val, mask):
+                        out = segment_reduce(val, key, mask, V, op=op)
+                        cnt = segment_count(key, mask, V)
+                        return (
+                            comm.all_reduce(out, EDGE_AXIS, op=op),
+                            comm.all_reduce(cnt, EDGE_AXIS),
+                        )
+
+                    in_specs = (
+                        P(EDGE_AXIS),
+                        jax.tree.map(lambda _: P(EDGE_AXIS), val),
+                        P(EDGE_AXIS),
+                    )
+                    out, cnt = comm.shard_map(
+                        shard_fn, mesh, in_specs=in_specs, out_specs=(P(), P())
+                    )(key, val, mask)
+                    return out, cnt > 0
+
+            else:
+
+                @jax.jit
+                def _window(block: EdgeBlock):
+                    key, _nbr, val, mask = expand_direction(block, self.direction)
+                    out = segment_reduce(val, key, mask, block.n_vertices, op=op)
+                    cnt = segment_count(key, mask, block.n_vertices)
+                    return out, cnt > 0
 
         else:
 

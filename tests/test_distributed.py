@@ -100,3 +100,26 @@ def test_window_order_independence_of_final_cc():
             pass
         finals.append(str(last))
     assert len(set(finals)) == 1
+
+
+@pytest.mark.parametrize("op", ["sum", "min", "max"])
+def test_snapshot_reduce_on_edges_sharded_matches_local(op):
+    """slice().reduce_on_edges over an 8-shard mesh == single-device."""
+    from gelly_streaming_tpu.core.types import EdgeDirection
+
+    rng = np.random.default_rng(8)
+    edges = [
+        (int(a), int(b), float(w))
+        for (a, b), w in zip(
+            rng.integers(0, 12, size=(48, 2)), rng.uniform(1, 9, 48).round(2)
+        )
+    ]
+    local = SimpleEdgeStream(edges, window=CountWindow(16))
+    ctx = StreamContext(mesh=make_mesh(8))
+    sharded = SimpleEdgeStream(edges, window=CountWindow(16), context=ctx)
+    a = list(local.slice(direction=EdgeDirection.ALL).reduce_on_edges(op))
+    b = list(sharded.slice(direction=EdgeDirection.ALL).reduce_on_edges(op))
+    assert len(a) == len(b)
+    for (va, ra), (vb, rb) in zip(a, b):
+        assert va == vb
+        assert ra == pytest.approx(rb, rel=1e-6)
