@@ -129,3 +129,27 @@ def restore_aggregation(path: str, aggregation, template: Any = None) -> Optiona
             aggregation._summary = pickle.load(f)
     vd_path = path + ".vdict.npy"
     return load_vertex_dict(path) if os.path.exists(vd_path) else None
+
+
+def save_workload(path: str, workload, vdict: Optional[VertexDict] = None) -> None:
+    """Checkpoint any carried-state workload exposing ``state_dict()``
+    (triangles, PageRank, degree distribution, spanner, samplers, SAGE,
+    matching). The state is a plain dict of numpy arrays / scalars and is
+    pickled — same trust model as the host-aggregation path above."""
+    import pickle
+
+    with open(path + ".workload.pkl", "wb") as f:
+        pickle.dump(workload.state_dict(), f)
+    if vdict is not None:
+        save_vertex_dict(path, vdict)
+
+
+def restore_workload(path: str, workload) -> Optional[VertexDict]:
+    """Restore a :func:`save_workload` checkpoint into ``workload``.
+    Returns the restored VertexDict when one was saved alongside."""
+    import pickle
+
+    with open(path + ".workload.pkl", "rb") as f:
+        workload.load_state_dict(pickle.load(f))
+    vd_path = path + ".vdict.npy"
+    return load_vertex_dict(path) if os.path.exists(vd_path) else None

@@ -166,6 +166,18 @@ class EdgeAccumulator:
     def mask(self) -> jax.Array:
         return jnp.arange(self.src.shape[0]) < self.n_edges
 
+    def state_dict(self) -> dict:
+        return {
+            "src": np.asarray(self.src)[: self.n_edges],
+            "dst": np.asarray(self.dst)[: self.n_edges],
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self.src = jnp.zeros(0, jnp.int32)
+        self.dst = jnp.zeros(0, jnp.int32)
+        self.n_edges = 0
+        self.append(d["src"], d["dst"])
+
 
 def concat_blocks(blocks: Sequence[EdgeBlock], capacity: Optional[int] = None) -> EdgeBlock:
     """Concatenate blocks into one (host-side; used by window re-bucketing).

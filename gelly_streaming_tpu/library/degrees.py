@@ -68,14 +68,18 @@ class DegreeDistribution:
     change-only list of ``(degree, count)`` histogram entries.
     """
 
-    def __init__(self, window: Optional[WindowPolicy] = None):
+    def __init__(self, window: Optional[WindowPolicy] = None, vertex_dict=None):
         self.window = window or CountWindow(1 << 16)
+        # the windower (and its VertexDict) persists across run() calls so
+        # a resumed stream keeps the same compact-id space as the carried
+        # degree vector
+        self._windower = Windower(self.window, vertex_dict, val_dtype=np.int32)
         self._deg = None  # device int32[vcap]
         self._hist = None  # device int32[hcap]; index = degree, [0] unused
         self._max_deg = 0
 
     def run(self, events: Iterable[Tuple]) -> Iterator[List[Tuple[int, int]]]:
-        windower = Windower(self.window, val_dtype=np.int32)
+        windower = self._windower
         rows = ((s, d, _delta(c), *rest) for s, d, c, *rest in events)
         for block in windower.blocks(rows):
             vcap = block.n_vertices
@@ -97,9 +101,14 @@ class DegreeDistribution:
                     [self._hist,
                      jnp.zeros(hcap - self._hist.shape[0], jnp.int32)]
                 )
-            verts = jnp.concatenate([block.src, block.dst])
-            deltas = jnp.concatenate([block.val, block.val])
-            mask = jnp.concatenate([block.mask, block.mask])
+            # interleave [s0, d0, s1, d1, ...] — the reference emits
+            # (src, ±1) then (dst, ±1) PER EVENT (``DegreeDistribution.
+            # java:73-77``), and per-vertex clamp order matters when a
+            # degree crosses zero; a plain [all srcs, all dsts] concat
+            # would reorder a vertex's src-role vs dst-role updates
+            verts = jnp.stack([block.src, block.dst], axis=1).ravel()
+            deltas = jnp.stack([block.val, block.val], axis=1).ravel()
+            mask = jnp.stack([block.mask, block.mask], axis=1).ravel()
             old_hist = self._hist
             self._deg, self._hist = _degree_step(
                 self._deg, self._hist, verts, deltas, mask, vcap
@@ -110,6 +119,30 @@ class DegreeDistribution:
             )[0]
             new_hist = np.asarray(self._hist)
             yield [(int(d), int(new_hist[d])) for d in changed]
+
+    def state_dict(self) -> dict:
+        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``);
+        self-contained: includes the vertex dictionary so the compact-id
+        space survives the resume."""
+        return {
+            "deg": None if self._deg is None else np.asarray(self._deg),
+            "hist": None if self._hist is None else np.asarray(self._hist),
+            "max_deg": self._max_deg,
+            "vdict_raw": self._windower.vertex_dict.raw_ids(),
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self._deg = None if d["deg"] is None else jnp.asarray(d["deg"])
+        self._hist = None if d["hist"] is None else jnp.asarray(d["hist"])
+        self._max_deg = int(d["max_deg"])
+        vd = self._windower.vertex_dict
+        if len(vd) == 0:
+            vd.encode(d["vdict_raw"])
+        elif vd.raw_ids().tolist() != d["vdict_raw"].tolist():
+            raise ValueError(
+                "restoring into a DegreeDistribution whose vertex dictionary "
+                "already diverged from the checkpoint"
+            )
 
     def histogram(self) -> dict:
         """Current (degree -> count) map, degree >= 1 entries only."""

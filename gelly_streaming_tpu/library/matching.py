@@ -64,6 +64,13 @@ class CentralizedWeightedMatching:
                 self._by_vertex[d] = edge
                 yield MatchingEvent(MatchingEventType.ADD, edge)
 
+    def state_dict(self) -> dict:
+        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``)."""
+        return {"by_vertex": dict(self._by_vertex)}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._by_vertex = dict(d["by_vertex"])
+
     def matching(self) -> set:
         """The current matched edge set."""
         return {e for e in self._by_vertex.values()}

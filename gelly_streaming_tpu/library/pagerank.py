@@ -136,6 +136,18 @@ class IncrementalPageRank:
             )
             yield PageRankEmission(w, len(self._vdict), int(iters), float(delta))
 
+    def state_dict(self) -> dict:
+        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``).
+        The vertex dictionary is saved alongside by ``save_workload``."""
+        return {
+            "edges": self._edges.state_dict(),
+            "ranks": None if self._ranks is None else np.asarray(self._ranks),
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self._edges.load_state_dict(d["edges"])
+        self._ranks = None if d["ranks"] is None else jnp.asarray(d["ranks"])
+
     def ranks(self) -> dict:
         """Current (raw vertex id -> rank), seen vertices only."""
         if self._ranks is None:

@@ -126,6 +126,23 @@ class BroadcastTriangleCount:
         self._edge_count = jnp.int32(0)
         self._previous = 0  # the reference never emits the initial 0
 
+    def state_dict(self) -> dict:
+        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``)."""
+        import jax as _jax
+
+        return {
+            "state": _jax.tree.map(np.asarray, self._state),
+            "edge_count": int(self._edge_count),
+            "key": np.asarray(self._key),
+            "previous": self._previous,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self._state = jax.tree.map(jnp.asarray, d["state"])
+        self._edge_count = jnp.int32(d["edge_count"])
+        self._key = jnp.asarray(d["key"])
+        self._previous = d["previous"]
+
     def run(self, edges: Iterable[Tuple]) -> Iterator[Tuple[int, int]]:
         windower = Windower(self.window)
         for block in windower.blocks(edges):

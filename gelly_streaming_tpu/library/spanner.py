@@ -152,6 +152,13 @@ class DeviceSpanner:
             self._sv = np.concatenate([self._sv, *keep_v])
             yield self.edges()
 
+    def state_dict(self) -> dict:
+        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``)."""
+        return {"su": self._su, "sv": self._sv}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._su, self._sv = d["su"], d["sv"]
+
     def edges(self) -> Set[Tuple[int, int]]:
         """Current spanner edges as raw-id pairs."""
         if self._vdict is None or len(self._su) == 0:

@@ -143,6 +143,21 @@ class ExactTriangleCount:
             new_u, new_v = self._dedup_new(s, d)
             yield self._process(new_u, new_v, vcap, vdict)
 
+    def state_dict(self) -> dict:
+        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``)."""
+        return {
+            "u": self._u, "v": self._v, "seen_keys": self._seen_keys,
+            "deg": self._deg,
+            "counts": None if self._counts is None else np.asarray(self._counts),
+            "total": self._total,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self._u, self._v = d["u"], d["v"]
+        self._seen_keys, self._deg = d["seen_keys"], d["deg"]
+        self._counts = None if d["counts"] is None else jnp.asarray(d["counts"])
+        self._total = int(d["total"])
+
     # ------------------------------------------------------------------ #
     def _dedup_new(self, s: np.ndarray, d: np.ndarray):
         """Canonicalize, drop self-loops and edges seen before (order kept)."""

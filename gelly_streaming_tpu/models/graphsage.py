@@ -168,6 +168,21 @@ class StreamingGraphSAGE:
             )
             yield out[:n]
 
+    def state_dict(self) -> dict:
+        """Checkpoint surface for the carried graph + features (params are
+        user-owned and checkpointed separately, e.g. via save_pytree)."""
+        return {
+            "edges": self._edges.state_dict(),
+            "h": None if self._h is None else np.asarray(self._h),
+            "n_seen": self._n_seen,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self._edges.load_state_dict(d["edges"])
+        dtype = self.params[0]["w_self"].dtype
+        self._h = None if d["h"] is None else jnp.asarray(d["h"], dtype)
+        self._n_seen = int(d["n_seen"])
+
     def _extend_features(self, vdict, n: int, vcap: int, features, dtype) -> None:
         """Fill feature rows for vertices first seen this window only."""
         if self._h is None:

@@ -106,3 +106,30 @@ def test_large_random_event_stream_matches_simulator():
     dd = DegreeDistribution(CountWindow(37))
     list(dd.run(events))
     assert dd.histogram() == ref_hist
+
+
+def test_src_dst_role_order_within_window():
+    """A vertex hit as dst of one event and src of a later event in the
+    SAME window must fold in event order (regression: concat-by-role
+    reordered them and diverged at the clamp-at-zero boundary)."""
+    # v=5: dst of a "-" (ignored at deg 0), then src of a "+" -> deg 1
+    events = [(9, 5, "-"), (5, 7, "+")]
+    for wsize in (1, 2):
+        dd = DegreeDistribution(CountWindow(wsize))
+        list(dd.run(events))
+        _, ref_hist = reference_simulator(events)
+        assert dd.histogram() == ref_hist, wsize
+
+    # adversarial random mix with many zero crossings, several windowings
+    rng = np.random.default_rng(21)
+    ev = [
+        (int(a), int(b), "+" if k else "-")
+        for (a, b), k in zip(
+            rng.integers(0, 6, size=(300, 2)), rng.random(300) < 0.5
+        )
+    ]
+    _, ref_hist = reference_simulator(ev)
+    for wsize in (2, 5, 23, 300):
+        dd = DegreeDistribution(CountWindow(wsize))
+        list(dd.run(ev))
+        assert dd.histogram() == ref_hist, wsize
