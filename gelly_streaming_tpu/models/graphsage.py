@@ -59,9 +59,24 @@ def mean_aggregate(h, src, dst, mask, num_vertices: int):
     return agg / jnp.maximum(cnt, 1.0)[:, None]
 
 
-def sage_layer(params, h, src, dst, mask, *, activation=jax.nn.relu):
-    """One GraphSAGE layer: act(h @ W_self + mean_nbr(h) @ W_nbr + b)."""
+def sage_layer(
+    params, h, src, dst, mask, *, activation=jax.nn.relu, use_pallas=False
+):
+    """One GraphSAGE layer: act(h @ W_self + mean_nbr(h) @ W_nbr + b).
+
+    ``use_pallas=True`` routes the dense dual-matmul through the fused
+    Pallas kernel (``ops/pallas_kernels.py``) — relu activation only;
+    aggregation stays on the XLA scatter path either way.
+    """
     agg = mean_aggregate(h, src, dst, mask, h.shape[0])
+    if use_pallas:
+        from ..ops.pallas_kernels import fused_sage_matmul, pallas_available
+
+        return fused_sage_matmul(
+            h, agg, params["w_self"], params["w_nbr"], params["b"],
+            activation="relu" if activation is jax.nn.relu else "none",
+            interpret=not pallas_available(),
+        )
     out = (
         jnp.dot(h, params["w_self"], preferred_element_type=jnp.float32)
         + jnp.dot(agg, params["w_nbr"], preferred_element_type=jnp.float32)
