@@ -72,11 +72,17 @@ def sage_layer(
     if use_pallas:
         from ..ops.pallas_kernels import fused_sage_matmul, pallas_available
 
-        return fused_sage_matmul(
-            h, agg, params["w_self"], params["w_nbr"], params["b"],
-            activation="relu" if activation is jax.nn.relu else "none",
-            interpret=not pallas_available(),
-        )
+        if activation is not jax.nn.relu:
+            raise ValueError(
+                "use_pallas=True supports only the default relu activation"
+            )
+        if pallas_available():
+            return fused_sage_matmul(
+                h, agg, params["w_self"], params["w_nbr"], params["b"],
+                activation="relu",
+            )
+        # off-TPU: the XLA dense path below is the fast fallback
+        # (interpret mode is a test-only emulator)
     out = (
         jnp.dot(h, params["w_self"], preferred_element_type=jnp.float32)
         + jnp.dot(agg, params["w_nbr"], preferred_element_type=jnp.float32)

@@ -19,11 +19,9 @@ test suite covers them.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 def _pad_to(x: jax.Array, mult0: int, mult1: int) -> jax.Array:
@@ -55,8 +53,12 @@ def fused_sage_matmul(
     the MXU-native recipe). Returns [V, O] in ``h.dtype``.
     """
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
+    if activation not in ("relu", "none"):
+        raise ValueError(
+            f"fused_sage_matmul supports activation 'relu' or 'none', "
+            f"got {activation!r}"
+        )
     V, F = h.shape
     O = w_self.shape[1]
     dtype = h.dtype
