@@ -1,2 +1,3 @@
 from .mesh import EDGE_AXIS, MODEL_AXIS, edge_sharding, make_mesh, replicated
 from . import comm
+from . import multihost

@@ -1,0 +1,80 @@
+"""Multi-host (multi-slice / DCN) execution support.
+
+The reference scales out through Flink's cluster runtime: one JobManager,
+N TaskManagers, Netty shuffles between hosts (SURVEY.md §2.6). The JAX
+equivalent is multi-controller SPMD: every host runs this same program,
+``jax.distributed.initialize`` wires them into one runtime, and a global
+``Mesh`` spans all hosts' devices — collectives ride ICI within a slice
+and DCN across slices, placed by XLA from the same ``shard_map`` programs
+used single-host (nothing else in the framework changes).
+
+Ingest contract (the keyBy analog across hosts): every host windows ITS
+OWN shard of the edge stream with a deterministic VertexDict — compaction
+is deterministic given identical id streams, so hosts must either (a)
+share the raw->compact mapping by exchanging dictionaries per window, or
+(b) pre-partition the raw id space (e.g. ``hash(v) % n_hosts``) and use
+:func:`global_edge_block` to assemble the global sharded arrays from
+per-host blocks. This module provides the wiring; the windowing/kernel
+stack is host-count agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join this process into the multi-controller runtime.
+
+    Thin wrapper over ``jax.distributed.initialize`` (args auto-detected
+    on TPU pods, explicit elsewhere). Call once per process, before any
+    device computation; afterwards ``jax.devices()`` spans all hosts and
+    :func:`gelly_streaming_tpu.parallel.mesh.make_mesh` builds a global
+    mesh.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def global_edge_block(mesh, local_arrays: Sequence[np.ndarray]):
+    """Assemble globally-sharded device arrays from per-host numpy columns.
+
+    Each host passes the columns of ITS edge shard (e.g. src, dst, val,
+    mask of the local window); the result is a tuple of global
+    ``jax.Array``s sharded over the mesh ``"edges"`` axis whose global
+    shape concatenates all hosts' rows — the input contract of the
+    sharded aggregation/snapshot paths. All hosts must pass equal-length
+    columns (pad to the window capacity as usual).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import EDGE_AXIS
+
+    sharding = NamedSharding(mesh, P(EDGE_AXIS))
+    out = []
+    for col in local_arrays:
+        col = np.asarray(col)
+        global_shape = (col.shape[0] * jax.process_count(), *col.shape[1:])
+        out.append(
+            jax.make_array_from_process_local_data(sharding, col, global_shape)
+        )
+    return tuple(out)
+
+
+def is_coordinator() -> bool:
+    """True on the process that should own singleton side effects
+    (emission files, checkpoint writes) — the JobManager analog."""
+    return jax.process_index() == 0

@@ -123,3 +123,22 @@ def test_snapshot_reduce_on_edges_sharded_matches_local(op):
     for (va, ra), (vb, rb) in zip(a, b):
         assert va == vb
         assert ra == pytest.approx(rb, rel=1e-6)
+
+
+def test_multihost_helpers_single_process():
+    """Single-process behavior of the multi-host wiring: global arrays from
+    process-local columns and coordinator identity (true multi-host needs a
+    pod; the mesh/collective programs themselves are host-count agnostic)."""
+    from gelly_streaming_tpu.parallel import multihost
+
+    assert multihost.is_coordinator()
+    mesh = make_mesh(8)
+    src = np.arange(16, dtype=np.int32)
+    val = np.linspace(0, 1, 16, dtype=np.float32)
+    gsrc, gval = multihost.global_edge_block(mesh, [src, val])
+    assert gsrc.shape == (16,) and gval.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(gsrc), src)
+    import jax
+    from gelly_streaming_tpu.parallel.mesh import EDGE_AXIS
+
+    assert gsrc.sharding.spec == jax.sharding.PartitionSpec(EDGE_AXIS)
