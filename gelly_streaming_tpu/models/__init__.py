@@ -6,3 +6,4 @@ from .graphsage import (
     sage_forward,
     sage_layer,
 )
+from .gcn import gcn_forward, gcn_layer, init_gcn

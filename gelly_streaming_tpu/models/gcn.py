@@ -1,0 +1,60 @@
+"""Streaming GCN layers (Kipf-Welling symmetric normalization).
+
+Second GNN family next to GraphSAGE (``models/graphsage.py``): the layer is
+``act(D^-1/2 (A+I) D^-1/2 H W + b)`` computed per window over the
+accumulated edge list with the same segment-sum message passing (P2) and
+one MXU matmul; normalization uses the current degree vector, so
+embeddings track the stream. Shares the GraphSAGE plumbing conventions:
+plain-pytree params, bf16-in/f32-accumulate matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+
+def init_gcn(key, dims: List[int], dtype=jnp.bfloat16) -> List[Dict[str, jax.Array]]:
+    """Glorot-initialized stack of GCN layers; ``dims = [in, h1, ..., out]``."""
+    params = []
+    for fi, fo in zip(dims[:-1], dims[1:]):
+        key, k1 = jax.random.split(key)
+        scale = jnp.sqrt(2.0 / (fi + fo)).astype(jnp.float32)
+        params.append(
+            {
+                "w": (jax.random.normal(k1, (fi, fo)) * scale).astype(dtype),
+                "b": jnp.zeros((fo,), dtype),
+            }
+        )
+    return params
+
+
+def gcn_layer(params, h, src, dst, mask, *, activation=jax.nn.relu):
+    """One GCN layer over the (undirected-as-given + self-loop) edge set."""
+    V = h.shape[0]
+    m = mask.astype(h.dtype)
+    # degrees with self-loops (the +I term)
+    deg = jnp.ones(V, h.dtype).at[src].add(m).at[dst].add(m)
+    norm = jax.lax.rsqrt(deg)
+    # both directions so A is symmetric, plus the self-loop contribution
+    msg_fwd = h[src] * (norm[src] * m)[:, None]
+    msg_bwd = h[dst] * (norm[dst] * m)[:, None]
+    agg = jnp.zeros_like(h).at[dst].add(msg_fwd).at[src].add(msg_bwd)
+    agg = agg + h * norm[:, None]
+    agg = agg * norm[:, None]
+    out = (
+        jnp.dot(agg, params["w"], preferred_element_type=jnp.float32)
+        + params["b"].astype(jnp.float32)
+    )
+    return activation(out).astype(h.dtype)
+
+
+def gcn_forward(params_stack, h, src, dst, mask):
+    """Full model: all layers, last layer linear."""
+    n = len(params_stack)
+    for i, p in enumerate(params_stack):
+        act = jax.nn.relu if i < n - 1 else (lambda x: x)
+        h = gcn_layer(p, h, src, dst, mask, activation=act)
+    return h
