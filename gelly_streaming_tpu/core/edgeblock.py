@@ -117,8 +117,14 @@ class EdgeBlock:
         """Return (src, dst, val) numpy arrays with padding stripped.
 
         ``val`` may be a pytree of arrays (e.g. after a tuple-valued
-        ``map_edges``); masking is applied leaf-wise.
+        ``map_edges``); masking is applied leaf-wise. Blocks built by the
+        Windower carry their pre-padding host columns (``_host_cache``), so
+        this is free on the ingest path — the device download only happens
+        for blocks produced by device transforms.
         """
+        cache = getattr(self, "_host_cache", None)
+        if cache is not None:
+            return cache
         mask = np.asarray(self.mask)
         val = jax.tree.map(lambda a: np.asarray(a)[mask], self.val)
         return (
@@ -126,6 +132,13 @@ class EdgeBlock:
             np.asarray(self.dst)[mask],
             val,
         )
+
+    def with_host_cache(self, src, dst, val) -> "EdgeBlock":
+        """Attach pre-padding host columns (not part of the pytree: lost
+        across jit/tree operations, which is correct — a transformed block
+        must re-download)."""
+        object.__setattr__(self, "_host_cache", (src, dst, val))
+        return self
 
     def with_vertices(self, n_vertices: int) -> "EdgeBlock":
         return dataclasses.replace(self, n_vertices=int(n_vertices))

@@ -130,9 +130,12 @@ class Windower:
         src = enc[0::2]
         dst = enc[1::2]
         cap = self.capacity if self.capacity is not None else bucket_capacity(n)
-        return EdgeBlock.from_arrays(
+        block = EdgeBlock.from_arrays(
             src, dst, val, n_vertices=self.vertex_dict.capacity, capacity=cap,
             val_dtype=self.val_dtype,
+        )
+        return block.with_host_cache(
+            src.copy(), dst.copy(), np.asarray(val, self.val_dtype)
         )
 
     def blocks(self, edges: Iterable[Tuple]) -> Iterator[EdgeBlock]:

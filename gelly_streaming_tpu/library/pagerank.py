@@ -32,10 +32,14 @@ from ..core.edgeblock import EdgeAccumulator
 
 
 class PageRankEmission(NamedTuple):
+    """Per-window emission. ``iterations``/``l1_delta`` are device scalars
+    (sync on first read) so successive windows pipeline on device instead
+    of blocking per emission; ``int()``/``float()`` them to materialize."""
+
     window: int
     num_vertices: int
-    iterations: int
-    l1_delta: float
+    iterations: "jax.Array"
+    l1_delta: "jax.Array"
 
 
 @functools.partial(jax.jit, static_argnums=(5,), static_argnames=("max_iter",))
@@ -134,7 +138,7 @@ class IncrementalPageRank:
                 tol=self.tol,
                 max_iter=self.max_iter,
             )
-            yield PageRankEmission(w, len(self._vdict), int(iters), float(delta))
+            yield PageRankEmission(w, len(self._vdict), iters, delta)
 
     def state_dict(self) -> dict:
         """Checkpoint surface (``aggregate/checkpoint.py:save_workload``).

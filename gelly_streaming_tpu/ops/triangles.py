@@ -180,6 +180,7 @@ def ranked_triangle_update(
     rank: jax.Array,
     mask: jax.Array,
     counts: jax.Array,
+    edge_chunk: int = 1 << 16,
 ) -> Tuple[jax.Array, jax.Array]:
     """Count the triangles *closed by* a batch of new edges.
 
@@ -190,17 +191,48 @@ def ranked_triangle_update(
     the ``(w,1)/(u,c)/(v,c)`` emissions of
     ``ExactTriangleCount.java:85-106``) and returns ``(counts, delta)``
     where delta is this batch's new-triangle total (the ``(-1, c)`` stream).
+
+    The [E, D] membership intermediates are processed in ``edge_chunk``
+    slices via ``lax.scan`` to bound peak HBM (same pattern as
+    :func:`window_triangle_count`).
     """
-    rows_u = jnp.where(mask[:, None], nbr_ids[u], _BIG)
-    ranks_u = nbr_ranks[u]
-    rows_v = nbr_ids[v]
-    ranks_v = nbr_ranks[v]
-    pos, found = _row_membership(rows_u, rows_v)
-    r = rank[:, None]
-    match = found & (ranks_u < r) & (jnp.take_along_axis(ranks_v, pos, axis=1) < r)
-    c = match.sum(axis=1).astype(jnp.int32)
-    w_ids = jnp.where(match, rows_u, 0)
-    counts = counts.at[w_ids.reshape(-1)].add(match.reshape(-1).astype(jnp.int32))
-    cm = jnp.where(mask, c, 0)
-    counts = counts.at[u].add(cm).at[v].add(cm)
-    return counts, cm.sum().astype(jnp.int32)
+    E = u.shape[0]
+    pad_to = -(-E // edge_chunk) * edge_chunk
+
+    def pad(a, fill=0):
+        return jnp.concatenate(
+            [a, jnp.full(pad_to - E, fill, a.dtype)]
+        ) if pad_to != E else a
+
+    uc = pad(u).reshape(-1, edge_chunk)
+    vc = pad(v).reshape(-1, edge_chunk)
+    rc = pad(rank).reshape(-1, edge_chunk)
+    mc = pad(mask.astype(jnp.int32)).astype(bool).reshape(-1, edge_chunk)
+
+    def chunk_step(carry, x):
+        counts, total = carry
+        u_i, v_i, r_i, m_i = x
+        rows_u = jnp.where(m_i[:, None], nbr_ids[u_i], _BIG)
+        ranks_u = nbr_ranks[u_i]
+        rows_v = nbr_ids[v_i]
+        ranks_v = nbr_ranks[v_i]
+        pos, found = _row_membership(rows_u, rows_v)
+        r = r_i[:, None]
+        match = (
+            found
+            & (ranks_u < r)
+            & (jnp.take_along_axis(ranks_v, pos, axis=1) < r)
+        )
+        c = match.sum(axis=1).astype(jnp.int32)
+        w_ids = jnp.where(match, rows_u, 0)
+        counts = counts.at[w_ids.reshape(-1)].add(
+            match.reshape(-1).astype(jnp.int32)
+        )
+        cm = jnp.where(m_i, c, 0)
+        counts = counts.at[u_i].add(cm).at[v_i].add(cm)
+        return (counts, total + cm.sum().astype(jnp.int32)), None
+
+    (counts, delta), _ = jax.lax.scan(
+        chunk_step, (counts, jnp.int32(0)), (uc, vc, rc, mc)
+    )
+    return counts, delta
