@@ -68,10 +68,7 @@ class SummaryAggregation(abc.ABC):
         self.mesh = mesh
         self._summary = None
         self._vcap = 0
-        self._jit_update = None
-        self._stack_combine = None
-        self._jit_combine = None
-        self._shard_fn = None
+        self._window_step_fn = None
 
     # ------------------------------------------------------------------ #
     # State protocol (the updateFun / combineFun / transform slots)
@@ -114,48 +111,17 @@ class SummaryAggregation(abc.ABC):
             return None
         return mesh
 
-    def _window_partial(self, block: EdgeBlock, vcap: int, mesh) -> Any:
-        """Compute one window's aggregate (the keyBy->fold->reduce pipeline)."""
-        if self._jit_update is None:
-            self._jit_update = jax.jit(
-                lambda st, s, d, v, m: self.update(st, s, d, v, m)
-            )
-            self._jit_combine = jax.jit(self.combine)
-            self._shard_fn = None
+    def _window_step(self, summary: Any, block: EdgeBlock, vcap: int, mesh) -> Any:
+        """One window's full pipeline — per-shard fold, cross-shard combine,
+        Merger merge — as ONE jitted dispatch (the keyBy->fold->reduce->
+        Merger chain). Single-dispatch matters twice: host round trips
+        never interleave the device pipeline, and successive windows
+        overlap via async dispatch."""
+        if self._window_step_fn is None:
+            p = mesh.shape[EDGE_AXIS] if mesh is not None else 1
+            tree = self._is_tree()
 
-        if mesh is None:
-            return self._jit_update(
-                self.initial_state(vcap), block.src, block.dst, block.val, block.mask
-            )
-        p = mesh.shape[EDGE_AXIS]
-        tree = self._is_tree()
-        # Build the shard-mapped callable once and reuse across windows — jax
-        # caches compilations per shape, so same-capacity windows don't
-        # retrace (the whole point of capacity bucketing).
-        if self._shard_fn is None:
-            init = self.initial_state(vcap)
-
-            def shard_fn(src, dst, val, mask):
-                part = self.update(init, src, dst, val, mask)
-                if tree:
-                    return comm.tree_all_reduce(part, EDGE_AXIS, self.combine, p)
-                return jax.tree.map(lambda x: x[None], part)
-
-            in_specs = (P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS))
-            out_specs = jax.tree.map(lambda _: P() if tree else P(EDGE_AXIS), init)
-            self._shard_fn = jax.jit(
-                comm.shard_map(shard_fn, mesh, in_specs, out_specs)
-            )
-        out = self._shard_fn(block.src, block.dst, block.val, block.mask)
-        if tree:
-            return out
-        # bulk: stacked partials [p, ...] -> one jitted log-depth pairwise
-        # reduction (the timeWindowAll gather analog) — a single dispatch
-        # instead of p-1 host round trips
-        if self._stack_combine is None:
-
-            def stacked_reduce(stacked):
-                n = p
+            def stacked_reduce(stacked, n):
                 while n > 1:
                     half = n // 2
                     lo = jax.tree.map(lambda x: x[:half], stacked)
@@ -173,8 +139,37 @@ class SummaryAggregation(abc.ABC):
                         n = half
                 return jax.tree.map(lambda x: x[0], stacked)
 
-            self._stack_combine = jax.jit(stacked_reduce)
-        return self._stack_combine(out)
+            def step(summary, src, dst, val, mask):
+                init = self.initial_state(vcap)
+                if mesh is None:
+                    partial = self.update(init, src, dst, val, mask)
+                else:
+                    def shard_fn(src, dst, val, mask):
+                        part = self.update(init, src, dst, val, mask)
+                        if tree:
+                            return comm.tree_all_reduce(
+                                part, EDGE_AXIS, self.combine, p
+                            )
+                        return jax.tree.map(lambda x: x[None], part)
+
+                    in_specs = (
+                        P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)
+                    )
+                    out_specs = jax.tree.map(
+                        lambda _: P() if tree else P(EDGE_AXIS), init
+                    )
+                    out = comm.shard_map(shard_fn, mesh, in_specs, out_specs)(
+                        src, dst, val, mask
+                    )
+                    # bulk: stacked shard partials -> log-depth reduction
+                    # (the timeWindowAll gather analog)
+                    partial = out if tree else stacked_reduce(out, p)
+                return self.combine(summary, partial)
+
+            self._window_step_fn = jax.jit(step)
+        return self._window_step_fn(
+            summary, block.src, block.dst, block.val, block.mask
+        )
 
     def _is_tree(self) -> bool:
         return False
@@ -193,11 +188,8 @@ class SummaryAggregation(abc.ABC):
                 elif vcap > self._vcap:
                     self._summary = self.grow_state(self._summary, self._vcap, vcap)
                     self._vcap = vcap
-                    self._jit_update = self._jit_combine = None  # shapes changed
-                    self._shard_fn = None
-                    self._stack_combine = None
-                partial = self._window_partial(block, vcap, mesh)
-                self._summary = self._jit_combine(self._summary, partial)
+                    self._window_step_fn = None  # shapes changed
+                self._summary = self._window_step(self._summary, block, vcap, mesh)
             else:
                 src, dst, val = block.to_host()
                 raw_s = vdict.decode(src)
@@ -236,6 +228,7 @@ class SummaryAggregation(abc.ABC):
             self._vcap = vcap
         elif self.device:
             self._vcap = self.infer_vcap(self._summary)
+        self._window_step_fn = None  # closure holds the old vcap
 
 
 class SummaryBulkAggregation(SummaryAggregation):

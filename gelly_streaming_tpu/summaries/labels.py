@@ -27,7 +27,7 @@ contains vertices from processed edges).
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -118,19 +118,38 @@ class Components:
     the reference's test parser reads (``DisjointSet.java:139-153``).
     """
 
-    def __init__(self, components: Dict[int, List[int]]):
-        self.components = components
+    def __init__(self, components: Optional[Dict[int, List[int]]] = None, *,
+                 _lazy=None):
+        self._components = components
+        self._lazy = _lazy  # (labels_dev, touched_dev, n, vdict)
+
+    @property
+    def components(self) -> Dict[int, List[int]]:
+        """Materialized (root -> sorted members) map; device sync + host
+        grouping happen on first access, so un-inspected per-window
+        emissions cost nothing (windows pipeline on device)."""
+        if self._components is None:
+            labels_dev, touched_dev, n, vdict = self._lazy
+            labels = np.asarray(labels_dev)
+            touched = np.asarray(touched_dev)
+            idx = np.nonzero(touched[:n])[0]
+            lab = labels[idx]
+            raw = vdict.decode(idx)
+            order = np.argsort(lab, kind="stable")
+            _, starts = np.unique(lab[order], return_index=True)
+            self._components = {}
+            for members in np.split(raw[order], starts[1:]):
+                ms = members.tolist()
+                self._components[min(ms)] = sorted(ms)
+        return self._components
 
     @staticmethod
     def from_labels(state: Dict[str, jax.Array], vdict) -> "Components":
-        labels = np.asarray(state["labels"])
-        touched = np.asarray(state["touched"])
-        n = len(vdict)
-        groups: Dict[int, List[int]] = {}
-        for c in np.nonzero(touched[:n])[0].tolist():
-            groups.setdefault(int(labels[c]), []).append(int(vdict.decode_one(c)))
+        """Lazy view over the label table: snapshots the dict SIZE now
+        (the dict itself is append-only, so compact ids < n stay stable
+        even if the stream runs ahead) and defers the device sync."""
         return Components(
-            {min(members): sorted(members) for members in groups.values()}
+            _lazy=(state["labels"], state["touched"], len(vdict), vdict)
         )
 
     def num_components(self) -> int:
