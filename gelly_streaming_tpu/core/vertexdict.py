@@ -35,9 +35,16 @@ class VertexDict:
     def __init__(self, min_capacity: int = 8):
         self._idx_to_raw: list[int] = []
         # batch-lookup index: raw ids sorted, with their compact ids aligned
+        # (numpy fallback path; unused when the native encoder loads)
         self._sorted_raw = np.empty(0, np.int64)
         self._sorted_idx = np.empty(0, np.int32)
         self._min_capacity = min_capacity
+        try:
+            from ..native import NativeEncoder
+
+            self._native = NativeEncoder()
+        except Exception:
+            self._native = None
 
     def __len__(self) -> int:
         return len(self._idx_to_raw)
@@ -59,6 +66,11 @@ class VertexDict:
         n = raw.shape[0]
         out = np.empty(n, dtype=np.int32)
         if n == 0:
+            return out
+        if self._native is not None:
+            out, novel = self._native.encode(raw)
+            if novel.size:
+                self._idx_to_raw.extend(novel.tolist())
             return out
         if self._sorted_raw.size:
             pos = np.searchsorted(self._sorted_raw, raw)
@@ -89,6 +101,8 @@ class VertexDict:
 
     def lookup(self, raw: int) -> int | None:
         """Query without inserting; None if unseen."""
+        if self._native is not None:
+            return self._native.lookup(raw)
         pos = int(np.searchsorted(self._sorted_raw, raw))
         if pos < self._sorted_raw.size and self._sorted_raw[pos] == raw:
             return int(self._sorted_idx[pos])

@@ -57,6 +57,15 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.parse_edge_chunk.argtypes = [
                 ctypes.c_char_p, ctypes.POINTER(i64), p64, p64, pf64, i64, pi32,
             ]
+            pi32a = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            lib.encoder_create.restype = ctypes.c_void_p
+            lib.encoder_destroy.argtypes = [ctypes.c_void_p]
+            lib.encoder_encode.restype = i64
+            lib.encoder_encode.argtypes = [ctypes.c_void_p, p64, i64, pi32a, p64]
+            lib.encoder_lookup.restype = ctypes.c_int32
+            lib.encoder_lookup.argtypes = [ctypes.c_void_p, i64]
+            lib.encoder_size.restype = i64
+            lib.encoder_size.argtypes = [ctypes.c_void_p]
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -144,3 +153,40 @@ def _parse_python(path: str):
     src = np.asarray(srcs, np.int64)
     dst = np.asarray(dsts, np.int64)
     return src, dst, (np.asarray(vals, np.float64) if any_val else None)
+
+
+class NativeEncoder:
+    """C++ first-seen id compactor (the ``VertexDict.encode`` hot path).
+
+    ``encode(raw)`` returns ``(idx[i32], novel_raw[i64])`` — compact ids
+    for every input and the never-seen-before raw ids in first-appearance
+    order. Falls back is handled by the caller (``VertexDict`` keeps its
+    numpy path when the toolchain is absent).
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native toolchain unavailable")
+        self._lib = lib
+        self._h = lib.encoder_create()
+
+    def encode(self, raw: np.ndarray):
+        raw = np.ascontiguousarray(raw, np.int64)
+        idx = np.empty(raw.size, np.int32)
+        novel = np.empty(raw.size, np.int64)
+        n_novel = self._lib.encoder_encode(self._h, raw, raw.size, idx, novel)
+        return idx, novel[:n_novel]
+
+    def lookup(self, k: int):
+        v = self._lib.encoder_lookup(self._h, int(k))
+        return None if v < 0 else int(v)
+
+    def __len__(self) -> int:
+        return int(self._lib.encoder_size(self._h))
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.encoder_destroy(h)

@@ -88,3 +88,20 @@ def test_chunked_into_windower_stream(tmp_path):
 def test_missing_file_raises():
     with pytest.raises(IOError):
         native.parse_edge_file("/nonexistent/file.txt")
+
+
+def test_native_encoder_matches_numpy_fallback():
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+
+    rng = np.random.default_rng(13)
+    batches = [rng.integers(0, 500, rng.integers(1, 400)) for _ in range(8)]
+    a = VertexDict()
+    b = VertexDict()
+    b._native = None  # force the numpy path
+    for batch in batches:
+        np.testing.assert_array_equal(a.encode(batch), b.encode(batch))
+    assert a.raw_ids().tolist() == b.raw_ids().tolist()
+    assert len(a) == len(b)
+    probe = int(batches[0][0])
+    assert a.lookup(probe) == b.lookup(probe)
+    assert a.lookup(10**12) is None
