@@ -64,14 +64,14 @@ class VertexDict:
         """
         raw = np.asarray(raw, np.int64).ravel()
         n = raw.shape[0]
-        out = np.empty(n, dtype=np.int32)
         if n == 0:
-            return out
+            return np.empty(0, dtype=np.int32)
         if self._native is not None:
             out, novel = self._native.encode(raw)
             if novel.size:
                 self._idx_to_raw.extend(novel.tolist())
             return out
+        out = np.empty(n, dtype=np.int32)
         if self._sorted_raw.size:
             pos = np.searchsorted(self._sorted_raw, raw)
             pos_c = np.minimum(pos, self._sorted_raw.size - 1)

@@ -170,16 +170,23 @@ class NativeEncoder:
             raise RuntimeError("native toolchain unavailable")
         self._lib = lib
         self._h = lib.encoder_create()
+        # ctypes calls release the GIL; without this lock a prefetch-thread
+        # encode's rehash could free buffers mid-lookup (use-after-free)
+        self._mu = threading.Lock()
 
     def encode(self, raw: np.ndarray):
         raw = np.ascontiguousarray(raw, np.int64)
         idx = np.empty(raw.size, np.int32)
         novel = np.empty(raw.size, np.int64)
-        n_novel = self._lib.encoder_encode(self._h, raw, raw.size, idx, novel)
+        with self._mu:
+            n_novel = self._lib.encoder_encode(
+                self._h, raw, raw.size, idx, novel
+            )
         return idx, novel[:n_novel]
 
     def lookup(self, k: int):
-        v = self._lib.encoder_lookup(self._h, int(k))
+        with self._mu:
+            v = self._lib.encoder_lookup(self._h, int(k))
         return None if v < 0 else int(v)
 
     def __len__(self) -> int:

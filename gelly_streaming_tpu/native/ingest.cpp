@@ -189,6 +189,7 @@ struct Encoder {
     int32_t* vals;
     int64_t cap;      // power of two
     int64_t size;
+    int32_t min_idx;  // slot for the raw id == EMPTY_KEY itself (-1 = unseen)
 };
 
 constexpr int64_t EMPTY_KEY = INT64_MIN;
@@ -220,7 +221,7 @@ extern "C" {
 
 void* encoder_create() {
     Encoder* e = (Encoder*)malloc(sizeof(Encoder));
-    e->cap = 1024; e->size = 0;
+    e->cap = 1024; e->size = 0; e->min_idx = -1;
     e->keys = (int64_t*)malloc(e->cap * sizeof(int64_t));
     e->vals = (int32_t*)malloc(e->cap * sizeof(int32_t));
     for (int64_t i = 0; i < e->cap; ++i) e->keys[i] = EMPTY_KEY;
@@ -242,6 +243,15 @@ int64_t encoder_encode(void* ptr, const int64_t* raw, int64_t n,
     for (int64_t i = 0; i < n; ++i) {
         if ((e->size + 1) * 10 >= e->cap * 7) encoder_rehash(e, e->cap * 2);
         int64_t k = raw[i];
+        if (k == EMPTY_KEY) {  // the sentinel value is a legal raw id
+            if (e->min_idx < 0) {
+                e->min_idx = (int32_t)e->size;
+                novel_out[n_novel++] = k;
+                e->size++;
+            }
+            idx_out[i] = e->min_idx;
+            continue;
+        }
         uint64_t h = mix_hash((uint64_t)k) & (e->cap - 1);
         while (true) {
             if (e->keys[h] == k) { idx_out[i] = e->vals[h]; break; }
@@ -262,6 +272,7 @@ int64_t encoder_encode(void* ptr, const int64_t* raw, int64_t n,
 // Lookup without insert; returns -1 when unseen.
 int32_t encoder_lookup(void* ptr, int64_t k) {
     Encoder* e = (Encoder*)ptr;
+    if (k == EMPTY_KEY) return e->min_idx;
     uint64_t h = mix_hash((uint64_t)k) & (e->cap - 1);
     while (true) {
         if (e->keys[h] == k) return e->vals[h];

@@ -96,6 +96,7 @@ def test_native_encoder_matches_numpy_fallback():
     rng = np.random.default_rng(13)
     batches = [rng.integers(0, 500, rng.integers(1, 400)) for _ in range(8)]
     a = VertexDict()
+    assert a._native is not None, "native encoder must load in this image"
     b = VertexDict()
     b._native = None  # force the numpy path
     for batch in batches:
@@ -105,3 +106,9 @@ def test_native_encoder_matches_numpy_fallback():
     probe = int(batches[0][0])
     assert a.lookup(probe) == b.lookup(probe)
     assert a.lookup(10**12) is None
+    # the C++ map's empty-slot sentinel value is a legal raw id
+    minv = np.iinfo(np.int64).min
+    batch = np.array([minv, 7, minv], np.int64)
+    np.testing.assert_array_equal(a.encode(batch), b.encode(batch))
+    assert a.lookup(minv) == b.lookup(minv)
+    assert a.raw_ids().tolist() == b.raw_ids().tolist()
