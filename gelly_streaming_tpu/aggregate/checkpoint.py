@@ -25,22 +25,35 @@ import numpy as np
 from ..core.vertexdict import VertexDict
 
 
+def _keypaths(tree: Any) -> list:
+    """Version-stable structural encoding: one path string per leaf.
+
+    ``jax.tree_util.keystr`` output (dict keys, attribute names, indices) is
+    part of the public API and stable across JAX versions, unlike
+    ``str(treedef)`` whose repr has changed between releases."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [jax.tree_util.keystr(path) for path, _ in flat]
+
+
 def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     """Write a pytree of arrays to ``path.npz`` + ``path.json``."""
     leaves, treedef = jax.tree.flatten(tree)
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     np.savez(path + ".npz", **arrays)
     with open(path + ".json", "w") as f:
-        json.dump({"treedef": str(treedef), "n_leaves": len(leaves),
-                   "meta": meta or {}}, f)
+        json.dump({"treedef": str(treedef), "keypaths": _keypaths(tree),
+                   "n_leaves": len(leaves), "meta": meta or {}}, f)
 
 
 def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:
     """Read arrays back into the structure of ``like`` (same treedef).
 
-    Returns (tree, meta). Rejects a checkpoint whose stored treedef, leaf
-    count, or leaf shapes disagree with ``like`` — restoring one summary kind
-    into another must fail at load time, not corrupt state silently.
+    Returns (tree, meta). Rejects a checkpoint whose stored structure (leaf
+    key paths), leaf count, leaf shapes, or leaf dtype kinds disagree with
+    ``like`` — restoring one summary kind into another must fail at load
+    time, not corrupt state silently. Structure is compared via leaf key
+    paths (stable across JAX versions), not ``str(treedef)`` (which is not);
+    for pre-keypath checkpoints the treedef string downgrades to a warning.
     """
     with open(path + ".json") as f:
         info = json.load(f)
@@ -52,16 +65,35 @@ def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:
             f"checkpoint has {len(leaves)} leaves but template has "
             f"{treedef.num_leaves}"
         )
-    if info.get("treedef") and info["treedef"] != str(treedef):
-        raise ValueError(
-            f"checkpoint treedef {info['treedef']} does not match template "
-            f"treedef {treedef}"
+    if info.get("keypaths") is not None:
+        want_paths = _keypaths(like)
+        if info["keypaths"] != want_paths:
+            raise ValueError(
+                f"checkpoint structure {info['keypaths']} does not match "
+                f"template structure {want_paths}"
+            )
+    elif info.get("treedef") and info["treedef"] != str(treedef):
+        # Old checkpoint without keypaths: the treedef repr is not stable
+        # across JAX versions, so only warn; leaf count/shape/dtype checks
+        # below remain the load-bearing validation.
+        import warnings
+
+        warnings.warn(
+            f"checkpoint treedef string {info['treedef']!r} differs from "
+            f"template {str(treedef)!r}; proceeding on matching leaf "
+            "count/shapes (repr may differ across JAX versions)"
         )
     for i, (stored, want) in enumerate(zip(leaves, like_leaves)):
         if np.shape(want) != stored.shape:
             raise ValueError(
                 f"checkpoint leaf {i} has shape {stored.shape} but template "
                 f"expects {np.shape(want)}"
+            )
+        want_kind = np.asarray(want).dtype.kind
+        if stored.dtype.kind != want_kind:
+            raise ValueError(
+                f"checkpoint leaf {i} has dtype {stored.dtype} but template "
+                f"expects kind {want_kind!r}"
             )
     return jax.tree.unflatten(treedef, leaves), info.get("meta", {})
 

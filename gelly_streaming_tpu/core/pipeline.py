@@ -29,25 +29,53 @@ _SENTINEL = object()
 
 
 def prefetch(iterator: Iterator[T], depth: int = 2) -> Iterator[T]:
-    """Iterate ``iterator`` on a background thread, ``depth`` items ahead."""
+    """Iterate ``iterator`` on a background thread, ``depth`` items ahead.
+
+    If the consumer abandons the generator early (break / exception /
+    garbage collection), the producer thread notices via a stop flag and
+    exits instead of blocking forever on the bounded queue; the source
+    iterator is closed so file handles are released.
+    """
     q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, depth))
     error: list = []
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up once the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def produce():
         try:
             for item in iterator:
-                q.put(item)
+                if not _put(item):
+                    break
         except BaseException as e:  # re-raised consumer-side
             error.append(e)
         finally:
-            q.put(_SENTINEL)
+            if stop.is_set():
+                close = getattr(iterator, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+            _put(_SENTINEL)
 
     t = threading.Thread(target=produce, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _SENTINEL:
-            if error:
-                raise error[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if error:
+                    raise error[0]
+                return
+            yield item
+    finally:
+        stop.set()

@@ -73,6 +73,13 @@ class EventTimeWindow(WindowPolicy):
 
     ``timestamp_fn(edge) -> number`` extracts the (ascending) event time, the
     analog of the reference's ``AscendingTimestampExtractor`` ctor path.
+
+    Column contract on the array fast path: array input is ``[N, 2|3]``
+    (src, dst[, third]) or a (src, dst[, val][, ts]) column tuple, and
+    ``timestamp_fn`` is applied to the column tuple itself — an index-based
+    extractor like ``lambda e: e[2]`` therefore selects the same column it
+    would select per-record, vectorized for free. A non-indexing fn must be
+    numpy-broadcastable or the windower raises.
     """
 
     size: float
@@ -212,24 +219,44 @@ class Windower:
         throughput fix for large streams.
         """
         if isinstance(edges, np.ndarray):
-            if edges.ndim != 2 or edges.shape[1] < 2:
+            if edges.ndim != 2 or not 2 <= edges.shape[1] <= 3:
                 raise ValueError("edge array must be [N, 2] or [N, 3]")
-            src = edges[:, 0].astype(np.int64)
-            dst = edges[:, 1].astype(np.int64)
-            val = (
-                edges[:, 2].astype(self.val_dtype)
-                if edges.shape[1] > 2
-                else None
-            )
-            ts = edges[:, 2] if edges.shape[1] > 2 else None
+            cols = [edges[:, i] for i in range(edges.shape[1])]
         else:
             cols = [np.asarray(c) for c in edges]
-            src = cols[0].astype(np.int64)
-            dst = cols[1].astype(np.int64)
-            val = cols[2].astype(self.val_dtype) if len(cols) > 2 else None
-            ts = cols[3] if len(cols) > 3 else (cols[2] if len(cols) > 2 else None)
+        src = cols[0].astype(np.int64)
+        dst = cols[1].astype(np.int64)
+        val = cols[2].astype(self.val_dtype) if len(cols) > 2 else None
         n = src.shape[0]
         policy = self.policy
+        ts = None
+        if isinstance(policy, EventTimeWindow):
+            # Same contract as the record path: the caller must say which
+            # column is the event time — never silently read the value
+            # column as a timestamp.
+            if policy.timestamp_fn is None:
+                raise ValueError(
+                    "EventTimeWindow requires timestamp_fn — without it the "
+                    "edge value would silently be read as the event time"
+                )
+            # Apply the extractor to the column tuple: an index-based fn
+            # (lambda e: e[k]) picks the same column it picks per-record,
+            # vectorized. Anything non-broadcastable errors here rather
+            # than silently windowing on the wrong column.
+            try:
+                ts = np.asarray(policy.timestamp_fn(tuple(cols)), np.float64)
+            except Exception as e:
+                raise ValueError(
+                    "EventTimeWindow.timestamp_fn could not be applied to "
+                    "the column tuple on the array ingest path; use an "
+                    "index-based extractor (lambda e: e[k]) or a numpy-"
+                    f"broadcastable fn ({e})"
+                ) from e
+            if ts.shape != (n,):
+                raise ValueError(
+                    "EventTimeWindow.timestamp_fn returned shape "
+                    f"{ts.shape} on the array path; expected ({n},)"
+                )
         if isinstance(policy, CountWindow):
             index = 0
             for start in range(0, n, policy.size):
@@ -240,10 +267,6 @@ class Windower:
                 )
                 index += 1
         elif isinstance(policy, EventTimeWindow):
-            if ts is None:
-                raise ValueError(
-                    "event-time windowing over arrays needs a timestamp column"
-                )
             slots = (np.asarray(ts, np.float64) // policy.size).astype(np.int64)
             # ascending timestamps: window boundaries are runs of equal slot
             bounds = np.nonzero(np.diff(slots))[0] + 1

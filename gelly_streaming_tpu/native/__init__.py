@@ -12,6 +12,7 @@ the package works without a toolchain.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -27,6 +28,21 @@ _lib = None
 _lib_failed = False
 
 
+def _stale(digest: str) -> bool:
+    """The build is stale unless the .so's hash sidecar matches the source.
+
+    Content hash, not mtime: a checkout or copy can leave any mtime order,
+    and a binary silently out of sync with its source is worse than a
+    rebuild."""
+    if not os.path.exists(_SO):
+        return True
+    try:
+        with open(_SO + ".hash") as f:
+            return f.read().strip() != digest
+    except OSError:
+        return True
+
+
 def _load() -> Optional[ctypes.CDLL]:
     """Compile (once) and load the ingest library; None if unavailable."""
     global _lib, _lib_failed
@@ -36,14 +52,16 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            ):
+            with open(_SRC, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()
+            if _stale(digest):
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
                     check=True, capture_output=True,
                 )
                 os.replace(_SO + ".tmp", _SO)
+                with open(_SO + ".hash", "w") as f:
+                    f.write(digest)
             lib = ctypes.CDLL(_SO)
             i64 = ctypes.c_int64
             p64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -55,7 +73,8 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.parse_edge_file.argtypes = [ctypes.c_char_p, p64, p64, pf64, i64, pi32]
             lib.parse_edge_chunk.restype = i64
             lib.parse_edge_chunk.argtypes = [
-                ctypes.c_char_p, ctypes.POINTER(i64), p64, p64, pf64, i64, pi32,
+                ctypes.c_char_p, ctypes.POINTER(i64), p64, p64, pf64, i64,
+                pi32, pi32,
             ]
             pi32a = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
             lib.encoder_create.restype = ctypes.c_void_p
@@ -117,20 +136,30 @@ def iter_edge_chunks(
     dst = np.empty(chunk_edges, np.int64)
     val = np.empty(chunk_edges, np.float64)
     has_val = ctypes.c_int32(0)
+    at_eof = ctypes.c_int32(0)
     while True:
+        prev = offset.value
         got = lib.parse_edge_chunk(
             path.encode(), ctypes.byref(offset), src, dst, val, chunk_edges,
-            ctypes.byref(has_val),
+            ctypes.byref(has_val), ctypes.byref(at_eof),
         )
         if got < 0:
             raise IOError(f"cannot read {path}")
-        if got == 0:
+        if got:
+            yield (
+                src[:got].copy(),
+                dst[:got].copy(),
+                val[:got].copy() if has_val.value else None,
+            )
+        if at_eof.value:
             return
-        yield (
-            src[:got].copy(),
-            dst[:got].copy(),
-            val[:got].copy() if has_val.value else None,
-        )
+        # got == 0 with more file left is fine as long as the offset moved
+        # (a span of comments/blanks); no progress means a single line
+        # larger than the over-read buffer — error, don't drop the rest.
+        if got == 0 and offset.value == prev:
+            raise IOError(
+                f"{path}: line at byte {prev} exceeds the chunk read buffer"
+            )
 
 
 def _parse_python(path: str):

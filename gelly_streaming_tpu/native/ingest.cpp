@@ -137,16 +137,24 @@ int64_t parse_edge_file(const char* path, int64_t* src, int64_t* dst,
 
 // Chunked parse: read from byte *offset, stop after cap edges or EOF;
 // *offset is advanced to the first unconsumed byte (always at a line
-// boundary). Returns edges parsed (-1 on IO error).
+// boundary). Returns edges parsed (-1 on IO error). *at_eof_out is set to
+// 1 only when this call consumed through the last byte of the file — a
+// return of 0 with *at_eof_out == 0 means "no edges in this span, keep
+// going" (comment/blank run) or, if *offset did not advance, a line larger
+// than the read buffer (caller's error to surface).
 int64_t parse_edge_chunk(const char* path, int64_t* offset, int64_t* src,
                          int64_t* dst, double* val, int64_t cap,
-                         int32_t* has_val) {
+                         int32_t* has_val, int32_t* at_eof_out) {
     // Over-read enough bytes for cap edges (64 bytes/line upper bound),
     // then re-scan; the last (possibly partial) line is not consumed.
     int64_t len = cap * 64 + 4096;
     bool at_eof = false;
+    *at_eof_out = 0;
     char* buf = read_span(path, *offset, &len, &at_eof);
-    if (!buf) return len == 0 ? 0 : -1;
+    if (!buf) {
+        if (len == 0) { *at_eof_out = 1; return 0; }
+        return -1;
+    }
     const char* p = buf;
     const char* end = buf + len;
     int64_t n = 0;
@@ -168,6 +176,7 @@ int64_t parse_edge_chunk(const char* path, int64_t* offset, int64_t* src,
         consumed = p;
     }
     *offset += consumed - buf;
+    if (at_eof && consumed == end) *at_eof_out = 1;
     free(buf);
     return n;
 }

@@ -66,3 +66,46 @@ def test_concat_blocks(sample_edges):
     blocks = list(w.blocks(sample_edges))
     merged = concat_blocks(blocks)
     assert int(np.asarray(merged.mask).sum()) == 7
+
+
+def test_event_time_array_path_respects_timestamp_fn():
+    """ADVICE: the array fast path must apply timestamp_fn, not silently
+    window on a hardcoded column."""
+    import numpy as np
+    from gelly_streaming_tpu.core.window import EventTimeWindow, Windower
+
+    src = np.arange(6, dtype=np.int64)
+    dst = src + 100
+    val = np.zeros(6, np.float32)
+    ts = np.array([0, 1, 12, 13, 25, 26], np.float64)
+    # 4 columns: a naive implementation windows on cols[3]; the fn says e[2]
+    wrong_ts = np.zeros(6, np.float64)
+    w = Windower(EventTimeWindow(10, timestamp_fn=lambda e: e[2]))
+    infos = [i for i, _ in w.blocks_with_info((src, dst, ts, wrong_ts))]
+    assert len(infos) == 3  # windows from ts (col 2), not wrong_ts (col 3)
+    assert [i.start for i in infos] == [0, 10, 20]
+
+    # a fn that cannot be vectorized errors loudly instead of mis-windowing
+    import pytest
+
+    bad = Windower(EventTimeWindow(10, timestamp_fn=lambda e: float(len(str(e)))))
+    with pytest.raises(ValueError):
+        list(bad.blocks_with_info((src, dst, ts)))
+
+
+def test_event_time_array_path_requires_timestamp_fn():
+    """The array path keeps the record path's guard: no timestamp_fn means
+    an error, never silently windowing on the value column."""
+    import numpy as np
+    import pytest
+
+    from gelly_streaming_tpu.core.window import EventTimeWindow, Windower
+
+    src = np.arange(4, dtype=np.int64)
+    w = Windower(EventTimeWindow(10))
+    with pytest.raises(ValueError, match="timestamp_fn"):
+        list(w.blocks_with_info((src, src + 1, np.zeros(4))))
+    # ndarray wider than [N, 3] is rejected, matching the documented contract
+    w2 = Windower(EventTimeWindow(10, timestamp_fn=lambda e: e[2]))
+    with pytest.raises(ValueError, match=r"\[N, 2\] or \[N, 3\]"):
+        list(w2.blocks_with_info(np.zeros((4, 4))))

@@ -180,3 +180,37 @@ def test_checkpoint_rejects_mismatched_restore(tmp_path):
     other = BipartitenessCheck()
     with pytest.raises(ValueError):
         checkpoint.restore_aggregation(path, other)
+
+
+def test_checkpoint_structure_and_dtype_validation(tmp_path):
+    """Key-path structural check: same-shape/same-leaf-count states of
+    different kinds are still rejected; legacy checkpoints without key
+    paths fall back to a treedef-string warning, not an error."""
+    import json
+    import warnings
+
+    import numpy as np
+    import pytest
+
+    from gelly_streaming_tpu.aggregate import checkpoint
+
+    path = str(tmp_path / "ck")
+    checkpoint.save_pytree(path, {"ranks": np.zeros(8, np.float32)})
+    # same leaf count + shape, different key: must fail at load
+    with pytest.raises(ValueError, match="structure"):
+        checkpoint.load_pytree(path, {"deltas": np.zeros(8, np.float32)})
+    # same structure, different dtype kind: must fail at load
+    with pytest.raises(ValueError, match="dtype"):
+        checkpoint.load_pytree(path, {"ranks": np.zeros(8, np.int32)})
+    # legacy checkpoint (pre-keypaths) with a stale treedef repr: warn only
+    with open(path + ".json") as f:
+        info = json.load(f)
+    del info["keypaths"]
+    info["treedef"] = "PyTreeDef(<old jax repr>)"
+    with open(path + ".json", "w") as f:
+        json.dump(info, f)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        tree, _ = checkpoint.load_pytree(path, {"ranks": np.ones(8, np.float32)})
+    assert any("treedef" in str(w.message) for w in caught)
+    np.testing.assert_array_equal(tree["ranks"], np.zeros(8, np.float32))

@@ -112,3 +112,33 @@ def test_native_encoder_matches_numpy_fallback():
     np.testing.assert_array_equal(a.encode(batch), b.encode(batch))
     assert a.lookup(minv) == b.lookup(minv)
     assert a.raw_ids().tolist() == b.raw_ids().tolist()
+
+
+def test_chunked_iteration_skips_comment_runs(tmp_path):
+    """ADVICE: a chunk span containing no parseable edges is not EOF."""
+    p = tmp_path / "c.txt"
+    with open(p, "w") as f:
+        f.write("# head\n")
+        for i in range(50):
+            f.write(f"{i} {i + 1}\n")
+        # a comment run far larger than the over-read for chunk_edges=4
+        # ( 4*64 + 4096 bytes ) so at least one whole span is commentary
+        for _ in range(200):
+            f.write("%" + "x" * 60 + "\n")
+        for i in range(50, 100):
+            f.write(f"{i} {i + 1}\n")
+    chunks = list(native.iter_edge_chunks(str(p), chunk_edges=4))
+    src = np.concatenate([c[0] for c in chunks])
+    assert src.tolist() == list(range(100))
+
+
+def test_chunked_iteration_rejects_oversized_line(tmp_path):
+    """A single line larger than the read buffer errors instead of
+    silently dropping the rest of the file."""
+    p = tmp_path / "long.txt"
+    with open(p, "w") as f:
+        f.write("1 2\n")
+        f.write("# " + "y" * 20000 + "\n")
+        f.write("3 4\n")
+    with pytest.raises(IOError):
+        list(native.iter_edge_chunks(str(p), chunk_edges=2))

@@ -52,3 +52,29 @@ def test_prefetched_stream_matches_plain(sample_edges):
     a = [str(c) for c in plain.aggregate(ConnectedComponents())]
     b = [str(c) for c in pre.aggregate(ConnectedComponents())]
     assert a == b
+
+
+def test_prefetch_consumer_abandonment_stops_producer():
+    """ADVICE: breaking out of the consumer must not strand the producer
+    thread on a full queue or hold the source iterator open."""
+    import threading
+
+    closed = threading.Event()
+    produced = []
+
+    def gen():
+        try:
+            for i in range(10_000):
+                produced.append(i)
+                yield i
+        finally:
+            closed.set()
+
+    it = prefetch(gen(), depth=1)
+    for i in it:
+        if i >= 3:
+            break
+    it.close()
+    assert closed.wait(timeout=5.0), "producer did not release the source"
+    time.sleep(0.05)
+    assert len(produced) < 100  # producer stopped, not raced to completion
