@@ -1,22 +1,32 @@
-"""Benchmarks: the five BASELINE.json configs.
+"""Benchmarks: the five BASELINE.json configs, measured END-TO-END.
 
 Default run prints ONE JSON line (the driver contract): the headline
 streaming-CC metric {"metric", "value", "unit", "vs_baseline"}.
-``python bench.py --all`` additionally measures the other four configs and
+``python bench.py --all`` additionally measures the other configs and
 writes the detail table to BENCH_DETAIL.json (stderr log only — stdout
 stays one line).
 
-Headline workload: a synthetic power-law edge stream discretized into
-fixed-capacity windows; each window folds into the dense CC label table on
-device and merges into the running summary — the TPU-native equivalent of
-the reference's flagship path (``SummaryBulkAggregation.run`` →
-``DisjointSet.union``/``merge``, ``SummaryBulkAggregation.java:68-90``).
+Headline (round-2 change, per the round-1 verdict): the timed path is the
+whole system — corpus FILE -> native chunk parser -> Windower ->
+vertex mapping -> device blocks -> CC fold/combine summary — not a
+pre-staged device kernel loop. The kernel-only number is still reported in
+the detail table for the device-side story.
 
-``vs_baseline``: ratio against a measured in-process per-edge union-find
-(path compression + union by rank over dicts — the same data structure and
-one-record-at-a-time execution model as the reference's
-``summaries/DisjointSet.java``, minus JVM/Flink overheads). The reference
-publishes no numbers (BASELINE.md), so the baseline is measured, not quoted.
+``vs_baseline``: ratio against a COMPILED C++ implementation of the
+reference's own architecture on the same file — parse + per-partition
+window folds into hash-map union-find + sequential per-window merges
+(``native/ingest.cpp:cc_baseline_run``; the shapes of
+``SummaryBulkAggregation.java:68-90`` and ``summaries/DisjointSet.java``).
+That baseline is strictly FASTER than the actual reference (JVM Flink with
+serialization + network shuffles), so the printed ratio is a conservative
+lower bound on the true advantage; the interpreted-Python tier of the same
+model (the execution model the reference actually runs per record) is
+reported in the detail table as `python_unionfind_eps`.
+
+Measurement discipline: each detail config runs in a FRESH subprocess —
+the axon remote-TPU runtime degrades scatter executables up to ~250x after
+certain program sequences in one process (measured round 1), so in-process
+sequencing corrupts numbers. The headline runs first in this process.
 """
 
 from __future__ import annotations
@@ -44,9 +54,114 @@ def make_stream(n_vertices: int, n_edges: int, seed: int = 7):
 
 
 # --------------------------------------------------------------------- #
-# Config #2 (headline): streaming Connected Components
+# Headline: END-TO-END streaming Connected Components on the corpus file
 # --------------------------------------------------------------------- #
-def bench_cc(src, dst, n_vertices: int, window: int) -> float:
+CORPUS = "livejournal"
+WINDOW = 1 << 20
+ID_BOUND = 1 << 21  # surrogate R-MAT scale 21; the real corpus needs 1<<23
+
+
+def _corpus_path():
+    from gelly_streaming_tpu import datasets
+
+    path, is_real = datasets.ensure_corpus(CORPUS)
+    return path, is_real
+
+
+def _id_bound(path: str, is_real: bool) -> int:
+    if not is_real:
+        return ID_BOUND
+    # real LiveJournal: ids < 4,847,571
+    return 1 << 23
+
+
+def bench_cc_e2e(path: str, vdict_factory, n_edges: int) -> dict:
+    """file -> parse -> window -> vertex map -> device CC, warm + steady."""
+    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    def one_pass():
+        stream = datasets.stream_file(
+            path, window=CountWindow(WINDOW), vertex_dict=vdict_factory()
+        )
+        agg = ConnectedComponents()
+        lat = []
+        t0 = time.perf_counter()
+        last_t = t0
+        last = None
+        for last in stream.aggregate(agg):
+            now = time.perf_counter()
+            lat.append(now - last_t)
+            last_t = now
+        # the final summary's labels are already synced by the engine;
+        # component materialization is lazy and not part of the pipe rate
+        dt = time.perf_counter() - t0
+        return dt, lat, last
+
+    one_pass()  # warm: pays the jit compile for this (vcap, window) shape
+    dt, lat, last = one_pass()
+    lat_ms = np.asarray(lat) * 1e3
+    return {
+        "eps": n_edges / dt,
+        "p50_ms": float(np.percentile(lat_ms, 50)),
+        "p95_ms": float(np.percentile(lat_ms, 95)),
+        "components": len(last.component_sets()),
+    }
+
+
+def bench_cc_baseline(path: str) -> tuple:
+    """Compiled reference-architecture CC on the same file (parse included).
+
+    Returns (stats, src, dst) — the parsed columns ride along so --all
+    does not re-parse the corpus for the Python tier / binary cache."""
+    from gelly_streaming_tpu import native
+
+    t0 = time.perf_counter()
+    s, d, _ = native.parse_edge_file(path)
+    t_parse = time.perf_counter() - t0
+    secs, comps = native.cc_baseline(s, d, window=WINDOW)
+    return {
+        "eps": len(s) / (t_parse + secs),
+        "parse_s": t_parse,
+        "cc_s": secs,
+        "components": comps,
+        "n_edges": len(s),
+    }, s, d
+
+
+def bench_cc_python_tier(src, dst, sample: int) -> float:
+    """Per-edge union-find in interpreted Python — the reference's actual
+    per-record execution model, minus the JVM. Reference shape:
+    ``summaries/DisjointSet.java:97-123``."""
+    parent = {}
+    rank = {}
+
+    def find(x):
+        root = x
+        while parent.get(root, root) != root:
+            root = parent[root]
+        while parent.get(x, x) != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    t0 = time.perf_counter()
+    for s, d in zip(src[:sample].tolist(), dst[:sample].tolist()):
+        rs, rd = find(s), find(d)
+        if rs != rd:
+            if rank.get(rs, 0) < rank.get(rd, 0):
+                rs, rd = rd, rs
+            parent[rd] = rs
+            if rank.get(rs, 0) == rank.get(rd, 0):
+                rank[rs] = rank.get(rs, 0) + 1
+    dt = time.perf_counter() - t0
+    return sample / dt
+
+
+# --------------------------------------------------------------------- #
+# Kernel-only CC (round-1 headline, kept as the device-side number)
+# --------------------------------------------------------------------- #
+def bench_cc_kernel(src, dst, n_vertices: int, window: int) -> float:
     import jax
     import jax.numpy as jnp
 
@@ -80,32 +195,6 @@ def bench_cc(src, dst, n_vertices: int, window: int) -> float:
     lab = np.asarray(summary["labels"])
     assert (lab[lab] == lab).all()
     return n_win * window / dt
-
-
-def bench_cc_cpu_baseline(src, dst, sample: int) -> float:
-    """Per-edge union-find (the reference's execution model) edges/sec."""
-    parent = {}
-    rank = {}
-
-    def find(x):
-        root = x
-        while parent.get(root, root) != root:
-            root = parent[root]
-        while parent.get(x, x) != root:
-            parent[x], x = root, parent[x]
-        return root
-
-    t0 = time.perf_counter()
-    for s, d in zip(src[:sample].tolist(), dst[:sample].tolist()):
-        rs, rd = find(s), find(d)
-        if rs != rd:
-            if rank.get(rs, 0) < rank.get(rd, 0):
-                rs, rd = rd, rs
-            parent[rd] = rs
-            if rank.get(rs, 0) == rank.get(rd, 0):
-                rank[rs] = rank.get(rs, 0) + 1
-    dt = time.perf_counter() - t0
-    return sample / dt
 
 
 # --------------------------------------------------------------------- #
@@ -171,7 +260,7 @@ def bench_window_triangles(n_vertices: int = 1 << 17, window: int = 1 << 20) -> 
 
 
 # --------------------------------------------------------------------- #
-# Config #4: incremental PageRank
+# Config #4: incremental PageRank (end-to-end through the stream)
 # --------------------------------------------------------------------- #
 def bench_pagerank(n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int = 4) -> float:
     from gelly_streaming_tpu.core.stream import SimpleEdgeStream
@@ -220,35 +309,63 @@ def bench_graphsage(n_vertices: int = 1 << 16, window: int = 1 << 18, feat: int 
     return 2 * window / (time.perf_counter() - t0)
 
 
-def main():
-    n_vertices = 1 << 18
-    window = 1 << 18
-    n_windows = 8
-    n_edges = window * n_windows
+def _headline() -> tuple:
+    from gelly_streaming_tpu import datasets
 
-    src, dst = make_stream(n_vertices, n_edges)
-    log("bench: streaming CC (headline)...")
-    tpu_eps = bench_cc(src, dst, n_vertices, window)
-    cpu_eps = bench_cc_cpu_baseline(src, dst, sample=min(n_edges, 500_000))
+    path, is_real = _corpus_path()
+    bound = _id_bound(path, is_real)
+    base, s64, d64 = bench_cc_baseline(path)
+    n_edges = base["n_edges"]
+    log(f"bench: e2e CC on {path} ({'real' if is_real else 'surrogate'}, "
+        f"{n_edges} edges)...")
+    e2e = bench_cc_e2e(path, lambda: datasets.IdentityDict(bound), n_edges)
+    assert e2e["components"] == base["components"], (
+        f"correctness cross-check failed: device {e2e['components']} vs "
+        f"baseline {base['components']} components"
+    )
     headline = {
-        "metric": "streaming_cc_edges_per_sec",
-        "value": round(tpu_eps, 1),
+        "metric": "streaming_cc_e2e_edges_per_sec",
+        "value": round(e2e["eps"], 1),
         "unit": "edges/sec",
-        "vs_baseline": round(tpu_eps / cpu_eps, 2),
+        "vs_baseline": round(e2e["eps"] / base["eps"], 2),
     }
+    return headline, e2e, base, path, bound, n_edges, s64, d64
+
+
+def main():
+    headline, e2e, base, path, bound, n_edges, s64, d64 = _headline()
 
     if "--all" in sys.argv:
-        # Each config runs in a FRESH subprocess: the axon TPU runtime
-        # degrades subsequent scatter executions ~250x after certain
-        # programs run in the same process (measured: a scatter-min program
-        # drops later scatter-adds from 0.06ms to 15ms), so in-process
-        # sequencing would corrupt the numbers.
         import subprocess
 
-        detail = {"headline": headline, "cpu_unionfind_eps": round(cpu_eps, 1)}
+        from gelly_streaming_tpu import datasets
+
+        py_eps = bench_cc_python_tier(s64, d64, sample=min(n_edges, 400_000))
+        detail = {
+            "headline": headline,
+            "e2e_identity": e2e,
+            "baseline_compiled": base,
+            "python_unionfind_eps": round(py_eps, 1),
+            "corpus": path,
+        }
+        n_vertices = 1 << 18
+        window = 1 << 18
+        n_e = window * 8
+        binp = datasets.binary_cache(path, arrays=(s64, d64, None))
         for key, expr in [
+            ("e2e_dict_eps",
+             "import bench; from gelly_streaming_tpu.core.vertexdict import VertexDict; "
+             f"r = bench.bench_cc_e2e({path!r}, lambda: VertexDict(min_capacity={bound}), {n_edges}); "
+             "print(r['eps'])"),
+            ("e2e_binary_eps",
+             "import bench; from gelly_streaming_tpu import datasets; "
+             f"r = bench.bench_cc_e2e({binp!r}, lambda: datasets.IdentityDict({bound}), {n_edges}); "
+             "print(r['eps'])"),
+            ("kernel_cc_eps",
+             f"import bench; s,d=bench.make_stream({n_vertices},{n_e}); "
+             f"print(bench.bench_cc_kernel(s,d,{n_vertices},{window}))"),
             ("degrees_eps",
-             f"import bench; s,d=bench.make_stream({n_vertices},{n_edges}); "
+             f"import bench; s,d=bench.make_stream({n_vertices},{n_e}); "
              f"print(bench.bench_degrees(s,d,{n_vertices},{window}))"),
             ("window_triangles_eps",
              "import bench; print(bench.bench_window_triangles())"),

@@ -47,6 +47,23 @@ from ..parallel.mesh import EDGE_AXIS
 from jax.sharding import PartitionSpec as P
 
 
+#: Compiled window-step executables shared across aggregation instances,
+#: keyed by (step_cache_key(), vcap, mesh, tree-ness). Compiling the fused
+#: window program costs seconds on a remote TPU; a fresh aggregation object
+#: per stream must not pay it again. Bounded FIFO: each cached closure
+#: pins the aggregation instance it was built from (and thereby one
+#: summary pytree), so unbounded growth would leak device arrays across
+#: vcap buckets.
+_STEP_CACHE: dict = {}
+_STEP_CACHE_MAX = 16
+
+
+def _step_cache_put(key, fn) -> None:
+    if len(_STEP_CACHE) >= _STEP_CACHE_MAX:
+        _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
+    _STEP_CACHE[key] = fn
+
+
 class SummaryAggregation(abc.ABC):
     """Abstract engine config (``SummaryAggregation.java:22-137``).
 
@@ -58,6 +75,12 @@ class SummaryAggregation(abc.ABC):
     mesh:
         Optional ``jax.sharding.Mesh`` with an ``"edges"`` axis; falls back
         to the stream context's mesh, else single-device execution.
+
+    Contract for the state hooks (initial/update/combine): they must be
+    pure functions of their arguments for a given constructor
+    configuration. Subclasses whose constructor parameters change hook
+    behavior MUST include those parameters in :meth:`step_cache_key`, or
+    two differently-configured instances would share one compiled step.
     """
 
     #: False for host-state aggregations (update/combine get host edge arrays)
@@ -68,7 +91,10 @@ class SummaryAggregation(abc.ABC):
         self.mesh = mesh
         self._summary = None
         self._vcap = 0
-        self._window_step_fn = None
+
+    def step_cache_key(self):
+        """Hashable identity of the compiled window step (see class doc)."""
+        return (type(self),)
 
     # ------------------------------------------------------------------ #
     # State protocol (the updateFun / combineFun / transform slots)
@@ -117,7 +143,9 @@ class SummaryAggregation(abc.ABC):
         Merger chain). Single-dispatch matters twice: host round trips
         never interleave the device pipeline, and successive windows
         overlap via async dispatch."""
-        if self._window_step_fn is None:
+        cache_key = (self.step_cache_key(), vcap, mesh, self._is_tree())
+        step_fn = _STEP_CACHE.get(cache_key)
+        if step_fn is None:
             p = mesh.shape[EDGE_AXIS] if mesh is not None else 1
             tree = self._is_tree()
 
@@ -166,8 +194,9 @@ class SummaryAggregation(abc.ABC):
                     partial = out if tree else stacked_reduce(out, p)
                 return self.combine(summary, partial)
 
-            self._window_step_fn = jax.jit(step)
-        return self._window_step_fn(
+            step_fn = jax.jit(step)
+            _step_cache_put(cache_key, step_fn)
+        return step_fn(
             summary, block.src, block.dst, block.val, block.mask
         )
 
@@ -188,7 +217,6 @@ class SummaryAggregation(abc.ABC):
                 elif vcap > self._vcap:
                     self._summary = self.grow_state(self._summary, self._vcap, vcap)
                     self._vcap = vcap
-                    self._window_step_fn = None  # shapes changed
                 self._summary = self._window_step(self._summary, block, vcap, mesh)
             else:
                 src, dst, val = block.to_host()
@@ -228,7 +256,6 @@ class SummaryAggregation(abc.ABC):
             self._vcap = vcap
         elif self.device:
             self._vcap = self.infer_vcap(self._summary)
-        self._window_step_fn = None  # closure holds the old vcap
 
 
 class SummaryBulkAggregation(SummaryAggregation):

@@ -96,6 +96,40 @@ class VertexDict:
             self._sorted_idx = merged_idx[o]
         return out
 
+    def encode_pair(self, src: np.ndarray, dst: np.ndarray):
+        """Encode edge endpoint columns in arrival order (src before dst per
+        edge — the order the reference's per-record processing would see)
+        without materializing the interleaved array. Returns (src_idx,
+        dst_idx) int32 arrays."""
+        if self._native is not None:
+            ia, ib, novel = self._native.encode_pair(
+                np.asarray(src, np.int64).ravel(),
+                np.asarray(dst, np.int64).ravel(),
+            )
+            if novel.size:
+                self._idx_to_raw.extend(novel.tolist())
+            return ia, ib
+        both = np.stack(
+            [np.asarray(src, np.int64), np.asarray(dst, np.int64)], axis=1
+        ).ravel()
+        enc = self.encode(both)
+        return enc[0::2], enc[1::2]
+
+    def iter_encode_file(self, path: str, chunk_edges: int = 1 << 20):
+        """Fused file ingest (native only): yield already-encoded
+        ``(src_idx, dst_idx, val|None)`` int32 column chunks, keeping this
+        dict's reverse table in sync. Raises without the native encoder —
+        callers fall back to ``native.iter_edge_chunks`` + ``encode_pair``.
+        """
+        if self._native is None:
+            raise RuntimeError("native encoder unavailable")
+        for src, dst, val, novel in self._native.parse_encode_chunks(
+            path, chunk_edges
+        ):
+            if novel.size:
+                self._idx_to_raw.extend(novel.tolist())
+            yield src, dst, val
+
     def encode_one(self, raw: int) -> int:
         return int(self.encode(np.asarray([raw]))[0])
 

@@ -117,33 +117,47 @@ class Windower:
         if n and len(rows[0]) > 2 and rows[0][2] is not None:
             val = np.asarray([r[2] for r in rows], dtype=self.val_dtype)
         else:
-            val = np.zeros(n, dtype=self.val_dtype)
+            val = None
         return self._block_from_arrays(raw_src, raw_dst, val)
 
     def _block_from_arrays(
         self, raw_src: np.ndarray, raw_dst: np.ndarray, val: Optional[np.ndarray]
     ) -> EdgeBlock:
         n = raw_src.shape[0]
-        if val is None:
-            val = np.zeros(n, dtype=self.val_dtype)
-        # Encode both endpoints in one pass so first-seen order is by
-        # edge-arrival, matching the reference's per-record processing order.
-        both = (
-            np.stack([raw_src, raw_dst], axis=1).ravel()
-            if n
-            else np.zeros(0, np.int64)
-        )
-        enc = self.vertex_dict.encode(both)
-        src = enc[0::2]
-        dst = enc[1::2]
+        # Paired encode keeps first-seen order by edge arrival (src before
+        # dst per edge), matching the reference's per-record processing.
+        src, dst = self.vertex_dict.encode_pair(raw_src, raw_dst)
         cap = self.capacity if self.capacity is not None else bucket_capacity(n)
         block = EdgeBlock.from_arrays(
             src, dst, val, n_vertices=self.vertex_dict.capacity, capacity=cap,
             val_dtype=self.val_dtype,
         )
-        return block.with_host_cache(
-            src.copy(), dst.copy(), np.asarray(val, self.val_dtype)
+        host_val = (
+            np.zeros(n, dtype=self.val_dtype)
+            if val is None
+            else np.asarray(val, self.val_dtype)
         )
+        return block.with_host_cache(src, dst, host_val)
+
+    def _block_from_encoded(
+        self, src: np.ndarray, dst: np.ndarray, val: Optional[np.ndarray]
+    ) -> EdgeBlock:
+        """Build a block from already-compact int32 columns (the fused
+        native parse+encode path — the vertex dict was updated upstream)."""
+        n = src.shape[0]
+        src = np.ascontiguousarray(src, np.int32)
+        dst = np.ascontiguousarray(dst, np.int32)
+        cap = self.capacity if self.capacity is not None else bucket_capacity(n)
+        block = EdgeBlock.from_arrays(
+            src, dst, val, n_vertices=self.vertex_dict.capacity, capacity=cap,
+            val_dtype=self.val_dtype,
+        )
+        host_val = (
+            np.zeros(n, dtype=self.val_dtype)
+            if val is None
+            else np.asarray(val, self.val_dtype)
+        )
+        return block.with_host_cache(src, dst, host_val)
 
     def blocks(self, edges: Iterable[Tuple]) -> Iterator[EdgeBlock]:
         """Yield one EdgeBlock per tumbling window."""
@@ -278,6 +292,165 @@ class Windower:
                 )
         else:
             raise TypeError(f"unknown window policy {policy!r}")
+
+
+    # ------------------------------------------------------------------ #
+    # Chunked-column ingest: file-scale streams (datasets.stream_file)
+    # ------------------------------------------------------------------ #
+    def blocks_from_chunks(
+        self, chunks: Iterable[Tuple], encoded: bool = False
+    ) -> Iterator[Tuple["WindowInfo", EdgeBlock]]:
+        """Discretize an iterator of column chunks ``(src, dst[, val])``
+        into windows, re-slicing across chunk boundaries.
+
+        This is the bounded-memory ingest path for file-backed streams
+        (``native.iter_edge_chunks`` yields ~fixed-size column chunks; the
+        window policy decides the actual block boundaries). Count windows
+        buffer columns until ``size`` edges are pending; event-time windows
+        assume ascending timestamps (the reference's
+        ``AscendingTimestampExtractor`` contract) and flush a window when
+        its slot is passed.
+
+        ``encoded=True`` marks chunks whose endpoint columns are already
+        compact int32 ids from this windower's VertexDict (the fused native
+        ingest, ``VertexDict.iter_encode_file``); on that path an
+        event-time ``timestamp_fn`` sees compact ids in columns 0/1.
+        """
+        policy = self.policy
+        if isinstance(policy, CountWindow):
+            yield from self._chunk_count_windows(chunks, policy.size, encoded)
+        elif isinstance(policy, EventTimeWindow):
+            yield from self._chunk_time_windows(chunks, policy, encoded)
+        else:
+            raise TypeError(f"unknown window policy {policy!r}")
+
+    def _chunk_count_windows(self, chunks, size: int, encoded: bool = False):
+        pending: list[Tuple] = []  # (src, dst, val|None) column triples
+        have = 0
+        index = 0
+
+        def assemble(take: int):
+            nonlocal have
+            s_parts, d_parts, v_parts = [], [], []
+            got = 0
+            while got < take:
+                s, d, v = pending[0]
+                need = take - got
+                if len(s) <= need:
+                    s_parts.append(s)
+                    d_parts.append(d)
+                    v_parts.append(v)
+                    pending.pop(0)
+                    got += len(s)
+                else:
+                    s_parts.append(s[:need])
+                    d_parts.append(d[:need])
+                    v_parts.append(None if v is None else v[:need])
+                    pending[0] = (
+                        s[need:], d[need:], None if v is None else v[need:]
+                    )
+                    got = take
+            have -= take
+            if len(s_parts) == 1:
+                # common case (chunks larger than windows): hand out slice
+                # views, no concatenation copy — the encoder reads views
+                return s_parts[0], d_parts[0], v_parts[0]
+            src = np.concatenate(s_parts)
+            dst = np.concatenate(d_parts)
+            if any(v is not None for v in v_parts):
+                val = np.concatenate(
+                    [
+                        np.zeros(len(s), self.val_dtype) if v is None
+                        else np.asarray(v, self.val_dtype)
+                        for s, v in zip(s_parts, v_parts)
+                    ]
+                )
+            else:
+                val = None
+            return src, dst, val
+
+        build = self._block_from_encoded if encoded else self._block_from_arrays
+        for cols in chunks:
+            src, dst = np.asarray(cols[0]), np.asarray(cols[1])
+            val = cols[2] if len(cols) > 2 else None
+            if len(src) == 0:
+                continue
+            pending.append((src, dst, val))
+            have += len(src)
+            while have >= size:
+                yield WindowInfo(index, None, None), build(*assemble(size))
+                index += 1
+        if have:
+            yield WindowInfo(index, None, None), build(*assemble(have))
+
+    def _chunk_time_windows(
+        self, chunks, policy: EventTimeWindow, encoded: bool = False
+    ):
+        if policy.timestamp_fn is None:
+            raise ValueError(
+                "EventTimeWindow requires timestamp_fn — without it the "
+                "edge value would silently be read as the event time"
+            )
+        index = 0
+        slot: Optional[int] = None
+        pend: list[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = []
+
+        def flush():
+            nonlocal index, slot
+            if not pend:
+                return None
+            src = np.concatenate([p[0] for p in pend])
+            dst = np.concatenate([p[1] for p in pend])
+            if any(p[2] is not None for p in pend):
+                val = np.concatenate(
+                    [
+                        np.zeros(len(p[0]), self.val_dtype) if p[2] is None
+                        else np.asarray(p[2], self.val_dtype)
+                        for p in pend
+                    ]
+                )
+            else:
+                val = None
+            build = (
+                self._block_from_encoded if encoded else self._block_from_arrays
+            )
+            out = self._info(index, slot), build(src, dst, val)
+            index += 1
+            pend.clear()
+            return out
+
+        for cols in chunks:
+            src, dst = np.asarray(cols[0]), np.asarray(cols[1])
+            val = cols[2] if len(cols) > 2 else None
+            n = len(src)
+            if n == 0:
+                continue
+            ts = np.asarray(
+                policy.timestamp_fn(tuple(np.asarray(c) for c in cols)),
+                np.float64,
+            )
+            if ts.shape != (n,):
+                raise ValueError(
+                    "EventTimeWindow.timestamp_fn returned shape "
+                    f"{ts.shape} on the chunked path; expected ({n},)"
+                )
+            slots = (ts // policy.size).astype(np.int64)
+            bounds = np.nonzero(np.diff(slots))[0] + 1
+            starts = np.concatenate([[0], bounds])
+            ends = np.concatenate([bounds, [n]])
+            for a, b in zip(starts, ends):
+                run_slot = int(slots[a])
+                if slot is not None and run_slot != slot:
+                    w = flush()
+                    if w is not None:
+                        yield w
+                slot = run_slot
+                pend.append(
+                    (src[a:b], dst[a:b], None if val is None else val[a:b])
+                )
+        w = flush()
+        if w is not None:
+            yield w
 
 
 def blocks_from_edges(

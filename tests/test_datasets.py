@@ -1,0 +1,144 @@
+"""Corpus loaders, surrogate synthesis, and file->stream windowing."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import datasets, native
+from gelly_streaming_tpu.core.window import CountWindow, EventTimeWindow, Windower
+from gelly_streaming_tpu.library import ConnectedComponents
+
+
+def test_rmat_shape_and_skew():
+    src, dst = datasets.rmat_edges(1 << 16, scale=12, seed=3)
+    assert src.max() < (1 << 12) and dst.max() < (1 << 12)
+    # power-law-ish: the top-degree vertex holds far more than uniform share
+    deg = np.bincount(np.concatenate([src, dst]))
+    assert deg.max() > 20 * deg[deg > 0].mean()
+
+
+def test_chunk_count_windows_reslice(tmp_path):
+    """Windows re-slice across chunk boundaries with full coverage."""
+    p = tmp_path / "e.txt"
+    n = 10_000
+    src = np.arange(n, dtype=np.int64)
+    native.write_edge_file(str(p), src, src + 1)
+    w = Windower(CountWindow(768))
+    blocks = [
+        b for _, b in w.blocks_from_chunks(
+            native.iter_edge_chunks(str(p), chunk_edges=1000)
+        )
+    ]
+    sizes = [int(np.asarray(b.mask).sum()) for b in blocks]
+    assert sizes == [768] * (n // 768) + [n % 768]
+    got = np.concatenate([b.to_host()[0] for b in blocks])
+    # compact ids follow first-seen arrival order; decode back to raw
+    raw = w.vertex_dict.decode(got)
+    assert raw.tolist() == src.tolist()
+
+
+def test_chunk_time_windows_span_boundaries():
+    """Event-time windows spanning chunk boundaries come out whole."""
+    ts = np.array([0, 1, 5, 11, 12, 13, 29, 35], np.float64)
+    src = np.arange(8, dtype=np.int64)
+    chunks = [
+        (src[:3], src[:3] + 100, ts[:3]),
+        (src[3:5], src[3:5] + 100, ts[3:5]),
+        (src[5:], src[5:] + 100, ts[5:]),
+    ]
+    w = Windower(EventTimeWindow(10, timestamp_fn=lambda e: e[2]))
+    out = list(w.blocks_from_chunks(iter(chunks)))
+    starts = [i.start for i, _ in out]
+    sizes = [int(np.asarray(b.mask).sum()) for _, b in out]
+    assert starts == [0, 10, 20, 30]
+    assert sizes == [3, 3, 1, 1]
+
+
+def test_stream_file_cc_end_to_end(tmp_path):
+    p = tmp_path / "cc.txt"
+    p.write_text("# c\n1 2\n2 3\n6 7\n8 9\n5 6\n")
+    stream = datasets.stream_file(str(p), window=CountWindow(2))
+    last = None
+    for last in stream.aggregate(ConnectedComponents()):
+        pass
+    assert sorted(last.component_sets()) == sorted(
+        [frozenset({1, 2, 3}), frozenset({5, 6, 7}), frozenset({8, 9})]
+    )
+
+
+def test_ensure_corpus_surrogate_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("GELLY_DATA", str(tmp_path))  # no real corpora here
+    spec = datasets.CORPORA["movielens-100k"]
+    path = str(tmp_path / "ml.txt")
+    datasets.synthesize("movielens-100k", path, seed=1)
+    u, i, r = datasets.load_movielens(path)
+    assert len(u) == spec.surrogate_edges
+    assert r.min() >= 1 and r.max() <= 5
+    assert i.min() >= datasets.MOVIELENS_ITEM_OFFSET
+
+
+def test_locate_prefers_real_file(tmp_path, monkeypatch):
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "twitter_combined.txt").write_text("1 2\n")
+    monkeypatch.setenv("GELLY_DATA", str(d))
+    path, is_real = datasets.ensure_corpus("twitter-ego")
+    assert is_real and path.endswith("twitter_combined.txt")
+
+
+def test_identity_dict_roundtrip_and_bounds():
+    d = datasets.IdentityDict(100)
+    s = np.array([5, 7, 99], np.int64)
+    enc = d.encode(s)
+    assert enc.dtype == np.int32 and enc.tolist() == [5, 7, 99]
+    assert d.decode(enc).tolist() == [5, 7, 99]
+    assert len(d) == 100 and d.lookup(5) == 5 and d.lookup(200) is None
+    with pytest.raises(ValueError):
+        d.encode(np.array([100]))
+
+
+def test_identity_stream_matches_dict_stream(tmp_path):
+    """Raw-dense mode must produce the same components as the VertexDict
+    path (touched-mask filtering hides id-space gaps)."""
+    p = tmp_path / "g.txt"
+    # ids with gaps: 0,2,3, 7,8 — two components, ids 1,4,5,6 never appear
+    p.write_text("0 2\n2 3\n7 8\n")
+    a = datasets.stream_file(str(p), window=CountWindow(2))
+    b = datasets.stream_file(
+        str(p), window=CountWindow(2), vertex_dict=datasets.IdentityDict(16)
+    )
+    ra = [c for c in a.aggregate(ConnectedComponents())][-1]
+    rb = [c for c in b.aggregate(ConnectedComponents())][-1]
+    assert sorted(ra.component_sets()) == sorted(rb.component_sets()) == sorted(
+        [frozenset({0, 2, 3}), frozenset({7, 8})]
+    )
+
+
+def test_binary_cache_roundtrip(tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("# c\n1 2 0.5\n3 4 1.5\n5 6 -2.0\n")
+    binp = datasets.binary_cache(str(p))
+    chunks = list(datasets.iter_binary_chunks(binp, 2))
+    src = np.concatenate([c[0] for c in chunks])
+    val = np.concatenate([c[2] for c in chunks])
+    assert src.tolist() == [1, 3, 5]
+    np.testing.assert_allclose(val, [0.5, 1.5, -2.0])
+    # binary stream -> CC end to end
+    st = datasets.stream_file(binp, window=CountWindow(2),
+                              vertex_dict=datasets.IdentityDict(8))
+    last = [c for c in st.aggregate(ConnectedComponents())][-1]
+    assert sorted(last.component_sets()) == sorted(
+        [frozenset({1, 2}), frozenset({3, 4}), frozenset({5, 6})]
+    )
+
+
+def test_compiled_baseline_component_parity(tmp_path):
+    """The C++ baseline and the device path agree on component structure."""
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 300, 3000)
+    dst = rng.integers(0, 300, 3000)
+    p = tmp_path / "r.txt"
+    native.write_edge_file(str(p), src, dst)
+    _, comps = native.cc_baseline(src, dst, window=512)
+    st = datasets.stream_file(str(p), window=CountWindow(512))
+    last = [c for c in st.aggregate(ConnectedComponents())][-1]
+    assert len(last.component_sets()) == comps
