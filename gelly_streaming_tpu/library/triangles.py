@@ -56,19 +56,48 @@ def _window_step(src, dst, mask, num_vertices: int, max_degree: int):
     return window_triangle_count(src, dst, mask, num_vertices, max_degree)
 
 
-@functools.partial(jax.jit, static_argnums=(8, 9))
-def _streaming_step(
-    acc_u, acc_v, acc_rank, acc_mask,
-    new_u, new_v, new_rank, new_mask,
-    num_vertices: int, max_degree: int,
-    counts,
-):
-    ids, ranks = sorted_ranked_rows(
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _rebuild_rows(acc_u, acc_v, acc_rank, acc_mask, num_vertices: int,
+                  max_degree: int):
+    """Full sorted-row rebuild — used only on checkpoint restore; the
+    steady path merges incrementally (:func:`_incremental_step`)."""
+    return sorted_ranked_rows(
         acc_u, acc_v, acc_rank, acc_mask, num_vertices, max_degree
     )
-    return ranked_triangle_update(
+
+
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _incremental_step(
+    ids, ranks, counts,
+    touched, add_ids, add_ranks,
+    new_u, new_v, new_rank, new_mask,
+):
+    """One window of streaming exact triangles, one dispatch.
+
+    ``ids``/``ranks`` are the carried ``[Vcap+1, D]`` sorted-by-id
+    neighbor/rank rows of the ACCUMULATED graph (row Vcap is scratch —
+    padded ``touched`` slots point there so their writes never land on a
+    real vertex). The step (a) merges each touched vertex's new neighbors
+    into its row — per-window merge cost scales with the touched set, not
+    the accumulated edge count (the round-1 version re-sorted every
+    accumulated edge per window) — then (b) counts the triangles closed
+    by the new edges via the rank-ordered membership kernel.
+    """
+    rows = jnp.concatenate([ids[touched], add_ids], axis=1)
+    rrk = jnp.concatenate([ranks[touched], add_ranks], axis=1)
+    order = jnp.argsort(rows, axis=1)
+    D = ids.shape[1]
+    rows = jnp.take_along_axis(rows, order, axis=1)[:, :D]
+    rrk = jnp.take_along_axis(rrk, order, axis=1)[:, :D]
+    ids = ids.at[touched].set(rows)
+    ranks = ranks.at[touched].set(rrk)
+    counts, delta = ranked_triangle_update(
         ids, ranks, new_u, new_v, new_rank, new_mask, counts
     )
+    return ids, ranks, counts, delta
 
 
 class WindowTriangles:
@@ -131,8 +160,11 @@ class ExactTriangleCount:
         self._v = np.zeros(0, np.int32)
         self._seen_keys = np.zeros(0, np.int64)  # sorted
         self._deg = np.zeros(0, np.int64)
-        # device carry
+        # device carry: counts [Vcap] + sorted neighbor/rank rows
+        # [Vcap+1, Dcap] (last row = scratch for padded scatter indices)
         self._counts = None
+        self._ids = None
+        self._ranks = None
         self._total = 0
 
     def run(self, stream) -> Iterator[List[Tuple[int, int]]]:
@@ -144,7 +176,9 @@ class ExactTriangleCount:
             yield self._process(new_u, new_v, vcap, vdict)
 
     def state_dict(self) -> dict:
-        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``)."""
+        """Checkpoint surface (``aggregate/checkpoint.py:save_workload``).
+        The sorted rows are NOT serialized — they are rebuilt from the
+        edge list on restore (one full-build step)."""
         return {
             "u": self._u, "v": self._v, "seen_keys": self._seen_keys,
             "deg": self._deg,
@@ -157,6 +191,26 @@ class ExactTriangleCount:
         self._seen_keys, self._deg = d["seen_keys"], d["deg"]
         self._counts = None if d["counts"] is None else jnp.asarray(d["counts"])
         self._total = int(d["total"])
+        self._ids = self._ranks = None
+        if self._counts is not None and len(self._u):
+            vcap = int(self._counts.shape[0])
+            dcap = bucket_capacity(int(self._deg[:vcap].max()))
+            n = len(self._u)
+            cap = bucket_capacity(n)
+            ids, ranks = _rebuild_rows(
+                jnp.asarray(_pad(self._u, cap)),
+                jnp.asarray(_pad(self._v, cap)),
+                jnp.asarray(_pad(np.arange(n, dtype=np.int32), cap)),
+                jnp.asarray(np.arange(cap) < n),
+                vcap, dcap,
+            )
+            # append the scratch row
+            self._ids = jnp.concatenate(
+                [ids, jnp.full((1, dcap), _BIG, jnp.int32)]
+            )
+            self._ranks = jnp.concatenate(
+                [ranks, jnp.zeros((1, dcap), jnp.int32)]
+            )
 
     # ------------------------------------------------------------------ #
     def _dedup_new(self, s: np.ndarray, d: np.ndarray):
@@ -181,6 +235,66 @@ class ExactTriangleCount:
         self._seen_keys = np.sort(np.concatenate([self._seen_keys, key]))
         return u.astype(np.int32), v.astype(np.int32)
 
+    def _grow(self, vcap: int, dcap: int) -> None:
+        """Grow the carried device matrices to [vcap+1, dcap] (scratch row
+        last; log-many re-pads over the stream). Appending +INT_MAX columns
+        keeps rows sorted; the old scratch row is cleared when it becomes a
+        real vertex row."""
+        if self._ids is None:
+            self._ids = jnp.full((vcap + 1, dcap), _BIG, jnp.int32)
+            self._ranks = jnp.zeros((vcap + 1, dcap), jnp.int32)
+            return
+        old_v = self._ids.shape[0] - 1
+        old_d = self._ids.shape[1]
+        if old_v == vcap and old_d == dcap:
+            return
+        ids = self._ids
+        ranks = self._ranks
+        if dcap > old_d:
+            ids = jnp.concatenate(
+                [ids, jnp.full((old_v + 1, dcap - old_d), _BIG, jnp.int32)], 1
+            )
+            ranks = jnp.concatenate(
+                [ranks, jnp.zeros((old_v + 1, dcap - old_d), jnp.int32)], 1
+            )
+        if vcap > old_v:
+            ids = jnp.concatenate(
+                [ids, jnp.full((vcap - old_v, dcap), _BIG, jnp.int32)]
+            )
+            ranks = jnp.concatenate(
+                [ranks, jnp.zeros((vcap - old_v, dcap), jnp.int32)]
+            )
+            # the old scratch row (index old_v) is now a real vertex row;
+            # it holds junk from padded-slot writes — reset it
+            ids = ids.at[old_v].set(jnp.full(dcap, _BIG, jnp.int32))
+            ranks = ranks.at[old_v].set(jnp.zeros(dcap, jnp.int32))
+        self._ids = ids
+        self._ranks = ranks
+
+    @staticmethod
+    def _new_rows(new_u, new_v, new_ranks):
+        """Host-built per-vertex additions: (touched[T], add_ids[T, Dn],
+        add_ranks[T, Dn]) covering both directions of the new edges."""
+        key = np.concatenate([new_u, new_v]).astype(np.int64)
+        nbr = np.concatenate([new_v, new_u]).astype(np.int32)
+        rk = np.concatenate([new_ranks, new_ranks]).astype(np.int32)
+        order = np.argsort(key, kind="stable")
+        k, nb, rr = key[order], nbr[order], rk[order]
+        touched, start = np.unique(k, return_index=True)
+        cnt = np.diff(np.append(start, len(k)))
+        # floor 16: windows flapping between tiny Dn buckets would
+        # recompile the step for negligible memory savings
+        dn = bucket_capacity(int(cnt.max()), minimum=16)
+        t = len(touched)
+        tcap = bucket_capacity(t)
+        add_ids = np.full((tcap, dn), np.iinfo(np.int32).max, np.int32)
+        add_ranks = np.zeros((tcap, dn), np.int32)
+        row = np.repeat(np.arange(t), cnt)
+        col = np.arange(len(k)) - np.repeat(start, cnt)
+        add_ids[row, col] = nb
+        add_ranks[row, col] = rr
+        return touched.astype(np.int32), tcap, add_ids, add_ranks
+
     def _process(self, new_u, new_v, vcap: int, vdict) -> List[Tuple[int, int]]:
         n_old = len(self._u)
         self._u = np.concatenate([self._u, new_u])
@@ -201,32 +315,33 @@ class ExactTriangleCount:
             return []
 
         n_acc = len(self._u)
-        acc_cap = bucket_capacity(n_acc)
         new_cap = bucket_capacity(len(new_u))
         max_deg = bucket_capacity(int(self._deg[:vcap].max()))
-        acc_u = _pad(self._u, acc_cap)
-        acc_v = _pad(self._v, acc_cap)
-        acc_rank = _pad(np.arange(n_acc, dtype=np.int32), acc_cap)
-        acc_mask = np.zeros(acc_cap, bool)
-        acc_mask[:n_acc] = True
-        new_rank = _pad(np.arange(n_old, n_acc, dtype=np.int32), new_cap)
+        self._grow(vcap, max_deg)
+
+        new_ranks = np.arange(n_old, n_acc, dtype=np.int32)
+        touched, tcap, add_ids, add_ranks = self._new_rows(
+            new_u, new_v, new_ranks
+        )
+        # padded touched slots point at the scratch row (index vcap)
+        touched_p = np.full(tcap, vcap, np.int32)
+        touched_p[: len(touched)] = touched
         new_mask = np.zeros(new_cap, bool)
         new_mask[: len(new_u)] = True
 
-        old_counts = self._counts
-        self._counts, delta = _streaming_step(
-            jnp.asarray(acc_u), jnp.asarray(acc_v),
-            jnp.asarray(acc_rank), jnp.asarray(acc_mask),
+        # snapshot counts host-side BEFORE dispatch: the device buffer is
+        # donated to the step and must not be read afterwards
+        old_host = np.asarray(self._counts)
+        self._ids, self._ranks, self._counts, delta = _incremental_step(
+            self._ids, self._ranks, self._counts,
+            jnp.asarray(touched_p), jnp.asarray(add_ids), jnp.asarray(add_ranks),
             jnp.asarray(_pad(new_u, new_cap)), jnp.asarray(_pad(new_v, new_cap)),
-            jnp.asarray(new_rank), jnp.asarray(new_mask),
-            vcap, max_deg,
-            old_counts,
+            jnp.asarray(_pad(new_ranks, new_cap)), jnp.asarray(new_mask),
         )
-        changed = np.nonzero(
-            np.asarray(self._counts) != np.asarray(old_counts)
-        )[0]
-        out = [(int(vdict.decode_one(c)), int(np.asarray(self._counts)[c]))
-               for c in changed]
+        new_counts = np.asarray(self._counts)
+        changed = np.nonzero(new_counts != old_host)[0]
+        raw = vdict.decode(changed) if len(changed) else []
+        out = [(int(r), int(new_counts[c])) for r, c in zip(raw, changed)]
         delta = int(delta)
         if delta:
             self._total += delta
