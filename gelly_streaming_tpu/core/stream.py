@@ -511,7 +511,14 @@ class SimpleEdgeStream(GraphStream):
         (``SimpleEdgeStream.java:135-167``).
 
         ``window=None`` reuses the stream's own block windows; otherwise the
-        blocks are host-side re-discretized (count windows only).
+        blocks are host-side re-discretized — by edge count
+        (``CountWindow``) or by event time (``EventTimeWindow``, the
+        ``slice(Time, dir)`` analog of ``SimpleEdgeStream.java:135-167``).
+        Event-time re-windowing applies ``timestamp_fn`` to the host column
+        tuple ``(raw_src, raw_dst, val)`` (vectorized, same contract as the
+        array ingest path) and assumes ascending timestamps (the
+        reference's ``AscendingTimestampExtractor`` contract); windows may
+        span block boundaries.
         """
         from .snapshot import SnapshotStream
 
@@ -520,11 +527,12 @@ class SimpleEdgeStream(GraphStream):
             block_iter_fn = source
         elif isinstance(window, CountWindow):
             block_iter_fn = lambda: _rewindow_count(source(), window.size)
-        else:
-            raise NotImplementedError(
-                "slice() re-windowing supports CountWindow; build the stream "
-                "with an EventTimeWindow policy for time-based slicing"
+        elif isinstance(window, EventTimeWindow):
+            block_iter_fn = lambda: _rewindow_time(
+                source(), window, self._vdict
             )
+        else:
+            raise TypeError(f"unknown window policy {window!r}")
         return SnapshotStream(block_iter_fn, direction, self._vdict, self.context)
 
 
@@ -601,3 +609,67 @@ def _rewindow_count(blocks: Iterator[EdgeBlock], size: int) -> Iterator[EdgeBloc
         merged = concat_blocks(buf)
         if int(np.asarray(merged.mask).sum()):
             yield merged
+
+
+def _rewindow_time(
+    blocks: Iterator[EdgeBlock], policy: EventTimeWindow, vdict
+) -> Iterator[EdgeBlock]:
+    """Re-discretize a block stream into tumbling event-time windows.
+
+    ``policy.timestamp_fn`` is applied to the host column tuple
+    ``(raw_src, raw_dst, val)``; an index-based extractor (``lambda e:
+    e[2]``) selects the same column it would per-record. Ascending
+    timestamps assumed; a window flushes when a later slot appears, so one
+    window may assemble from several upstream blocks.
+    """
+    from .edgeblock import from_arrays_tree
+
+    if policy.timestamp_fn is None:
+        raise ValueError(
+            "EventTimeWindow requires timestamp_fn — without it the edge "
+            "value would silently be read as the event time"
+        )
+    pend: list = []  # (src, dst, val) column slices of the open window
+    slot: Optional[int] = None
+    n_vertices = 0
+
+    def flush() -> Optional[EdgeBlock]:
+        if not pend:
+            return None
+        s = np.concatenate([p[0] for p in pend])
+        d = np.concatenate([p[1] for p in pend])
+        v = jax.tree.map(lambda *leaves: np.concatenate(leaves), *[p[2] for p in pend])
+        pend.clear()
+        return from_arrays_tree(s, d, v, n_vertices=n_vertices)
+
+    for b in blocks:
+        s, d, v = b.to_host()
+        n = len(s)
+        if n == 0:
+            continue
+        n_vertices = max(n_vertices, b.n_vertices)
+        raw_s = vdict.decode(s)
+        raw_d = vdict.decode(d)
+        ts = np.asarray(policy.timestamp_fn((raw_s, raw_d, v)), np.float64)
+        if ts.shape != (n,):
+            raise ValueError(
+                "EventTimeWindow.timestamp_fn returned shape "
+                f"{ts.shape} re-windowing a block of {n} edges"
+            )
+        slots = (ts // policy.size).astype(np.int64)
+        bounds = np.nonzero(np.diff(slots))[0] + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [n]])
+        for a, e in zip(starts, ends):
+            run_slot = int(slots[a])
+            if slot is not None and run_slot != slot:
+                w = flush()
+                if w is not None:
+                    yield w
+            slot = run_slot
+            pend.append(
+                (s[a:e], d[a:e], jax.tree.map(lambda x: x[a:e], v))
+            )
+    w = flush()
+    if w is not None:
+        yield w

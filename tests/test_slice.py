@@ -104,3 +104,51 @@ def test_multi_window_slice(sample_edges):
     got2 = {v: int(r) for v, r in records[len(w1):]}
     assert got1 == w1
     assert got2 == w2
+
+
+def test_slice_event_time_rewindowing():
+    """Time-based re-windowing of an existing block stream
+    (``SimpleEdgeStream.java:135-167`` slice(Time, dir)): windows span the
+    underlying block boundaries and aggregate per time slot."""
+    # edges (src, dst, val) where val doubles as the timestamp; blocks of 3
+    # edges, but time windows of width 10 regroup them as 4 / 2 / 1
+    edges = [
+        (1, 2, 0.0), (2, 3, 1.0), (1, 3, 5.0),     # block 0
+        (3, 4, 9.0), (4, 5, 12.0), (5, 1, 13.0),   # block 1 (spans slots)
+        (2, 5, 27.0),                               # block 2
+    ]
+    from gelly_streaming_tpu import EventTimeWindow
+
+    stream = SimpleEdgeStream(edges, window=CountWindow(3))
+    sliced = stream.slice(
+        window=EventTimeWindow(10, timestamp_fn=lambda e: e[2]),
+        direction=EdgeDirection.OUT,
+    )
+    # the re-windowed blocks regroup edges by time slot across block bounds
+    wins = []
+    for b in sliced._block_iter_fn():
+        s, d, v = b.to_host()
+        raw_s = stream.vertex_dict.decode(s)
+        raw_d = stream.vertex_dict.decode(d)
+        wins.append(sorted(zip(raw_s.tolist(), raw_d.tolist(), v.tolist())))
+    assert wins == [
+        sorted([(1, 2, 0.0), (2, 3, 1.0), (1, 3, 5.0), (3, 4, 9.0)]),
+        sorted([(4, 5, 12.0), (5, 1, 13.0)]),
+        sorted([(2, 5, 27.0)]),
+    ]
+    # and the neighborhood aggregation runs per re-windowed snapshot:
+    # flat (vertex, sum) emissions, one group per window
+    got = [(v, float(x)) for v, x in sliced.reduce_on_edges("sum")]
+    assert got == [
+        (1, 5.0), (2, 1.0), (3, 9.0),
+        (4, 12.0), (5, 13.0),
+        (2, 27.0),
+    ]
+
+
+def test_slice_event_time_requires_timestamp_fn():
+    from gelly_streaming_tpu import EventTimeWindow
+
+    stream = SimpleEdgeStream([(1, 2, 0.0)], window=CountWindow(2))
+    with pytest.raises(ValueError, match="timestamp_fn"):
+        list(stream.slice(window=EventTimeWindow(10)).reduce_on_edges("sum"))
