@@ -1,0 +1,89 @@
+"""Worker for the 2-process ``jax.distributed`` smoke test.
+
+Launched twice by ``tests/test_multiprocess.py`` (process_id 0 and 1) on
+the CPU backend with 4 virtual devices per process — the multi-host analog
+of the reference's Flink mini-cluster tests (SURVEY.md §4): a coordinator
+wires both processes into one runtime, a global 8-device mesh spans them,
+``global_edge_block`` assembles globally-sharded columns from per-host
+shards, and one sharded CC window step runs across the processes.
+
+Prints ``MP_OK <labels...>`` on success (the parent asserts both workers
+agree and exit 0).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+# the launcher sets these in the subprocess env (site hooks may import jax
+# before this line); keep them here too for standalone runs
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from gelly_streaming_tpu.parallel import comm, multihost  # noqa: E402
+from gelly_streaming_tpu.parallel.mesh import EDGE_AXIS, make_mesh  # noqa: E402
+from gelly_streaming_tpu.summaries.labels import (  # noqa: E402
+    cc_fold,
+    init_labels,
+    label_combine,
+)
+
+multihost.initialize(f"localhost:{port}", num_processes=2, process_id=proc_id)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+assert multihost.is_coordinator() == (proc_id == 0)
+
+mesh = make_mesh(8)
+
+# Each host owns a shard of the window's edges (the pre-partitioned ingest
+# contract of parallel/multihost.py): host 0 links {0,1,2}, host 1 links
+# {3,4} and bridges 2-3, so the global graph is one component {0..4} plus
+# the untouched singleton 5 — correct ONLY if the cross-host edges meet in
+# the collective.
+V = 8
+if proc_id == 0:
+    src = np.array([0, 1, 0, 0], np.int32)
+    dst = np.array([1, 2, 0, 0], np.int32)
+    msk = np.array([True, True, False, False])
+else:
+    src = np.array([3, 2, 0, 0], np.int32)
+    dst = np.array([4, 3, 0, 0], np.int32)
+    msk = np.array([True, True, False, False])
+
+gsrc, gdst, gmsk = multihost.global_edge_block(mesh, [src, dst, msk])
+assert gsrc.shape == (8,), gsrc.shape
+
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+
+@jax.jit
+def window_step(s, d, m):
+    def shard_fn(s, d, m):
+        part = cc_fold(init_labels(V), s, d, m)
+        return jax.tree.map(lambda x: x[None], part)
+
+    out = comm.shard_map(
+        shard_fn, mesh,
+        (P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)),
+        jax.tree.map(lambda _: P(EDGE_AXIS), init_labels(V)),
+    )(s, d, m)
+    # flat stacked-shard reduction (the engine's bulk combine)
+    acc = jax.tree.map(lambda x: x[0], out)
+    for i in range(1, 8):
+        acc = label_combine(acc, jax.tree.map(lambda x: x[i], out))
+    return acc
+
+
+summary = window_step(gsrc, gdst, gmsk)
+# global summaries are replicated; every process can read them
+labels = np.asarray(jax.device_get(summary["labels"]))
+touched = np.asarray(jax.device_get(summary["touched"]))
+assert labels[:5].tolist() == [0, 0, 0, 0, 0], labels
+assert touched.tolist() == [True] * 5 + [False] * 3, touched
+print(f"MP_OK {labels.tolist()}", flush=True)

@@ -1,0 +1,59 @@
+"""2-process ``jax.distributed`` smoke test (VERDICT round-1 item #5).
+
+The reference validates distributed behavior on Flink's in-process
+mini-cluster; the closest JAX analog with real process boundaries is two
+coordinated CPU processes, each with 4 virtual devices, running one
+sharded CC window step over a global 8-device mesh. This is the only test
+that actually executes ``jax.process_count() == 2``.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_cc():
+    port = _free_port()
+    # env must be set before interpreter start: site hooks may import jax
+    # before the worker's own environ assignments would run. Remote-TPU
+    # plugin triggers are stripped so the workers come up as clean CPU
+    # processes (the plugin pre-initializes jax and breaks
+    # jax.distributed in child processes).
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout={out}\nstderr={err[-2000:]}"
+        assert "MP_OK" in out, out
+    # both processes computed the same replicated global summary
+    lines = [o.splitlines()[-1] for _, o, _ in outs]
+    assert lines[0] == lines[1], lines
