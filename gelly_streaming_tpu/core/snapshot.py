@@ -81,19 +81,29 @@ class SnapshotStream:
         return mesh
 
     def _emit(self, result, nonempty, vdict_size_hint: Optional[int] = None):
-        """Yield (raw_vertex_id, record) for each nonempty vertex."""
+        """Yield (raw_vertex_id, record) for each nonempty vertex.
+
+        Batched: one decode for the window's changed set and one host
+        download per result leaf (no per-record ``decode_one``)."""
         nonempty_h = np.asarray(nonempty)
         idxs = np.nonzero(nonempty_h)[0]
+        if idxs.size == 0:
+            return
+        raws = self._vdict.decode(idxs).tolist()
         leaves_are_struct = not isinstance(result, (jnp.ndarray, np.ndarray))
-        result_h = jax.tree.map(np.asarray, result)
-        for c in idxs.tolist():
-            raw = int(self._vdict.decode_one(c))
-            if leaves_are_struct:
-                rec = jax.tree.map(lambda a: a[c].item() if a[c].ndim == 0 else a[c], result_h)
-            else:
-                r = result_h[c]
-                rec = r.item() if np.ndim(r) == 0 else r
-            yield raw, rec
+        if not leaves_are_struct:
+            vals = np.asarray(result)[idxs]
+            scalar = vals.ndim == 1
+            for i, raw in enumerate(raws):
+                v = vals[i]
+                yield int(raw), (v.item() if scalar else v)
+            return
+        sliced = jax.tree.map(lambda a: np.asarray(a)[idxs], result)
+        for i, raw in enumerate(raws):
+            rec = jax.tree.map(
+                lambda a: a[i].item() if a[i].ndim == 0 else a[i], sliced
+            )
+            yield int(raw), rec
 
     # ------------------------------------------------------------------ #
     def fold_neighbors(self, initial_value: Any, fold_fn: Callable) -> Iterator[Tuple[int, Any]]:

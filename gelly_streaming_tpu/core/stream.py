@@ -361,31 +361,59 @@ class SimpleEdgeStream(GraphStream):
     # ------------------------------------------------------------------ #
     # Property streams (continuously improving, per-block change-only)
     # ------------------------------------------------------------------ #
-    def get_edges(self) -> Iterator[Edge]:
+    def get_edges(self) -> "EmissionStream":
         vdict = self._vdict
-        for b in self.blocks():
-            src, dst, val = b.to_host()
-            raw_s = vdict.decode(src)
-            raw_d = vdict.decode(dst)
-            vals = _host_vals(val)
-            for i in range(len(raw_s)):
-                yield Edge(int(raw_s[i]), int(raw_d[i]), vals[i])
 
-    def get_vertices(self) -> Iterator[Vertex]:
+        def batches():
+            for b in self.blocks():
+                src, dst, val = b.to_host()
+                raw_s = vdict.decode(src)
+                raw_d = vdict.decode(dst)
+                vals = _host_vals(val)
+                yield [
+                    Edge(int(s), int(d), v)
+                    for s, d, v in zip(raw_s.tolist(), raw_d.tolist(), vals)
+                ]
+
+        from .emission import EmissionStream
+
+        return EmissionStream(batches)
+
+    def get_vertices(self) -> "EmissionStream":
         """Distinct vertices, emitted on first appearance
-        (``SimpleEdgeStream.java:116-121,181-202``)."""
-        vdict = self._vdict
-        seen: set[int] = set()
-        for b in self.blocks():
-            src, dst, _ = b.to_host()
-            ids = np.stack([src, dst], axis=1).ravel() if len(src) else src
-            for c in ids.tolist():
-                r = int(vdict.decode_one(c))
-                if r not in seen:
-                    seen.add(r)
-                    yield Vertex(r, None)
+        (``SimpleEdgeStream.java:116-121,181-202``).
 
-    def _degree_stream(self, in_: bool, out: bool) -> Iterator[Tuple[int, int]]:
+        Vectorized: per window, a numpy first-occurrence pass against a
+        carried seen-mask, then one batched decode — no per-record Python.
+        """
+        vdict = self._vdict
+
+        def batches():
+            seen = np.zeros(0, bool)
+            for b in self.blocks():
+                src, dst, _ = b.to_host()
+                if len(src) == 0:
+                    yield []
+                    continue
+                if seen.size < b.n_vertices:
+                    seen = np.concatenate(
+                        [seen, np.zeros(b.n_vertices - seen.size, bool)]
+                    )
+                both = np.stack([src, dst], axis=1).ravel()
+                uniq, first = np.unique(both, return_index=True)
+                fresh = ~seen[uniq]
+                new_ids = uniq[fresh]
+                seen[new_ids] = True
+                # first-appearance (arrival) order, matching the reference
+                order = np.argsort(first[fresh], kind="stable")
+                raw = vdict.decode(new_ids[order])
+                yield [Vertex(int(r), None) for r in raw.tolist()]
+
+        from .emission import EmissionStream
+
+        return EmissionStream(batches)
+
+    def _degree_stream(self, in_: bool, out: bool) -> "EmissionStream":
         """Shared core of the degree streams (``SimpleEdgeStream.java:413-478``).
 
         Carried device state: an int32 degree vector over compact ids. Per
@@ -393,60 +421,64 @@ class SimpleEdgeStream(GraphStream):
         whose degree changed, with its new degree (change-only emission;
         per-record-identical at CountWindow(1)).
         """
-        from ..ops.segment import segment_count
-
         vdict = self._vdict
 
-        @jax.jit
-        def _update(deg: jax.Array, block: EdgeBlock) -> Tuple[jax.Array, jax.Array]:
-            V = deg.shape[0]
-            delta = jnp.zeros_like(deg)
-            if out:
-                delta = delta + segment_count(block.src, block.mask, V)
-            if in_:
-                delta = delta + segment_count(block.dst, block.mask, V)
-            return deg + delta, delta
+        def batches():
+            deg = jnp.zeros(0, dtype=jnp.int32)
+            for b in self.blocks():
+                if b.n_vertices > deg.shape[0]:
+                    deg = jnp.concatenate(
+                        [deg, jnp.zeros(b.n_vertices - deg.shape[0], jnp.int32)]
+                    )
+                deg, delta = _degree_update(deg, b, in_=in_, out=out)
+                changed = np.nonzero(np.asarray(delta))[0]
+                deg_h = np.asarray(deg)[changed]
+                raw = vdict.decode(changed)
+                yield list(zip(raw.tolist(), deg_h.tolist()))
 
-        deg = jnp.zeros(0, dtype=jnp.int32)
-        for b in self.blocks():
-            if b.n_vertices > deg.shape[0]:
-                deg = jnp.concatenate(
-                    [deg, jnp.zeros(b.n_vertices - deg.shape[0], jnp.int32)]
-                )
-            deg, delta = _update(deg, b)
-            delta_h = np.asarray(delta)
-            changed = np.nonzero(delta_h)[0]
-            deg_h = np.asarray(deg)
-            for c in changed.tolist():
-                yield int(vdict.decode_one(c)), int(deg_h[c])
+        from .emission import EmissionStream
 
-    def get_degrees(self) -> Iterator[Tuple[int, int]]:
+        return EmissionStream(batches)
+
+    def get_degrees(self) -> "EmissionStream":
         return self._degree_stream(in_=True, out=True)
 
-    def get_in_degrees(self) -> Iterator[Tuple[int, int]]:
+    def get_in_degrees(self) -> "EmissionStream":
         return self._degree_stream(in_=True, out=False)
 
-    def get_out_degrees(self) -> Iterator[Tuple[int, int]]:
+    def get_out_degrees(self) -> "EmissionStream":
         return self._degree_stream(in_=False, out=True)
 
-    def number_of_vertices(self) -> Iterator[int]:
+    def number_of_vertices(self) -> "EmissionStream":
         """Running distinct-vertex count, one emission per new vertex
         (``SimpleEdgeStream.java:366-383``, change-only via
         ``GlobalAggregateMapper`` ``:562-576``)."""
-        count = 0
-        for _ in self.get_vertices():
-            count += 1
-            yield count
+        from .emission import EmissionStream
 
-    def number_of_edges(self) -> Iterator[int]:
+        vertices = self.get_vertices()
+
+        def batches():
+            count = 0
+            for batch in vertices.batches():
+                k = len(batch)
+                yield range(count + 1, count + k + 1)
+                count += k
+
+        return EmissionStream(batches)
+
+    def number_of_edges(self) -> "EmissionStream":
         """Running edge count, one emission per edge
         (``SimpleEdgeStream.java:388-404``)."""
-        total = 0
-        for b in self.blocks():
-            n = int(np.asarray(b.mask).sum())
-            for i in range(1, n + 1):
-                yield total + i
-            total += n
+        from .emission import EmissionStream
+
+        def batches():
+            total = 0
+            for b in self.blocks():
+                n = int(np.asarray(b.mask).sum())
+                yield range(total + 1, total + n + 1)
+                total += n
+
+        return EmissionStream(batches)
 
     def global_aggregate(
         self,
@@ -539,6 +571,23 @@ class SimpleEdgeStream(GraphStream):
 # --------------------------------------------------------------------------- #
 # Helpers
 # --------------------------------------------------------------------------- #
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("in_", "out"))
+def _degree_update(deg: jax.Array, block: EdgeBlock, *, in_: bool, out: bool):
+    """One window's degree fold (module-level jit: the executable is shared
+    across streams and get_degrees() calls — a per-call closure would
+    recompile on every invocation)."""
+    from ..ops.segment import segment_count
+
+    V = deg.shape[0]
+    delta = jnp.zeros_like(deg)
+    if out:
+        delta = delta + segment_count(block.src, block.mask, V)
+    if in_:
+        delta = delta + segment_count(block.dst, block.mask, V)
+    return deg + delta, delta
 def _host_vals(val) -> list:
     """Convert a (possibly pytree) value batch to a list of python records."""
     leaves = jax.tree.leaves(val)

@@ -143,8 +143,19 @@ class VertexDict:
         return None
 
     def decode(self, idx: Iterable[int] | np.ndarray) -> np.ndarray:
-        rev = np.asarray(self._idx_to_raw, dtype=np.int64)
+        rev = self._rev_array()
         return rev[np.asarray(idx, dtype=np.int64)]
+
+    def _rev_array(self) -> np.ndarray:
+        """Reverse table as numpy, cached by dict size (converting the
+        python list costs ~0.1s/M entries — too much per emission batch)."""
+        n = len(self._idx_to_raw)
+        cached = getattr(self, "_rev_cache", None)
+        if cached is not None and cached.shape[0] == n:
+            return cached
+        rev = np.asarray(self._idx_to_raw, dtype=np.int64)
+        self._rev_cache = rev
+        return rev
 
     def decode_one(self, idx: int) -> int:
         return self._idx_to_raw[int(idx)]

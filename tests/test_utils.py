@@ -63,3 +63,45 @@ def test_signed_vertex_reverse():
     sv = SignedVertex(5, True)
     assert sv.reverse() == SignedVertex(5, False)
     assert sv.reverse().reverse() == sv
+
+
+def test_emission_stream_flat_and_batched_views():
+    from gelly_streaming_tpu.core.emission import EmissionStream
+    from gelly_streaming_tpu.utils.profiling import StreamProfiler
+
+    def batches():
+        yield [1, 2, 3]
+        yield []
+        yield [4, 5]
+
+    es = EmissionStream(batches)
+    assert list(es) == [1, 2, 3, 4, 5]
+    assert [list(b) for b in es.batches()] == [[1, 2, 3], [], [4, 5]]
+    # re-iterable (streams are lazily re-runnable)
+    assert list(es) == [1, 2, 3, 4, 5]
+    prof = StreamProfiler()
+    assert list(es.with_profiler(prof)) == [1, 2, 3, 4, 5]
+    assert len(prof.stats) == 3
+    assert [s.edges for s in prof.stats] == [3, 0, 2]
+
+
+def test_property_streams_are_emission_streams():
+    import numpy as np
+
+    from gelly_streaming_tpu import CountWindow, SimpleEdgeStream
+    from gelly_streaming_tpu.core.emission import EmissionStream
+
+    src = np.array([1, 2, 3, 1], np.int64)
+    dst = np.array([2, 3, 4, 3], np.int64)
+    s = SimpleEdgeStream((src, dst), window=CountWindow(2))
+    degrees = s.get_degrees()
+    assert isinstance(degrees, EmissionStream)
+    # batched view groups per window; flat view matches reference order
+    flat = list(degrees)
+    grouped = [list(b) for b in degrees.batches()]
+    assert flat == [x for b in grouped for x in b]
+    assert len(grouped) == 2
+    assert isinstance(s.get_vertices(), EmissionStream)
+    assert [v.id for v in s.get_vertices()] == [1, 2, 3, 4]
+    assert list(s.number_of_vertices()) == [1, 2, 3, 4]
+    assert list(s.number_of_edges()) == [1, 2, 3, 4]
