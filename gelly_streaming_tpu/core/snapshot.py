@@ -105,6 +105,23 @@ class SnapshotStream:
             )
             yield int(raw), rec
 
+    def _emit_pairs(self, vids: np.ndarray, result_h):
+        """Yield (raw_vertex_id, record) for pre-selected vertices whose
+        results are already host arrays aligned with ``vids``."""
+        raws = self._vdict.decode(vids).tolist()
+        leaves_are_struct = not isinstance(result_h, np.ndarray)
+        if not leaves_are_struct:
+            scalar = result_h.ndim == 1
+            for i, raw in enumerate(raws):
+                v = result_h[i]
+                yield int(raw), (v.item() if scalar else v)
+            return
+        for i, raw in enumerate(raws):
+            rec = jax.tree.map(
+                lambda a: a[i].item() if a[i].ndim == 0 else a[i], result_h
+            )
+            yield int(raw), rec
+
     # ------------------------------------------------------------------ #
     def fold_neighbors(self, initial_value: Any, fold_fn: Callable) -> Iterator[Tuple[int, Any]]:
         """Per-vertex arrival-order fold over the windowed neighborhood.
@@ -205,18 +222,33 @@ class SnapshotStream:
         (``SnapshotStream.java:129-181``).
 
         ``apply_fn(vertex_id, neighbor_ids[D], edge_values[D], valid[D]) ->
-        record`` is ``vmap``-ed over vertices; ``D`` is the (host-bucketed)
-        max degree of the window unless ``max_degree`` caps it. The UDF sees
-        raw ids and a validity mask instead of the reference's Iterable.
+        record`` is ``vmap``-ed over vertices. Vertices are processed in
+        DEGREE CLASSES (power-of-two buckets): each class materializes
+        dense rows only as wide as its own bucket, so a single Zipf hub no
+        longer sizes the rows for every vertex — the same skew defense as
+        the triangle kernels' orientation trick (``ops/triangles.py``).
+        Total dense work is ~sum_v bucket(deg v) <= ~4E. ``max_degree``
+        caps the row width instead (documented truncation policy: wider
+        neighborhoods are cut off). The UDF sees raw ids and a validity
+        mask instead of the reference's Iterable; emission is ascending by
+        vertex, as before.
         """
-        from ..ops.csr import build_csr, dense_neighbors
+        from ..ops.csr import build_csr, dense_neighbors, dense_neighbors_subset
 
         @jax.jit
         def _csr(block: EdgeBlock):
             key, nbr, val, mask = expand_direction(block, self.direction)
             return build_csr(key, nbr, val, mask, block.n_vertices)
 
-        def _window_fn(D: int):
+        def _class_fn(D: int):
+            @jax.jit
+            def _window(csr, raw, vids):
+                nbr_mat, val_mat, valid = dense_neighbors_subset(csr, vids, D)
+                return jax.vmap(apply_fn)(raw[vids], raw[nbr_mat], val_mat, valid)
+
+            return _window
+
+        def _capped_fn(D: int):
             @jax.jit
             def _window(csr, raw):
                 nbr_mat, val_mat, valid = dense_neighbors(csr, D)
@@ -227,15 +259,47 @@ class SnapshotStream:
 
             return _window
 
-        cache: dict[int, Callable] = {}
+        cache: dict = {}
         for b in self._block_iter_fn():
             csr = _csr(b)
             if max_degree is not None:
-                D = max_degree
-            else:
-                D = bucket_capacity(max(1, int(np.asarray(csr.degree).max(initial=0))), 4)
-            fn = cache.get(D)
-            if fn is None:
-                fn = cache[D] = _window_fn(D)
-            result, nonempty = fn(csr, self._raw32())
-            yield from self._emit(result, nonempty)
+                fn = cache.get(("cap", max_degree))
+                if fn is None:
+                    fn = cache[("cap", max_degree)] = _capped_fn(max_degree)
+                result, nonempty = fn(csr, self._raw32())
+                yield from self._emit(result, nonempty)
+                continue
+            deg = np.asarray(csr.degree)
+            active = np.nonzero(deg > 0)[0]
+            if active.size == 0:
+                continue
+            # group active vertices by degree bucket; rows per class are
+            # only as wide as that class's bucket
+            buckets = np.int64(1) << np.ceil(
+                np.log2(np.maximum(deg[active], 1))
+            ).astype(np.int64)
+            buckets = np.maximum(buckets, 4)
+            pieces = []  # (vids, result_tree) per class
+            for c in np.unique(buckets):
+                vids = active[buckets == c]
+                t = len(vids)
+                tcap = bucket_capacity(t, 4)
+                vids_p = np.concatenate(
+                    [vids, np.full(tcap - t, vids[0], vids.dtype)]
+                ).astype(np.int32)
+                key = ("class", int(c), tcap)
+                fn = cache.get(key)
+                if fn is None:
+                    fn = cache[key] = _class_fn(int(c))
+                out = fn(csr, self._raw32(), jnp.asarray(vids_p))
+                out_h = jax.tree.map(lambda a: np.asarray(a)[:t], out)
+                pieces.append((vids, out_h))
+            # merge classes back into ascending-vertex emission order
+            all_vids = np.concatenate([p[0] for p in pieces])
+            merged = jax.tree.map(
+                lambda *leaves: np.concatenate(leaves), *[p[1] for p in pieces]
+            )
+            order = np.argsort(all_vids, kind="stable")
+            yield from self._emit_pairs(
+                all_vids[order], jax.tree.map(lambda a: a[order], merged)
+            )

@@ -78,6 +78,25 @@ def dense_neighbors(csr: CSR, max_degree: int) -> Tuple[jax.Array, Any, jax.Arra
     return nbr_mat, val_mat, valid
 
 
+def dense_neighbors_subset(
+    csr: CSR, vids: jax.Array, max_degree: int
+) -> Tuple[jax.Array, Any, jax.Array]:
+    """Padded neighbor rows for SELECTED vertices only: ``[T, D]``.
+
+    The degree-class path of ``apply_on_neighbors``: vertices are grouped
+    by degree bucket and each class materializes rows only as wide as its
+    own bucket, so one hub no longer sizes the whole window's dense rows
+    (total work sum_v bucket(deg v) <= ~4E instead of V * max_degree).
+    """
+    starts = csr.row_ptr[vids]
+    idx = starts[:, None] + jnp.arange(max_degree)[None, :]
+    valid = idx < csr.row_ptr[vids + 1][:, None]
+    idx = jnp.clip(idx, 0, csr.sorted_key.shape[0] - 1)
+    nbr_mat = csr.sorted_nbr[idx]
+    val_mat = jax.tree.map(lambda a: a[idx], csr.sorted_val)
+    return nbr_mat, val_mat, valid
+
+
 def sorted_neighbor_matrix(csr: CSR, max_degree: int) -> Tuple[jax.Array, jax.Array]:
     """Neighbor rows sorted ascending within each row (for intersections).
 

@@ -152,3 +152,30 @@ def test_slice_event_time_requires_timestamp_fn():
     stream = SimpleEdgeStream([(1, 2, 0.0)], window=CountWindow(2))
     with pytest.raises(ValueError, match="timestamp_fn"):
         list(stream.slice(window=EventTimeWindow(10)).reduce_on_edges("sum"))
+
+
+def test_apply_on_neighbors_hub_degree_classes():
+    """A Zipf hub no longer sizes every vertex's dense rows: the degree-
+    class path computes the same results as a flat dense pass."""
+    import numpy as np
+
+    # hub 0 with 300 leaves + a torso of degree-1..3 vertices
+    src = [0] * 300 + [1000, 1001, 1002, 1001]
+    dst = list(range(1, 301)) + [2000, 2001, 2002, 2003]
+    edges = list(zip(src, dst))
+    stream = SimpleEdgeStream(edges, window=CountWindow(len(edges)))
+    snap = stream.slice(direction=EdgeDirection.OUT)
+
+    def degree_udf(vid, nbrs, vals, valid):
+        return valid.sum()
+
+    got = {v: int(r) for v, r in snap.apply_on_neighbors(degree_udf)}
+    assert got[0] == 300
+    assert got[1000] == 1 and got[1001] == 2 and got[1002] == 1
+    # emission stays ascending by vertex
+    assert list(got.keys()) == sorted(got.keys())
+    # max_degree cap: documented truncation policy
+    capped = {v: int(r) for v, r in stream.slice(
+        direction=EdgeDirection.OUT
+    ).apply_on_neighbors(degree_udf, max_degree=8)}
+    assert capped[0] == 8 and capped[1001] == 2
