@@ -1,3 +1,4 @@
+from .gcn import gcn_forward, gcn_layer, init_gcn
 from .graphsage import (
     StreamingGraphSAGE,
     init_graphsage,
@@ -6,4 +7,9 @@ from .graphsage import (
     sage_forward,
     sage_layer,
 )
-from .gcn import gcn_forward, gcn_layer, init_gcn
+from .training import (
+    make_sharded_train_step as make_gnn_train_step,
+    mse_loss,
+    shard_gnn_params,
+    softmax_xent_loss,
+)

@@ -10,6 +10,7 @@ plain-pytree params, bf16-in/f32-accumulate matmuls.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List
 
 import jax
@@ -51,10 +52,18 @@ def gcn_layer(params, h, src, dst, mask, *, activation=jax.nn.relu):
     return activation(out).astype(h.dtype)
 
 
-def gcn_forward(params_stack, h, src, dst, mask):
-    """Full model: all layers, last layer linear."""
+def gcn_forward(params_stack, h, src, dst, mask, *, remat: bool = False):
+    """Full model: all layers, last layer linear.
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint`` — activations
+    rematerialize in the backward pass, trading FLOPs for HBM on deep
+    stacks (the [V, F] activations dominate memory).
+    """
     n = len(params_stack)
     for i, p in enumerate(params_stack):
         act = jax.nn.relu if i < n - 1 else (lambda x: x)
-        h = gcn_layer(p, h, src, dst, mask, activation=act)
+        layer = functools.partial(gcn_layer, activation=act)
+        if remat:
+            layer = jax.checkpoint(layer, static_argnums=())
+        h = layer(p, h, src, dst, mask)
     return h

@@ -91,12 +91,18 @@ def sage_layer(
     return activation(out).astype(h.dtype)
 
 
-def sage_forward(params_stack, h, src, dst, mask):
-    """Full model: all layers, last layer linear (no activation)."""
+def sage_forward(params_stack, h, src, dst, mask, *, remat: bool = False):
+    """Full model: all layers, last layer linear (no activation).
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint`` (rematerialize
+    activations in backward — HBM for FLOPs on deep stacks)."""
     n = len(params_stack)
     for i, p in enumerate(params_stack):
         act = jax.nn.relu if i < n - 1 else (lambda x: x)
-        h = sage_layer(p, h, src, dst, mask, activation=act)
+        layer = functools.partial(sage_layer, activation=act)
+        if remat:
+            layer = jax.checkpoint(layer)
+        h = layer(p, h, src, dst, mask)
     return h
 
 
@@ -106,53 +112,23 @@ def _forward_jit(params_stack, h, src, dst, mask):
 
 
 def make_sharded_train_step(mesh, lr=1e-2):
-    """Build a jitted multi-chip training step: DP over the edge axis, TP
-    over the output-feature dimension of every weight.
+    """Build a jitted multi-chip SAGE training step (round-1 signature):
+    DP over the edge axis, TP over the output-feature dimension.
 
     Returns ``(step_fn, shard_params_fn)``; ``step_fn(params, h, src, dst,
-    mask, targets) -> (params, loss)``. Shardings are expressed as
-    ``NamedSharding`` constraints so XLA inserts the psum/all-gathers.
+    mask, targets) -> (params, loss)``. Thin wrapper over the generic
+    :func:`gelly_streaming_tpu.models.training.make_sharded_train_step`
+    (which adds optax optimizers, other losses, and remat).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .training import make_sharded_train_step as make_generic
 
-    from ..parallel.mesh import EDGE_AXIS, MODEL_AXIS
+    step, shard_params, _ = make_generic(mesh, sage_forward, lr=lr)
 
-    wsh = NamedSharding(mesh, P(None, MODEL_AXIS))
-    bsh = NamedSharding(mesh, P(MODEL_AXIS))
-    esh = NamedSharding(mesh, P(EDGE_AXIS))
-    rep = NamedSharding(mesh, P())
-
-    def shard_params(params_stack):
-        return [
-            {
-                "w_self": jax.device_put(p["w_self"], wsh),
-                "w_nbr": jax.device_put(p["w_nbr"], wsh),
-                "b": jax.device_put(p["b"], bsh),
-            }
-            for p in params_stack
-        ]
-
-    def loss_fn(params, h, src, dst, mask, targets):
-        out = sage_forward(params, h, src, dst, mask)
-        return jnp.mean((out - targets.astype(out.dtype)) ** 2)
-
-    @jax.jit
-    def step(params, h, src, dst, mask, targets):
-        h = jax.lax.with_sharding_constraint(h, rep)
-        src = jax.lax.with_sharding_constraint(src, esh)
-        dst = jax.lax.with_sharding_constraint(dst, esh)
-        mask = jax.lax.with_sharding_constraint(mask, esh)
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, h, src, dst, mask, targets
-        )
-        params = jax.tree.map(
-            lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(p.dtype),
-            params,
-            grads,
-        )
+    def step_compat(params, h, src, dst, mask, targets):
+        params, _, loss = step(params, None, h, src, dst, mask, targets)
         return params, loss
 
-    return step, shard_params
+    return step_compat, shard_params
 
 
 class StreamingGraphSAGE:

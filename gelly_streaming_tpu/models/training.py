@@ -1,0 +1,120 @@
+"""Shared sharded training for the GNN families (GraphSAGE + GCN).
+
+One generic step builder covers what ``models/graphsage.py`` round-1 did
+for SAGE only (ROADMAP #6 / round-1 verdict weak item #7): DP over the
+mesh ``"edges"`` axis for the edge messages, TP over the output-feature
+dimension of every weight, expressed as ``NamedSharding`` constraints so
+XLA inserts the psums/all-gathers on ICI. New here:
+
+- works for any layer-stack forward with the ``(params_stack, h, src,
+  dst, mask)`` signature (both families, plus user models of that shape);
+- optional **optax** optimizer (full ``GradientTransformation`` support;
+  plain SGD remains the no-dependency default);
+- optional per-layer ``jax.checkpoint`` rematerialization for deep stacks
+  (``remat=True`` forwarded to the model's forward).
+
+Parameters stay bf16; optimizer math runs in f32 master copies inside the
+step and re-casts, the standard mixed-precision recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mse_loss(out, targets):
+    return jnp.mean((out.astype(jnp.float32) - targets.astype(jnp.float32)) ** 2)
+
+
+def softmax_xent_loss(out, targets):
+    """``targets`` are int class ids over the vertex axis."""
+    logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, targets[:, None], axis=-1))
+
+
+def shard_gnn_params(params_stack, mesh):
+    """Place a layer stack on the mesh: 2-D weights split over the output
+    feature dimension (TP, ``"model"`` axis), 1-D biases likewise."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MODEL_AXIS
+
+    wsh = NamedSharding(mesh, P(None, MODEL_AXIS))
+    bsh = NamedSharding(mesh, P(MODEL_AXIS))
+
+    def place(leaf):
+        return jax.device_put(leaf, wsh if leaf.ndim == 2 else bsh)
+
+    return jax.tree.map(place, params_stack)
+
+
+def make_sharded_train_step(
+    mesh,
+    forward_fn: Callable,
+    *,
+    lr: float = 1e-2,
+    optimizer: Optional[Any] = None,
+    loss_fn: Callable = mse_loss,
+    remat: bool = False,
+) -> Tuple[Callable, Callable, Callable]:
+    """Build a jitted multi-chip training step for a GNN layer stack.
+
+    Returns ``(step_fn, shard_params_fn, init_opt_fn)``:
+
+    - ``step_fn(params, opt_state, h, src, dst, mask, targets) ->
+      (params, opt_state, loss)``;
+    - ``shard_params_fn(params) -> params`` placed on the mesh;
+    - ``init_opt_fn(params) -> opt_state`` (``None``-state for plain SGD).
+
+    ``optimizer`` is any optax ``GradientTransformation``; when omitted,
+    plain SGD with ``lr`` runs without the optax dependency.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import EDGE_AXIS
+
+    esh = NamedSharding(mesh, P(EDGE_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def shard_params(params_stack):
+        return shard_gnn_params(params_stack, mesh)
+
+    def init_opt(params_stack):
+        if optimizer is None:
+            return None
+        f32 = jax.tree.map(lambda p: p.astype(jnp.float32), params_stack)
+        return optimizer.init(f32)
+
+    def full_loss(params, h, src, dst, mask, targets):
+        out = forward_fn(params, h, src, dst, mask, remat=remat)
+        return loss_fn(out, targets)
+
+    @jax.jit
+    def step(params, opt_state, h, src, dst, mask, targets):
+        h = jax.lax.with_sharding_constraint(h, rep)
+        src = jax.lax.with_sharding_constraint(src, esh)
+        dst = jax.lax.with_sharding_constraint(dst, esh)
+        mask = jax.lax.with_sharding_constraint(mask, esh)
+        loss, grads = jax.value_and_grad(full_loss)(
+            params, h, src, dst, mask, targets
+        )
+        if optimizer is None:
+            params = jax.tree.map(
+                lambda p, g: (
+                    p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+                ).astype(p.dtype),
+                params,
+                grads,
+            )
+            return params, opt_state, loss
+        f32 = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates, opt_state = optimizer.update(g32, opt_state, f32)
+        f32 = jax.tree.map(lambda p, u: p + u, f32, updates)
+        params = jax.tree.map(lambda p, q: p.astype(q.dtype), f32, params)
+        return params, opt_state, loss
+
+    return step, shard_params, init_opt

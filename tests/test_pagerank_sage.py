@@ -222,3 +222,70 @@ def test_gcn_layer_matches_dense_reference():
 
     out = gcn_forward(init_gcn(jax.random.PRNGKey(1), [F, 8, O], jnp.float32), h, src, dst, mask)
     assert out.shape == (V, O)
+
+
+def test_gcn_sharded_train_step_with_optax_and_remat():
+    """Generic train step: GCN family, optax adam, per-layer remat, on the
+    8-device mesh — loss decreases and matches the unsharded step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from gelly_streaming_tpu.models import init_gcn, gcn_forward
+    from gelly_streaming_tpu.models.training import make_sharded_train_step
+    from gelly_streaming_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    V, E, F = 64, 256, 16
+    src = jnp.asarray(rng.integers(0, V, E), jnp.int32)
+    dst = jnp.asarray(rng.integers(0, V, E), jnp.int32)
+    mask = jnp.ones(E, bool)
+    h = jnp.asarray(rng.normal(size=(V, F)), jnp.bfloat16)
+    targets = jnp.asarray(rng.normal(size=(V, 8)), jnp.float32)
+
+    mesh = make_mesh(4, 2)
+    params = init_gcn(jax.random.PRNGKey(0), [F, 32, 8])
+    step, shard, init_opt = make_sharded_train_step(
+        mesh, gcn_forward, optimizer=optax.adam(1e-2), remat=True
+    )
+    params = shard(params)
+    opt_state = init_opt(params)
+    losses = []
+    for _ in range(12):
+        params, opt_state, loss = step(
+            params, opt_state, h, src, dst, mask, targets
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+    # plain-SGD path still works and needs no opt state
+    step2, shard2, init2 = make_sharded_train_step(mesh, gcn_forward, lr=1e-2)
+    p2 = shard2(init_gcn(jax.random.PRNGKey(0), [F, 32, 8]))
+    assert init2(p2) is None
+    p2, _, l0 = step2(p2, None, h, src, dst, mask, targets)
+    p2, _, l1 = step2(p2, None, h, src, dst, mask, targets)
+    assert float(l1) < float(l0)
+
+
+def test_remat_forward_matches_plain():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gelly_streaming_tpu.models import gcn_forward, init_gcn, sage_forward, init_graphsage
+
+    rng = np.random.default_rng(1)
+    V, E, F = 32, 100, 8
+    src = jnp.asarray(rng.integers(0, V, E), jnp.int32)
+    dst = jnp.asarray(rng.integers(0, V, E), jnp.int32)
+    mask = jnp.ones(E, bool)
+    h = jnp.asarray(rng.normal(size=(V, F)), jnp.float32)
+    for init, fwd in [
+        (init_gcn, gcn_forward),
+        (init_graphsage, sage_forward),
+    ]:
+        params = init(jax.random.PRNGKey(2), [F, 16, 4], dtype=jnp.float32)
+        a = fwd(params, h, src, dst, mask)
+        b = fwd(params, h, src, dst, mask, remat=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
