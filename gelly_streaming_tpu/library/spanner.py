@@ -91,30 +91,58 @@ class DeviceSpanner:
     set snapshot per window; ``edges()`` returns the current set (raw
     ids)."""
 
-    def __init__(self, k: int, query_chunk: int = 1024):
+    def __init__(
+        self,
+        k: int,
+        query_chunk: int = 1024,
+        mem_budget_entries: int = 1 << 28,
+    ):
         self.k = k
         self.query_chunk = query_chunk
+        #: bound on the [B, V] frontier footprint: the per-window query
+        #: batch shrinks as the vertex capacity grows, so corpus-scale
+        #: vertex counts cost more dispatches instead of exploding HBM
+        #: (round-1 weak item: B fixed at 1024 made the frontier O(B*V)).
+        self.mem_budget_entries = mem_budget_entries
         self._su = np.zeros(0, np.int32)  # spanner edges, compact canonical
         self._sv = np.zeros(0, np.int32)
+        self._have = np.zeros(0, np.int64)  # sorted canonical keys
+        self._have_vcap = 0
         self._vdict = None
+
+    def _batch_cap(self, vcap: int) -> int:
+        b = max(8, min(self.query_chunk, self.mem_budget_entries // max(vcap, 1)))
+        return bucket_capacity(b) // 2 if bucket_capacity(b) > b else b
 
     def run(self, stream) -> Iterator[Set[Tuple[int, int]]]:
         self._vdict = stream.vertex_dict
         for block in stream.blocks():
             s, d, _ = block.to_host()
             vcap = block.n_vertices
+            if vcap != self._have_vcap:
+                # key space changed with the capacity bucket: re-key
+                self._have = np.sort(
+                    self._su.astype(np.int64) * vcap
+                    + self._sv.astype(np.int64)
+                )
+                self._have_vcap = vcap
             u = np.minimum(s, d).astype(np.int64)
             v = np.maximum(s, d).astype(np.int64)
             ok = u != v
             u, v = u[ok], v[ok]
             if u.size:
                 # in-window dedup (order does not matter for the batch
-                # decision) + drop edges already in the spanner
+                # decision) + drop edges already in the spanner (carried
+                # sorted key set, merged incrementally — no per-window
+                # rebuild of the whole spanner's keys)
                 key = np.unique(u * vcap + v)
-                have = np.unique(
-                    self._su.astype(np.int64) * vcap + self._sv.astype(np.int64)
+                pos = np.searchsorted(self._have, key)
+                pos_c = np.minimum(pos, max(len(self._have) - 1, 0))
+                dup = (
+                    (self._have[pos_c] == key) if len(self._have)
+                    else np.zeros(len(key), bool)
                 )
-                key = key[~np.isin(key, have, assume_unique=True)]
+                key = key[~dup]
                 u = (key // vcap).astype(np.int32)
                 v = (key % vcap).astype(np.int32)
             if u.size == 0:
@@ -131,9 +159,10 @@ class DeviceSpanner:
             smask[: 2 * ns] = True
             spj, sqj, smj = jnp.asarray(sp), jnp.asarray(sq), jnp.asarray(smask)
             keep_u, keep_v = [], []
-            for a in range(0, len(u), self.query_chunk):
-                b = min(a + self.query_chunk, len(u))
-                qcap = bucket_capacity(b - a, minimum=min(self.query_chunk, 8))
+            batch = self._batch_cap(vcap)
+            for a in range(0, len(u), batch):
+                b = min(a + batch, len(u))
+                qcap = bucket_capacity(b - a, minimum=min(batch, 8))
                 uq = np.zeros(qcap, np.int32)
                 vq = np.zeros(qcap, np.int32)
                 mq = np.zeros(qcap, bool)
@@ -150,6 +179,13 @@ class DeviceSpanner:
                 keep_v.append(v[a:b][~reached])
             self._su = np.concatenate([self._su, *keep_u])
             self._sv = np.concatenate([self._sv, *keep_v])
+            new_keys = (
+                np.concatenate(keep_u).astype(np.int64) * vcap
+                + np.concatenate(keep_v).astype(np.int64)
+            )
+            if new_keys.size:
+                ins = np.searchsorted(self._have, np.sort(new_keys))
+                self._have = np.insert(self._have, ins, np.sort(new_keys))
             yield self.edges()
 
     def state_dict(self) -> dict:
@@ -158,6 +194,8 @@ class DeviceSpanner:
 
     def load_state_dict(self, d: dict) -> None:
         self._su, self._sv = d["su"], d["sv"]
+        self._have = np.zeros(0, np.int64)
+        self._have_vcap = 0
 
     def edges(self) -> Set[Tuple[int, int]]:
         """Current spanner edges as raw-id pairs."""

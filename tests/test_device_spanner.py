@@ -93,3 +93,30 @@ def test_device_spanner_drops_redundant_edges():
     for out in sp.run(SimpleEdgeStream(edges, window=CountWindow(1))):
         pass
     assert sp.edges() == {(1, 2), (2, 3)}
+
+
+def test_memory_budget_shrinks_query_batches():
+    """The frontier footprint stays within the budget: a tiny budget
+    forces small batches but the spanner result is unchanged."""
+    import numpy as np
+
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library.spanner import DeviceSpanner
+
+    rng = np.random.default_rng(8)
+    src = rng.integers(0, 120, 600)
+    dst = rng.integers(0, 120, 600)
+
+    def final(budget):
+        s = SimpleEdgeStream((src, dst), window=CountWindow(100))
+        sp = DeviceSpanner(k=3, mem_budget_entries=budget)
+        out = None
+        for out in sp.run(s):
+            pass
+        return sp, out
+
+    sp_small, small = final(budget=1 << 11)   # ~16 queries per batch
+    sp_big, big = final(budget=1 << 28)
+    assert sp_small._batch_cap(128) < sp_big._batch_cap(128)
+    assert small == big
