@@ -278,6 +278,15 @@ class SummaryTreeReduce(SummaryAggregation):
 
     def __init__(self, transient_state: bool = False, mesh=None, degree: int = 2):
         super().__init__(transient_state=transient_state, mesh=mesh)
+        if degree != 2:
+            import warnings
+
+            warnings.warn(
+                f"SummaryTreeReduce degree={degree} is accepted for API "
+                "parity only: the ppermute butterfly's fan-in is fixed at "
+                "2 (which the reference's enhance() also degenerates to, "
+                "SummaryTreeReduce.java:95-123); the value has no effect"
+            )
         self.degree = degree
 
     def _is_tree(self) -> bool:

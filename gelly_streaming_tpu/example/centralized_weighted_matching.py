@@ -27,17 +27,34 @@ def run(edges, output_path: Optional[str] = None):
 
 
 def main(args: List[str]) -> None:
+    if args and args[0] == "--movielens":
+        # the reference's dataset for this workload
+        # (CentralizedWeightedMatching.java:41-44 reads movielens_10k_sorted):
+        # real u.data under $GELLY_DATA/./data when present, else the
+        # cached surrogate
+        from .. import datasets
+
+        path = args[1] if len(args) > 1 else datasets.ensure_corpus(
+            "movielens-100k"
+        )[0]
+        u, i, r = datasets.load_movielens(path)
+        run(zip(u.tolist(), i.tolist(), r.tolist()))
+        return
     if args:
         if len(args) not in (1, 2):
             print(
-                "Usage: centralized_weighted_matching <input edges path> "
-                "[output path]"
+                "Usage: centralized_weighted_matching "
+                "[--movielens [u.data path] | <input edges path> "
+                "[output path]]"
             )
             return
         edges = read_edges(args[0], n_fields=3)
         run(edges, args[1] if len(args) > 1 else None)
     else:
-        usage("centralized_weighted_matching", "<input edges path> [output path]")
+        usage(
+            "centralized_weighted_matching",
+            "[--movielens [u.data path] | <input edges path> [output path]]",
+        )
         run([(1, 2, 10.0), (2, 3, 25.0), (3, 4, 15.0)])
 
 

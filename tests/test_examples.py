@@ -149,3 +149,39 @@ def test_graphsage_example(tmp_path):
 def test_examples_no_args_use_defaults(capsys):
     connected_components.main([])
     assert "Usage" in capsys.readouterr().out
+
+
+def test_matching_movielens_mode(tmp_path, monkeypatch, capsys):
+    """--movielens runs the reference's dataset workload end to end
+    (CentralizedWeightedMatching.java:41-44, runtime printout :62-64)."""
+    import numpy as np
+
+    from gelly_streaming_tpu import native
+    from gelly_streaming_tpu.example import centralized_weighted_matching as ex
+
+    p = tmp_path / "u.data"
+    rng = np.random.default_rng(3)
+    with open(p, "w") as f:
+        for _ in range(200):
+            f.write(
+                f"{rng.integers(1, 50)}\t{rng.integers(1, 80)}\t"
+                f"{rng.integers(1, 6)}\t0\n"
+            )
+    ex.main(["--movielens", str(p)])
+    out = capsys.readouterr().out
+    assert "Matching weight:" in out and "Runtime:" in out
+
+
+def test_tree_reduce_degree_warns():
+    import warnings
+
+    from gelly_streaming_tpu.library import ConnectedComponentsTree
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ConnectedComponentsTree(degree=4)
+    assert any("fan-in" in str(x.message) for x in w)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ConnectedComponentsTree()
+    assert not w
