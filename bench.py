@@ -361,6 +361,16 @@ def main():
              "import bench; from gelly_streaming_tpu import datasets; "
              f"r = bench.bench_cc_e2e({binp!r}, lambda: datasets.IdentityDict({bound}), {n_edges}); "
              "print(r['eps'])"),
+            ("e2e_device_encode_eps",
+             "import bench, time; from gelly_streaming_tpu import datasets; "
+             "from gelly_streaming_tpu.core.window import CountWindow; "
+             "from gelly_streaming_tpu.library import ConnectedComponents\n"
+             "def one():\n"
+             f"    s = datasets.stream_file({binp!r}, window=CountWindow(bench.WINDOW), device_encode=True, min_vertex_capacity={bound})\n"
+             "    t0 = time.perf_counter()\n"
+             "    for _ in s.aggregate(ConnectedComponents()): pass\n"
+             f"    return {n_edges} / (time.perf_counter() - t0)\n"
+             "one(); print(one())"),
             ("kernel_cc_eps",
              f"import bench; s,d=bench.make_stream({n_vertices},{n_e}); "
              f"print(bench.bench_cc_kernel(s,d,{n_vertices},{window}))"),

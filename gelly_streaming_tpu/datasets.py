@@ -293,6 +293,53 @@ def iter_binary_chunks(bin_path: str, chunk_edges: int = 1 << 21):
 # --------------------------------------------------------------------- #
 # File -> stream
 # --------------------------------------------------------------------- #
+def _device_encoded_blocks(path, is_binary, size, vdict, chunk_edges):
+    """CountWindow blocks whose vertex mapping runs ON DEVICE: host work
+    is slicing raw columns and device puts; the compaction is the carried
+    device hash table (``ops/device_dict.py``)."""
+    import jax.numpy as jnp
+
+    from .core.edgeblock import EdgeBlock, _cached_mask, _cached_zeros
+    from .core.edgeblock import bucket_capacity as bcap
+
+    def emit(s, d):
+        n = len(s)
+        si, di = vdict.encode_pair(s, d)
+        cap = bcap(n)
+        if cap != n:
+            si = jnp.pad(si, (0, cap - n))
+            di = jnp.pad(di, (0, cap - n))
+        return EdgeBlock(
+            src=si, dst=di, val=_cached_zeros(cap, jnp.float32),
+            mask=_cached_mask(cap, n), n_vertices=vdict.capacity,
+        )
+
+    src = iter_binary_chunks(path, size) if is_binary else native.iter_edge_chunks(
+        path, chunk_edges
+    )
+    pend_s, pend_d, have = [], [], 0
+    for s, d, v in src:
+        if v is not None:
+            raise ValueError(
+                "device_encode does not carry edge values yet; use the "
+                "host ingest path for weighted streams"
+            )
+        pend_s.append(np.asarray(s))
+        pend_d.append(np.asarray(d))
+        have += len(s)
+        while have >= size:
+            cs = np.concatenate(pend_s) if len(pend_s) > 1 else pend_s[0]
+            cd = np.concatenate(pend_d) if len(pend_d) > 1 else pend_d[0]
+            yield emit(cs[:size], cd[:size])
+            pend_s, pend_d = [cs[size:]], [cd[size:]]
+            have -= size
+    if have:
+        cs = np.concatenate(pend_s) if len(pend_s) > 1 else pend_s[0]
+        cd = np.concatenate(pend_d) if len(pend_d) > 1 else pend_d[0]
+        if len(cs):
+            yield emit(cs, cd)
+
+
 def stream_file(
     path: str,
     window: Optional[WindowPolicy] = None,
@@ -301,6 +348,7 @@ def stream_file(
     chunk_edges: int = 1 << 21,
     prefetch_depth: int = 0,
     min_vertex_capacity: int = 0,
+    device_encode: bool = False,
 ) -> SimpleEdgeStream:
     """A :class:`SimpleEdgeStream` over an edge file, chunk-parsed natively.
 
@@ -311,10 +359,34 @@ def stream_file(
     state compiles once instead of once per capacity-growth bucket.
     """
     policy = window or CountWindow(1 << 20)
+    is_binary = path.endswith(".gbin")
+    if device_encode:
+        # vertex compaction as device state: one encode dispatch per
+        # window, no host hash work (ROADMAP #1). CountWindow only.
+        if not isinstance(policy, CountWindow):
+            raise ValueError("device_encode supports CountWindow streams")
+        if vertex_dict is not None:
+            raise ValueError(
+                "device_encode builds its own DeviceVertexDict; a supplied "
+                "vertex_dict would be silently ignored"
+            )
+        from .ops.device_dict import DeviceVertexDict
+
+        # min_vertex_capacity doubles as the raw id bound here: dense-id
+        # corpora declare their space, so the table never grows or syncs
+        vd = DeviceVertexDict(
+            min_capacity=max(min_vertex_capacity, 1 << 10),
+            id_bound=min_vertex_capacity,
+        )
+        return SimpleEdgeStream(
+            _blocks=lambda: _device_encoded_blocks(
+                path, is_binary, policy.size, vd, chunk_edges
+            ),
+            _vdict=vd,
+        )
     if vertex_dict is None and min_vertex_capacity > 0:
         vertex_dict = VertexDict(min_capacity=min_vertex_capacity)
     windower = Windower(policy, vertex_dict)
-    is_binary = path.endswith(".gbin")
 
     def block_source():
         vd = windower.vertex_dict

@@ -132,7 +132,14 @@ class Components:
             labels_dev, touched_dev, n, vdict = self._lazy
             labels = np.asarray(labels_dev)
             touched = np.asarray(touched_dev)
-            idx = np.nonzero(touched[:n])[0]
+            if n is None:
+                # deferred dict-size read (device dicts: len() syncs the
+                # pipeline, so it must happen at materialization, not at
+                # emission). Safe because `touched` was snapshotted with
+                # the labels: vertices first seen after this window are
+                # False there, so a larger n admits nothing extra.
+                n = len(vdict)
+            idx = np.nonzero(touched[: min(n, touched.shape[0])])[0]
             lab = labels[idx]
             raw = vdict.decode(idx)
             order = np.argsort(lab, kind="stable")
@@ -145,11 +152,12 @@ class Components:
 
     @staticmethod
     def from_labels(state: Dict[str, jax.Array], vdict) -> "Components":
-        """Lazy view over the label table: snapshots the dict SIZE now
-        (the dict itself is append-only, so compact ids < n stay stable
-        even if the stream runs ahead) and defers the device sync."""
+        """Lazy view over the label table: defers BOTH the device sync and
+        the dict-size read to materialization (``len()`` on a device-
+        resident dict would sync the pipeline every window; the snapshotted
+        ``touched`` mask makes the later, larger size equivalent)."""
         return Components(
-            _lazy=(state["labels"], state["touched"], len(vdict), vdict)
+            _lazy=(state["labels"], state["touched"], None, vdict)
         )
 
     def num_components(self) -> int:

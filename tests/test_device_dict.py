@@ -1,0 +1,110 @@
+"""Device vertex dictionary: first-seen equivalence with the host dict."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.vertexdict import VertexDict
+from gelly_streaming_tpu.ops.device_dict import DeviceVertexDict
+
+
+def test_encode_matches_host_dict_first_seen_order():
+    rng = np.random.default_rng(4)
+    host = VertexDict()
+    dev = DeviceVertexDict(min_capacity=16)  # force growth along the way
+    for _ in range(6):
+        batch = rng.integers(0, 800, rng.integers(3, 500))
+        a = host.encode(batch)
+        b = dev.encode(batch)
+        np.testing.assert_array_equal(a, b)
+    assert len(host) == len(dev)
+    np.testing.assert_array_equal(host.raw_ids(), dev.raw_ids())
+
+
+def test_encode_pair_matches_host_pair():
+    rng = np.random.default_rng(5)
+    host = VertexDict()
+    dev = DeviceVertexDict(min_capacity=16)
+    for _ in range(4):
+        n = int(rng.integers(5, 300))
+        s = rng.integers(0, 500, n)
+        d = rng.integers(0, 500, n)
+        hs, hd = host.encode_pair(s, d)
+        ds, dd = dev.encode_pair(s, d)
+        np.testing.assert_array_equal(hs, np.asarray(ds))
+        np.testing.assert_array_equal(hd, np.asarray(dd))
+    np.testing.assert_array_equal(host.raw_ids(), dev.raw_ids())
+
+
+def test_decode_and_lookup():
+    dev = DeviceVertexDict(min_capacity=16)
+    out = dev.encode(np.array([42, 7, 42, 99], np.int64))
+    assert out.tolist() == [0, 1, 0, 2]
+    assert dev.decode(np.array([0, 1, 2])).tolist() == [42, 7, 99]
+    assert dev.lookup(7) == 1
+    assert dev.lookup(12345) is None
+    assert len(dev) == 3
+
+
+def test_adversarial_collisions_single_batch():
+    """Many ids hashing into a small table in one batch: claims, losses,
+    and probe chains all in one encode call."""
+    dev = DeviceVertexDict(min_capacity=16)
+    host = VertexDict()
+    batch = np.concatenate([np.arange(200), np.arange(200), [5, 5, 5]])
+    np.testing.assert_array_equal(host.encode(batch), dev.encode(batch))
+
+
+def test_stream_file_device_encode_cc(tmp_path):
+    import numpy as np
+
+    from gelly_streaming_tpu import datasets, native
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    rng = np.random.default_rng(6)
+    src = rng.integers(0, 400, 5000)
+    dst = rng.integers(0, 400, 5000)
+    p = tmp_path / "g.txt"
+    native.write_edge_file(str(p), src, dst)
+
+    def comps(**kw):
+        s = datasets.stream_file(str(p), window=CountWindow(700), **kw)
+        last = None
+        for last in s.aggregate(ConnectedComponents()):
+            pass
+        return sorted(last.component_sets())
+
+    assert comps(device_encode=True) == comps()
+
+
+def test_id_bound_violation_raises():
+    dev = DeviceVertexDict(min_capacity=16, id_bound=16)
+    with pytest.raises(ValueError, match="dense-id"):
+        dev.encode(np.arange(40))
+    with pytest.raises(ValueError, match="dense-id"):
+        dev.encode_pair(np.array([3]), np.array([99]))
+
+
+def test_stream_file_device_encode_guards(tmp_path):
+    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu.core.window import CountWindow, EventTimeWindow
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+
+    p = tmp_path / "g.txt"
+    p.write_text("1 2\n")
+    with pytest.raises(ValueError, match="vertex_dict"):
+        datasets.stream_file(
+            str(p), window=CountWindow(4), device_encode=True,
+            vertex_dict=VertexDict(),
+        )
+    with pytest.raises(ValueError, match="CountWindow"):
+        datasets.stream_file(
+            str(p), window=EventTimeWindow(10, timestamp_fn=lambda e: e[2]),
+            device_encode=True,
+        )
+    # weighted stream on the device path: loud error, not silent zeros
+    pw = tmp_path / "w.txt"
+    pw.write_text("1 2 0.5\n")
+    s = datasets.stream_file(str(pw), window=CountWindow(4), device_encode=True)
+    with pytest.raises(ValueError, match="edge values"):
+        list(s.blocks())
