@@ -30,6 +30,30 @@ from ..utils.profiling import StreamProfiler, WindowStats
 T = TypeVar("T")
 
 
+class ColumnBatch:
+    """One window's emissions backed by column arrays.
+
+    Iterating yields per-record tuples (API parity); bulk consumers read
+    ``.columns`` directly and skip the 4M-tuple object churn of a large
+    window entirely."""
+
+    __slots__ = ("columns",)
+
+    def __init__(self, *columns):
+        self.columns = columns
+
+    def __len__(self) -> int:
+        return len(self.columns[0])
+
+    def __iter__(self):
+        return zip(
+            *(
+                c.tolist() if hasattr(c, "tolist") else c
+                for c in self.columns
+            )
+        )
+
+
 class EmissionStream:
     """Re-iterable stream of emissions with a per-window batch view."""
 

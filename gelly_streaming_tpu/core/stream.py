@@ -478,9 +478,9 @@ class SimpleEdgeStream(GraphStream):
                 changed = np.nonzero(np.asarray(delta))[0]
                 deg_h = np.asarray(deg)[changed]
                 raw = vdict.decode(changed)
-                yield list(zip(raw.tolist(), deg_h.tolist()))
+                yield ColumnBatch(raw, deg_h)
 
-        from .emission import EmissionStream
+        from .emission import ColumnBatch, EmissionStream
 
         return EmissionStream(batches)
 

@@ -302,42 +302,71 @@ def _device_encoded_blocks(path, is_binary, size, vdict, chunk_edges):
     from .core.edgeblock import EdgeBlock, _cached_mask, _cached_zeros
     from .core.edgeblock import bucket_capacity as bcap
 
-    def emit(s, d):
+    def emit(s, d, v):
         n = len(s)
         si, di = vdict.encode_pair(s, d)
         cap = bcap(n)
         if cap != n:
             si = jnp.pad(si, (0, cap - n))
             di = jnp.pad(di, (0, cap - n))
+        if v is None:
+            val = _cached_zeros(cap, jnp.float32)
+        else:
+            vp = np.zeros(cap, np.float32)
+            vp[:n] = v
+            val = jnp.asarray(vp)
         return EdgeBlock(
-            src=si, dst=di, val=_cached_zeros(cap, jnp.float32),
+            src=si, dst=di, val=val,
             mask=_cached_mask(cap, n), n_vertices=vdict.capacity,
         )
 
     src = iter_binary_chunks(path, size) if is_binary else native.iter_edge_chunks(
         path, chunk_edges
     )
-    pend_s, pend_d, have = [], [], 0
+    pend, have = [], 0
     for s, d, v in src:
-        if v is not None:
-            raise ValueError(
-                "device_encode does not carry edge values yet; use the "
-                "host ingest path for weighted streams"
-            )
-        pend_s.append(np.asarray(s))
-        pend_d.append(np.asarray(d))
+        pend.append((np.asarray(s), np.asarray(d), v))
         have += len(s)
         while have >= size:
-            cs = np.concatenate(pend_s) if len(pend_s) > 1 else pend_s[0]
-            cd = np.concatenate(pend_d) if len(pend_d) > 1 else pend_d[0]
-            yield emit(cs[:size], cd[:size])
-            pend_s, pend_d = [cs[size:]], [cd[size:]]
+            if len(pend) == 1:
+                cs, cd, cv = pend[0]
+            else:
+                cs = np.concatenate([p[0] for p in pend])
+                cd = np.concatenate([p[1] for p in pend])
+                cv = (
+                    np.concatenate(
+                        [
+                            np.zeros(len(p[0]), np.float32) if p[2] is None
+                            else np.asarray(p[2], np.float32)
+                            for p in pend
+                        ]
+                    )
+                    if any(p[2] is not None for p in pend)
+                    else None
+                )
+            yield emit(
+                cs[:size], cd[:size], None if cv is None else cv[:size]
+            )
+            pend = [(cs[size:], cd[size:], None if cv is None else cv[size:])]
             have -= size
     if have:
-        cs = np.concatenate(pend_s) if len(pend_s) > 1 else pend_s[0]
-        cd = np.concatenate(pend_d) if len(pend_d) > 1 else pend_d[0]
+        cs, cd, cv = pend[0] if len(pend) == 1 else (
+            np.concatenate([p[0] for p in pend]),
+            np.concatenate([p[1] for p in pend]),
+            (
+                np.concatenate(
+                    [
+                        np.zeros(len(p[0]), np.float32) if p[2] is None
+                        else np.asarray(p[2], np.float32)
+                        for p in pend
+                    ]
+                )
+                if any(p[2] is not None for p in pend)
+                else None
+            ),
+        )
         if len(cs):
-            yield emit(cs, cd)
+            yield emit(cs, cd, cv)
 
 
 def stream_file(

@@ -102,9 +102,9 @@ def test_stream_file_device_encode_guards(tmp_path):
             str(p), window=EventTimeWindow(10, timestamp_fn=lambda e: e[2]),
             device_encode=True,
         )
-    # weighted stream on the device path: loud error, not silent zeros
+    # weighted streams carry their value column through the device path
     pw = tmp_path / "w.txt"
-    pw.write_text("1 2 0.5\n")
+    pw.write_text("1 2 0.5\n3 4 1.5\n")
     s = datasets.stream_file(str(pw), window=CountWindow(4), device_encode=True)
-    with pytest.raises(ValueError, match="edge values"):
-        list(s.blocks())
+    edges = sorted((e.src, e.dst, e.val) for e in s.get_edges())
+    assert edges == [(1, 2, 0.5), (3, 4, 1.5)]

@@ -105,3 +105,18 @@ def test_property_streams_are_emission_streams():
     assert [v.id for v in s.get_vertices()] == [1, 2, 3, 4]
     assert list(s.number_of_vertices()) == [1, 2, 3, 4]
     assert list(s.number_of_edges()) == [1, 2, 3, 4]
+
+
+def test_degree_batches_are_column_backed():
+    import numpy as np
+
+    from gelly_streaming_tpu import CountWindow, SimpleEdgeStream
+    from gelly_streaming_tpu.core.emission import ColumnBatch
+
+    s = SimpleEdgeStream(
+        (np.array([1, 2, 3]), np.array([2, 3, 4])), window=CountWindow(3)
+    )
+    batches = list(s.get_degrees().batches())
+    assert all(isinstance(b, ColumnBatch) for b in batches)
+    raw, deg = batches[0].columns
+    assert list(zip(raw.tolist(), deg.tolist())) == list(batches[0])
