@@ -20,22 +20,70 @@ from .common import default_chain_edges, read_edges, run_main, usage, write_line
 
 def run(edges, window_size: int, output_path: Optional[str] = None):
     stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
+    return _drain(stream, output_path)
+
+
+def _drain(stream, output_path: Optional[str] = None):
+    import time
+
     last = None
+    t0 = time.perf_counter()
     for comps in stream.aggregate(ConnectedComponents()):
         last = comps
+    runtime_ms = (time.perf_counter() - t0) * 1000
     lines = [
         f"{root}={members}"
         for root, members in sorted(last.components.items())
     ] if last else []
     write_lines(output_path, lines)
+    print(f"Runtime: {runtime_ms:.1f}")
+    return last
+
+
+def run_corpus(
+    name_or_path: str,
+    window_size: int = 1 << 20,
+    device_encode: bool = False,
+    id_bound: int = 0,
+):
+    """Stream a BASELINE corpus (by registry name or file path) through
+    the flagship workload — the measured end-to-end path of bench.py as a
+    runnable CLI. ``device_encode`` moves the vertex mapping onto the
+    accelerator (dense-id corpora; pass the id bound)."""
+    from .. import datasets
+
+    if name_or_path in datasets.CORPORA:
+        path, is_real = datasets.ensure_corpus(name_or_path)
+        print(f"corpus: {path} ({'real' if is_real else 'surrogate'})")
+    else:
+        path = name_or_path
+    kw = {}
+    if device_encode:
+        kw = dict(device_encode=True, min_vertex_capacity=id_bound)
+    stream = datasets.stream_file(
+        path, window=CountWindow(window_size), **kw
+    )
+    last = _drain(stream)
+    if last is not None:
+        print(f"components: {len(last.components)}")
     return last
 
 
 def main(args: List[str]) -> None:
+    if args and args[0] == "--corpus":
+        # connected_components --corpus livejournal [window] [--device-encode id_bound]
+        rest = args[1:]
+        name = rest[0] if rest else "livejournal"
+        window = int(rest[1]) if len(rest) > 1 and rest[1].isdigit() else 1 << 20
+        dev = "--device-encode" in rest
+        bound = int(rest[rest.index("--device-encode") + 1]) if dev else 0
+        run_corpus(name, window, device_encode=dev, id_bound=bound)
+        return
     if args:
         if len(args) not in (2, 3):
             print(
-                "Usage: connected_components <input edges path> "
+                "Usage: connected_components [--corpus <name|path> [window] "
+                "[--device-encode <id bound>]] | <input edges path> "
                 "<merge window size (edges)> [output path]"
             )
             return
@@ -44,6 +92,7 @@ def main(args: List[str]) -> None:
     else:
         usage(
             "connected_components",
+            "[--corpus <name|path> [window] [--device-encode <id bound>]] | "
             "<input edges path> <merge window size (edges)> [output path]",
         )
         run(default_chain_edges(), 100)

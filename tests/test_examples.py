@@ -185,3 +185,23 @@ def test_tree_reduce_degree_warns():
         warnings.simplefilter("always")
         ConnectedComponentsTree()
     assert not w
+
+
+def test_cc_corpus_mode(tmp_path, capsys):
+    """--corpus drives the measured end-to-end path as a CLI."""
+    import numpy as np
+
+    from gelly_streaming_tpu import native
+    from gelly_streaming_tpu.example import connected_components as ex
+
+    rng = np.random.default_rng(2)
+    p = tmp_path / "c.txt"
+    native.write_edge_file(
+        str(p), rng.integers(0, 100, 800), rng.integers(0, 100, 800)
+    )
+    ex.main(["--corpus", str(p), "200"])
+    out = capsys.readouterr().out
+    assert "Runtime:" in out and "components:" in out
+    ex.main(["--corpus", str(p), "200", "--device-encode", "128"])
+    out = capsys.readouterr().out
+    assert "components:" in out
