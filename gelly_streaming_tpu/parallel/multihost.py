@@ -74,6 +74,45 @@ def global_edge_block(mesh, local_arrays: Sequence[np.ndarray]):
     return tuple(out)
 
 
+def global_block(mesh, local_block):
+    """Assemble a globally-sharded EdgeBlock from each host's local block.
+
+    Every host passes the block holding ITS shard of the window (equal
+    capacities everywhere; vertex mappings must agree across hosts — use
+    a pre-partitioned/dense id scheme, see the module docstring). The
+    result is an EdgeBlock of global ``jax.Array``s sharded over the mesh
+    edge axis, consumable by the engine's sharded window step directly.
+    """
+    import numpy as np
+
+    from ..core.edgeblock import EdgeBlock
+
+    s, d, v, m = (
+        np.asarray(local_block.src),
+        np.asarray(local_block.dst),
+        np.asarray(local_block.val),
+        np.asarray(local_block.mask),
+    )
+    gs, gd, gv, gm = global_edge_block(mesh, [s, d, v, m])
+    return EdgeBlock(
+        src=gs, dst=gd, val=gv, mask=gm,
+        n_vertices=local_block.n_vertices,
+    )
+
+
+def globalize_stream(stream, mesh):
+    """A stream whose windows are the global assembly of every host's
+    local windows — the ingest contract for running the aggregation
+    engine itself multi-process (each host windows its own shard; the
+    engine's shard_map programs see one global block per window)."""
+    from ..core.stream import SimpleEdgeStream
+
+    return SimpleEdgeStream(
+        _blocks=lambda: (global_block(mesh, b) for b in stream.blocks()),
+        _vdict=stream.vertex_dict,
+    )
+
+
 def is_coordinator() -> bool:
     """True on the process that should own singleton side effects
     (emission files, checkpoint writes) — the JobManager analog."""

@@ -86,4 +86,35 @@ labels = np.asarray(jax.device_get(summary["labels"]))
 touched = np.asarray(jax.device_get(summary["touched"]))
 assert labels[:5].tolist() == [0, 0, 0, 0, 0], labels
 assert touched.tolist() == [True] * 5 + [False] * 3, touched
+
+# ---- the aggregation ENGINE itself across both processes: each host
+# windows its own shard (dense ids -> identical mapping everywhere), the
+# globalized stream feeds the engine's sharded window step ---------------
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream, StreamContext  # noqa: E402
+from gelly_streaming_tpu.core.window import CountWindow  # noqa: E402
+from gelly_streaming_tpu.datasets import IdentityDict  # noqa: E402
+from gelly_streaming_tpu.library import ConnectedComponents  # noqa: E402
+
+if proc_id == 0:
+    esrc = np.array([0, 1, 6, 6], np.int64)
+    edst = np.array([1, 2, 6, 6], np.int64)
+else:
+    esrc = np.array([3, 2, 6, 6], np.int64)
+    edst = np.array([4, 3, 6, 6], np.int64)
+# identical dense mapping on every host (no cross-host dict coordination)
+from gelly_streaming_tpu.core.window import Windower  # noqa: E402
+
+w = Windower(CountWindow(4), IdentityDict(8))
+local = SimpleEdgeStream(
+    _blocks=lambda: (b for _, b in w.blocks_from_chunks([(esrc, edst)])),
+    _vdict=w.vertex_dict,
+    context=StreamContext(mesh=mesh),
+)
+gstream = multihost.globalize_stream(local, mesh)
+agg = ConnectedComponents(mesh=mesh)
+last = None
+for last in agg.run(gstream):
+    pass
+sets = sorted(last.component_sets())
+assert sets == [frozenset({0, 1, 2, 3, 4}), frozenset({6})], sets
 print(f"MP_OK {labels.tolist()}", flush=True)
