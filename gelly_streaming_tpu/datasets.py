@@ -320,8 +320,12 @@ def _device_encoded_blocks(path, is_binary, size, vdict, chunk_edges):
             mask=_cached_mask(cap, n), n_vertices=vdict.capacity,
         )
 
-    src = iter_binary_chunks(path, size) if is_binary else native.iter_edge_chunks(
-        path, chunk_edges
+    src = (
+        iter_binary_chunks(path, size)
+        if is_binary
+        else native.iter_edge_chunks_i32(
+            path, chunk_edges, id_bound=getattr(vdict, "id_bound", 0)
+        )
     )
     pend, have = [], 0
     for s, d, v in src:
@@ -432,9 +436,10 @@ def stream_file(
                 )
             pairs = windower.blocks_from_chunks(chunks, encoded=True)
         elif identity:
-            chunks = (
-                (vd.encode(s), vd.encode(d), v)
-                for s, d, v in native.iter_edge_chunks(path, chunk_edges)
+            # the i32 parser already bound-checks against the id space, so
+            # the columns pass through with no further validation/convert
+            chunks = native.iter_edge_chunks_i32(
+                path, chunk_edges, id_bound=vd.id_bound
             )
             pairs = windower.blocks_from_chunks(chunks, encoded=True)
         elif getattr(vd, "_native", None) is not None:

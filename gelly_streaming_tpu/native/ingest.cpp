@@ -382,6 +382,48 @@ int64_t reader_next_encoded(void* ptr, void* enc_ptr, int32_t* src32,
                             int64_t* novel_out, int64_t* n_novel_out,
                             int32_t* has_val, int32_t* at_eof_out);
 
+// int32-direct span parse for dense-id corpora: writes int32 columns
+// (half the memory traffic of the int64 path, no convert pass) and counts
+// ids outside [0, id_bound) (bound 0 = only require int32 range) so the
+// caller can reject bad corpora instead of truncating silently.
+int64_t reader_next_span_i32(void* ptr, int32_t* src, int32_t* dst,
+                             double* val, int64_t cap, int64_t id_bound,
+                             int32_t* has_val, int32_t* at_eof_out,
+                             int64_t* oob_out) {
+    SpanReader* r = (SpanReader*)ptr;
+    bool at_eof = false;
+    *at_eof_out = 0;
+    *has_val = 0;
+    *oob_out = 0;
+    const char* end = nullptr;
+    int64_t span = reader_fill(r, &end, &at_eof);
+    if (span < 0) return -1;
+    if (span == 0) {
+        if (at_eof) *at_eof_out = 1;
+        return 0;
+    }
+    const char* p = r->buf;
+    int64_t n = 0, oob = 0;
+    int64_t bound = id_bound > 0 ? id_bound : (int64_t)1 << 31;
+    int64_t s, d; double v; bool h;
+    bool any_val = false;
+    while (p < end && n < cap) {
+        if (parse_line_fast(p, end, &s, &d, &v, &h)) {
+            oob += (s < 0) | (s >= bound) | (d < 0) | (d >= bound);
+            src[n] = (int32_t)s;
+            dst[n] = (int32_t)d;
+            val[n] = v;
+            any_val |= h;
+            ++n;
+        }
+    }
+    r->offset += p - r->buf;
+    if (at_eof && r->offset >= r->size) *at_eof_out = 1;
+    *has_val = any_val ? 1 : 0;
+    *oob_out = oob;
+    return n;
+}
+
 // Fast tab-separated edge-file writer (for corpus synthesis at scale —
 // np.savetxt measures ~0.5M edges/s; this runs ~100x that across cores).
 // Appends when append != 0. Returns 0, or -1 on IO error.

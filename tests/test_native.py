@@ -144,3 +144,15 @@ def test_chunked_iteration_rejects_oversized_line(tmp_path):
         f.write("3 4\n")
     with pytest.raises(IOError):
         list(native.iter_edge_chunks(str(p), chunk_edges=2))
+
+
+def test_i32_chunks_match_and_bound_check(tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("# c\n1 2\n3 4 0.5\n70000 5\n")
+    a = list(native.iter_edge_chunks(str(p)))
+    b = list(native.iter_edge_chunks_i32(str(p)))
+    assert a[0][0].tolist() == b[0][0].tolist()
+    assert b[0][0].dtype == np.int32
+    np.testing.assert_allclose(a[0][2], b[0][2])
+    with pytest.raises(ValueError, match="dense-id"):
+        list(native.iter_edge_chunks_i32(str(p), id_bound=100))
