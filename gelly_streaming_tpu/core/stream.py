@@ -131,6 +131,7 @@ class SimpleEdgeStream(GraphStream):
         edges: Optional[Iterable[Tuple]] = None,
         window: Optional[WindowPolicy] = None,
         context: Optional[StreamContext] = None,
+        vertex_dict: Optional[VertexDict] = None,
         *,
         _blocks: Optional[Callable[[], Iterator[EdgeBlock]]] = None,
         _vdict: Optional[VertexDict] = None,
@@ -144,7 +145,7 @@ class SimpleEdgeStream(GraphStream):
             if edges is None:
                 raise ValueError("either edges or _blocks must be given")
             policy = window or self.context.default_window
-            windower = Windower(policy)
+            windower = Windower(policy, vertex_dict)
             self._vdict = windower.vertex_dict
             edges_it = edges
             is_cols = isinstance(edges, np.ndarray) or (
