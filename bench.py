@@ -255,6 +255,26 @@ def bench_cc_kernel(src, dst, n_vertices: int, window: int) -> float:
     return n_win * window / dt
 
 
+def bench_degrees_e2e(bin_path: str, bound: int, n_edges: int) -> float:
+    """BASELINE config #1 end-to-end: binary corpus -> stream ->
+    continuous degree emission (batched view consumed per window)."""
+    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu.core.window import CountWindow
+
+    def one_pass():
+        stream = datasets.stream_file(
+            bin_path, window=CountWindow(WINDOW),
+            vertex_dict=datasets.IdentityDict(bound),
+        )
+        t0 = time.perf_counter()
+        for _ in stream.get_degrees().batches():
+            pass
+        return n_edges / (time.perf_counter() - t0)
+
+    one_pass()
+    return one_pass()
+
+
 # --------------------------------------------------------------------- #
 # Config #1: continuous degree aggregate
 # --------------------------------------------------------------------- #
@@ -442,6 +462,8 @@ def main():
             ("degrees_eps",
              f"import bench; s,d=bench.make_stream({n_vertices},{n_e}); "
              f"print(bench.bench_degrees(s,d,{n_vertices},{window}))"),
+            ("degrees_e2e_eps",
+             f"import bench; print(bench.bench_degrees_e2e({binp!r}, {bound}, {n_edges}))"),
             ("window_triangles_eps",
              "import bench; print(bench.bench_window_triangles())"),
             ("pagerank_eps", "import bench; print(bench.bench_pagerank())"),
