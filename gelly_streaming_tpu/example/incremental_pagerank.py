@@ -23,12 +23,43 @@ def run(edges, window_size: int, output_path: Optional[str] = None):
     return pr
 
 
+def run_corpus(name_or_path: str, window_size: int = 1 << 18):
+    """Rank a BASELINE corpus (registry name or edge file) end to end."""
+    import time
+
+    from .. import datasets
+
+    if name_or_path in datasets.CORPORA:
+        path, is_real = datasets.ensure_corpus(name_or_path)
+        print(f"corpus: {path} ({'real' if is_real else 'surrogate'})")
+    else:
+        path = name_or_path
+    stream = datasets.stream_file(path, window=CountWindow(window_size))
+    pr = IncrementalPageRank()
+    t0 = time.perf_counter()
+    for _ in pr.run(stream):
+        pass
+    ranks = pr.ranks()  # materializes (syncs) the final fixpoint
+    print(f"Runtime: {(time.perf_counter() - t0) * 1000:.1f}")
+    top = sorted(ranks.items(), key=lambda kv: -kv[1])[:10]
+    for v, r in top:
+        print(f"({v},{r:.6f})")
+    return pr
+
+
 def main(args: List[str]) -> None:
+    if args and args[0] == "--corpus":
+        rest = args[1:]
+        name = rest[0] if rest else "livejournal"
+        window = int(rest[1]) if len(rest) > 1 else 1 << 18
+        run_corpus(name, window)
+        return
     if args:
         if len(args) not in (2, 3):
             print(
-                "Usage: incremental_pagerank <input edges path> "
-                "<window size (edges)> [output path]"
+                "Usage: incremental_pagerank [--corpus <name|path> "
+                "[window]] | <input edges path> <window size (edges)> "
+                "[output path]"
             )
             return
         edges = read_edges(args[0])
@@ -36,7 +67,8 @@ def main(args: List[str]) -> None:
     else:
         usage(
             "incremental_pagerank",
-            "<input edges path> <window size (edges)> [output path]",
+            "[--corpus <name|path> [window]] | <input edges path> "
+            "<window size (edges)> [output path]",
         )
         run(default_chain_edges(), 25)
 

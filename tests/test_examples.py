@@ -205,3 +205,19 @@ def test_cc_corpus_mode(tmp_path, capsys):
     ex.main(["--corpus", str(p), "200", "--device-encode", "128"])
     out = capsys.readouterr().out
     assert "components:" in out
+
+
+def test_pagerank_corpus_mode(tmp_path, capsys):
+    import numpy as np
+
+    from gelly_streaming_tpu import native
+    from gelly_streaming_tpu.example import incremental_pagerank as ex
+
+    rng = np.random.default_rng(4)
+    p = tmp_path / "p.txt"
+    native.write_edge_file(
+        str(p), rng.integers(0, 60, 400), rng.integers(0, 60, 400)
+    )
+    ex.main(["--corpus", str(p), "100"])
+    out = capsys.readouterr().out
+    assert "Runtime:" in out and out.count("(") >= 10
