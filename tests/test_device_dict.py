@@ -108,3 +108,55 @@ def test_stream_file_device_encode_guards(tmp_path):
     s = datasets.stream_file(str(pw), window=CountWindow(4), device_encode=True)
     edges = sorted((e.src, e.dst, e.val) for e in s.get_edges())
     assert edges == [(1, 2, 0.5), (3, 4, 1.5)]
+
+
+def test_device_encoded_blocks_under_sharded_engine(tmp_path):
+    """Device-encoded blocks feed the mesh-sharded engine unchanged."""
+    import numpy as np
+
+    from gelly_streaming_tpu import datasets, native
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream, StreamContext
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+    from gelly_streaming_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(9)
+    p = tmp_path / "g.txt"
+    native.write_edge_file(
+        str(p), rng.integers(0, 300, 4000), rng.integers(0, 300, 4000)
+    )
+    plain = datasets.stream_file(str(p), window=CountWindow(512))
+    want = None
+    for want in plain.aggregate(ConnectedComponents()):
+        pass
+    dev = datasets.stream_file(
+        str(p), window=CountWindow(512), device_encode=True,
+        min_vertex_capacity=512,
+    )
+    sharded = SimpleEdgeStream(
+        _blocks=dev._block_source, _vdict=dev.vertex_dict,
+        context=StreamContext(mesh=make_mesh(8)),
+    )
+    got = None
+    for got in sharded.aggregate(ConnectedComponents()):
+        pass
+    assert sorted(got.component_sets()) == sorted(want.component_sets())
+
+
+def test_device_dict_checkpoint_interop(tmp_path):
+    """A device-dict checkpoint restores into the host dict with the same
+    mapping (raw_ids carries the first-seen order)."""
+    import numpy as np
+
+    from gelly_streaming_tpu.aggregate import checkpoint
+
+    dev = DeviceVertexDict(min_capacity=64)
+    rng = np.random.default_rng(10)
+    for _ in range(3):
+        dev.encode(rng.integers(0, 500, 200))
+    path = str(tmp_path / "ck")
+    checkpoint.save_vertex_dict(path, dev)
+    host = checkpoint.load_vertex_dict(path)
+    np.testing.assert_array_equal(host.raw_ids(), dev.raw_ids())
+    probe = np.array([dev.raw_ids()[5], 99999], np.int64)
+    assert host.encode(probe)[0] == 5
