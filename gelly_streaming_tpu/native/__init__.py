@@ -327,23 +327,55 @@ def cc_baseline(
     return ns / 1e9, int(comps.value)
 
 
+_LINE_RE = None
+
+
 def _parse_python(path: str):
-    """Numpy fallback when no C++ toolchain is available."""
+    """Numpy fallback when no C++ toolchain is available.
+
+    Mirrors the C grammar char-for-char (prefix number parsing, not token
+    splitting): two integers separated by space/tab/comma runs, trailing
+    junk after a number tolerated, an unparseable THIRD column leaves the
+    edge valid with value 0 (the strtod-failure behavior). Never raises
+    on noise — the fuzz suite holds the two parsers byte-equivalent."""
+    global _LINE_RE
+    import re
+
+    if _LINE_RE is None:
+        _LINE_RE = (
+            re.compile(r"^[ \t,\r]*([+-]?\d+)[ \t,\r]+([+-]?\d+)(.*)$"),
+            re.compile(r"^[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?"),
+        )
+    line_re, float_re = _LINE_RE
     srcs, dsts, vals = [], [], []
     any_val = False
     with open(path) as f:
         for line in f:
-            parts = line.replace(",", " ").split()
-            if len(parts) < 2 or parts[0][0] in "#%":
+            stripped = line.lstrip(" \t,\r")
+            if not stripped or stripped[0] in "#%\n":
                 continue
-            srcs.append(int(parts[0]))
-            dsts.append(int(parts[1]))
-            if len(parts) > 2:
-                any_val = True
-                t = parts[2]
-                vals.append(1.0 if t == "+" else -1.0 if t == "-" else float(t))
-            else:
-                vals.append(0.0)
+            m = line_re.match(line.rstrip("\n"))
+            if not m:
+                continue
+            srcs.append(int(m.group(1)))
+            dsts.append(int(m.group(2)))
+            rest = m.group(3).lstrip(" \t,\r")
+            v = 0.0
+            if rest:
+                c0 = rest[0]
+                follows = rest[1:2]
+                if c0 == "+" and follows in ("", " ", "\t", "\r"):
+                    v = 1.0
+                    any_val = True
+                elif c0 == "-" and follows in ("", " ", "\t", "\r"):
+                    v = -1.0
+                    any_val = True
+                else:
+                    fm = float_re.match(rest)
+                    if fm:
+                        v = float(fm.group(0))
+                        any_val = True
+            vals.append(v)
     src = np.asarray(srcs, np.int64)
     dst = np.asarray(dsts, np.int64)
     return src, dst, (np.asarray(vals, np.float64) if any_val else None)

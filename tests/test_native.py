@@ -156,3 +156,41 @@ def test_i32_chunks_match_and_bound_check(tmp_path):
     np.testing.assert_allclose(a[0][2], b[0][2])
     with pytest.raises(ValueError, match="dense-id"):
         list(native.iter_edge_chunks_i32(str(p), id_bound=100))
+
+
+def test_parser_fuzz_matches_python_fallback(tmp_path):
+    """Random byte soup + structured noise: the C parser must never crash,
+    must terminate, and must extract the same edges as the Python
+    fallback (grammar oracle)."""
+    rng = np.random.default_rng(123)
+    tokens = [
+        "12 34", "5\t6", "7,8", "#x", "%y", "", " ", "9 10 1.5", "11 12 +",
+        "13 14 -", "-1 -2", "99999999999 1", "3 4 abc", "a b", "5", "6 7 8 9",
+        "0 0", "  15  16  ", "\t", "17 18 -0.25",
+    ]
+    for trial in range(8):
+        n = int(rng.integers(5, 120))
+        lines = [tokens[i] for i in rng.integers(0, len(tokens), n)]
+        body = "\n".join(lines)
+        if rng.random() < 0.5:
+            body += "\n"
+        if rng.random() < 0.3:
+            body += tokens[int(rng.integers(0, len(tokens)))]  # ragged tail
+        p = tmp_path / f"fuzz{trial}.txt"
+        p.write_text(body)
+        ns, nd, nv = native.parse_edge_file(str(p))
+        ps, pd, pv = native._parse_python(str(p))
+        assert ns.tolist() == ps.tolist(), body
+        assert nd.tolist() == pd.tolist(), body
+        if pv is None:
+            assert nv is None or not len(nv)
+        else:
+            np.testing.assert_allclose(nv, pv)
+        # chunked i32 (with its fast path) agrees wherever ids are dense
+        if len(ps) and ps.min() >= 0 and pd.min() >= 0 and max(
+            ps.max(), pd.max()
+        ) < 2**31:
+            cs = np.concatenate(
+                [c[0] for c in native.iter_edge_chunks_i32(str(p), 16)]
+            ) if len(ps) else np.zeros(0, np.int32)
+            assert cs.tolist() == ps.tolist(), body
