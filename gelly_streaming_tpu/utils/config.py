@@ -33,11 +33,28 @@ class EngineConfig:
     capacity: Optional[int] = None
     #: edge-axis shards for the device mesh (None = all devices)
     edge_shards: Optional[int] = None
+    #: run the vertex mapping on the accelerator (dense-id corpora;
+    #: requires ``id_bound``) — see ``datasets.stream_file``
+    device_encode: bool = False
+    #: raw id-space bound for identity/device vertex mappings (0 = general
+    #: host dictionary)
+    id_bound: int = 0
 
     def window(self, timestamp_fn=None) -> WindowPolicy:
         if self.window_time is not None:
             return EventTimeWindow(self.window_time, timestamp_fn=timestamp_fn)
         return CountWindow(self.window_size)
+
+    def open_stream(self, path: str):
+        """``datasets.stream_file`` with this config's ingest knobs."""
+        from .. import datasets
+
+        kw = {}
+        if self.device_encode:
+            kw = dict(device_encode=True, min_vertex_capacity=self.id_bound)
+        elif self.id_bound:
+            kw = dict(vertex_dict=datasets.IdentityDict(self.id_bound))
+        return datasets.stream_file(path, window=self.window(), **kw)
 
     @staticmethod
     def add_args(parser: argparse.ArgumentParser) -> None:
@@ -48,6 +65,8 @@ class EngineConfig:
         g.add_argument("--tree-degree", type=int, default=2)
         g.add_argument("--capacity", type=int, default=None)
         g.add_argument("--edge-shards", type=int, default=None)
+        g.add_argument("--device-encode", action="store_true")
+        g.add_argument("--id-bound", type=int, default=0)
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "EngineConfig":
@@ -58,4 +77,6 @@ class EngineConfig:
             tree_degree=ns.tree_degree,
             capacity=ns.capacity,
             edge_shards=ns.edge_shards,
+            device_encode=ns.device_encode,
+            id_bound=ns.id_bound,
         )

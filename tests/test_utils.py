@@ -120,3 +120,37 @@ def test_degree_batches_are_column_backed():
     assert all(isinstance(b, ColumnBatch) for b in batches)
     raw, deg = batches[0].columns
     assert list(zip(raw.tolist(), deg.tolist())) == list(batches[0])
+
+
+def test_engine_config_ingest_knobs(tmp_path):
+    import argparse
+
+    import numpy as np
+
+    from gelly_streaming_tpu import native
+    from gelly_streaming_tpu.library import ConnectedComponents
+    from gelly_streaming_tpu.utils.config import EngineConfig
+
+    p = tmp_path / "g.txt"
+    native.write_edge_file(
+        str(p), np.array([0, 1, 5]), np.array([1, 2, 6])
+    )
+    parser = argparse.ArgumentParser()
+    EngineConfig.add_args(parser)
+    cfg = EngineConfig.from_args(
+        parser.parse_args(
+            ["--window-size", "2", "--device-encode", "--id-bound", "8"]
+        )
+    )
+    stream = cfg.open_stream(str(p))
+    last = None
+    for last in stream.aggregate(ConnectedComponents()):
+        pass
+    assert sorted(last.component_sets()) == sorted(
+        [frozenset({0, 1, 2}), frozenset({5, 6})]
+    )
+    # identity mode without device encoding
+    cfg2 = EngineConfig(window_size=2, id_bound=8)
+    stream2 = cfg2.open_stream(str(p))
+    got = [c for c in stream2.aggregate(ConnectedComponents())][-1]
+    assert sorted(got.component_sets()) == sorted(last.component_sets())
