@@ -37,8 +37,8 @@ import numpy as np
 from ..core.edgeblock import bucket_capacity
 from ..core.window import CountWindow, WindowPolicy, Windower
 from ..ops.triangles import (
-    ranked_triangle_update,
-    sorted_ranked_rows,
+    merge_packed_adjacency,
+    packed_triangle_update,
     window_triangle_count,
 )
 
@@ -51,53 +51,43 @@ def _pad(a: np.ndarray, cap: int) -> np.ndarray:
     return out
 
 
+def _pad_fill(a: np.ndarray, cap: int, fill) -> np.ndarray:
+    out = np.full(cap, fill, a.dtype)
+    out[: len(a)] = a
+    return out
+
+
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def _window_step(src, dst, mask, num_vertices: int, max_degree: int):
     return window_triangle_count(src, dst, mask, num_vertices, max_degree)
-
-
-@functools.partial(jax.jit, static_argnums=(4, 5))
-def _rebuild_rows(acc_u, acc_v, acc_rank, acc_mask, num_vertices: int,
-                  max_degree: int):
-    """Full sorted-row rebuild — used only on checkpoint restore; the
-    steady path merges incrementally (:func:`_incremental_step`)."""
-    return sorted_ranked_rows(
-        acc_u, acc_v, acc_rank, acc_mask, num_vertices, max_degree
-    )
 
 
 _BIG = jnp.iinfo(jnp.int32).max
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-def _incremental_step(
-    ids, ranks, counts,
-    touched, add_ids, add_ranks,
-    new_u, new_v, new_rank, new_mask,
-):
-    """One window of streaming exact triangles, one dispatch.
+def _merge_step(pv, pn, pr, new_v, new_n, new_r, n_new):
+    return merge_packed_adjacency(pv, pn, pr, new_v, new_n, new_r, n_new)
 
-    ``ids``/``ranks`` are the carried ``[Vcap+1, D]`` sorted-by-id
-    neighbor/rank rows of the ACCUMULATED graph (row Vcap is scratch —
-    padded ``touched`` slots point there so their writes never land on a
-    real vertex). The step (a) merges each touched vertex's new neighbors
-    into its row — per-window merge cost scales with the touched set, not
-    the accumulated edge count (the round-1 version re-sorted every
-    accumulated edge per window) — then (b) counts the triangles closed
-    by the new edges via the rank-ordered membership kernel.
-    """
-    rows = jnp.concatenate([ids[touched], add_ids], axis=1)
-    rrk = jnp.concatenate([ranks[touched], add_ranks], axis=1)
-    order = jnp.argsort(rows, axis=1)
-    D = ids.shape[1]
-    rows = jnp.take_along_axis(rows, order, axis=1)[:, :D]
-    rrk = jnp.take_along_axis(rrk, order, axis=1)[:, :D]
-    ids = ids.at[touched].set(rows)
-    ranks = ranks.at[touched].set(rrk)
-    counts, delta = ranked_triangle_update(
-        ids, ranks, new_u, new_v, new_rank, new_mask, counts
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _row_ptr_of(pv, num_vertices: int):
+    return jnp.searchsorted(
+        pv, jnp.arange(num_vertices + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8), donate_argnums=(6,))
+def _packed_count_step(
+    pn, pr, row_ptr, qu, qv, qrank, counts_and_delta, enum_width: int,
+    search_steps: int, *, qmask,
+):
+    counts, delta = counts_and_delta
+    counts, d = packed_triangle_update(
+        pn, pr, row_ptr, qu, qv, qrank, qmask, counts, enum_width,
+        search_steps=search_steps,
     )
-    return ids, ranks, counts, delta
+    return counts, delta + d
 
 
 class WindowTriangles:
@@ -160,11 +150,17 @@ class ExactTriangleCount:
         self._v = np.zeros(0, np.int32)
         self._seen_keys = np.zeros(0, np.int64)  # sorted
         self._deg = np.zeros(0, np.int64)
-        # device carry: counts [Vcap] + sorted neighbor/rank rows
-        # [Vcap+1, Dcap] (last row = scratch for padded scatter indices)
+        # device carry: counts [Vcap] + PACKED sorted adjacency — columns
+        # (vertex, nbr, rank) sorted by (vertex, nbr), both directions of
+        # every canonical edge, +INT32_MAX vertex sentinel padding. O(E)
+        # memory: the round-2 interim [V, max_degree] dense rows let one
+        # hub size every vertex's row (O(V*D) — 17 GB at a 16k-degree hub
+        # over 262k vertices).
         self._counts = None
-        self._ids = None
-        self._ranks = None
+        self._pv = None
+        self._pn = None
+        self._pr = None
+        self._n_packed = 0
         self._total = 0
 
     def run(self, stream) -> Iterator[List[Tuple[int, int]]]:
@@ -177,8 +173,8 @@ class ExactTriangleCount:
 
     def state_dict(self) -> dict:
         """Checkpoint surface (``aggregate/checkpoint.py:save_workload``).
-        The sorted rows are NOT serialized — they are rebuilt from the
-        edge list on restore (one full-build step)."""
+        The packed adjacency is NOT serialized — ``load_state_dict``
+        rebuilds it from the edge list (one host lexsort + device put)."""
         return {
             "u": self._u, "v": self._v, "seen_keys": self._seen_keys,
             "deg": self._deg,
@@ -191,26 +187,23 @@ class ExactTriangleCount:
         self._seen_keys, self._deg = d["seen_keys"], d["deg"]
         self._counts = None if d["counts"] is None else jnp.asarray(d["counts"])
         self._total = int(d["total"])
-        self._ids = self._ranks = None
-        if self._counts is not None and len(self._u):
-            vcap = int(self._counts.shape[0])
-            dcap = bucket_capacity(int(self._deg[:vcap].max()))
-            n = len(self._u)
-            cap = bucket_capacity(n)
-            ids, ranks = _rebuild_rows(
-                jnp.asarray(_pad(self._u, cap)),
-                jnp.asarray(_pad(self._v, cap)),
-                jnp.asarray(_pad(np.arange(n, dtype=np.int32), cap)),
-                jnp.asarray(np.arange(cap) < n),
-                vcap, dcap,
+        self._pv = self._pn = self._pr = None
+        self._n_packed = 0
+        if len(self._u):
+            # rebuild the packed adjacency from the edge list (host
+            # lexsort once — checkpoints stay in the edge-list format)
+            ranks = np.arange(len(self._u), dtype=np.int32)
+            pv = np.concatenate([self._u, self._v])
+            pn = np.concatenate([self._v, self._u])
+            pr = np.concatenate([ranks, ranks])
+            order = np.lexsort((pn, pv))
+            self._n_packed = len(pv)
+            cap = bucket_capacity(self._n_packed)
+            self._pv = jnp.asarray(
+                _pad_fill(pv[order], cap, np.iinfo(np.int32).max)
             )
-            # append the scratch row
-            self._ids = jnp.concatenate(
-                [ids, jnp.full((1, dcap), _BIG, jnp.int32)]
-            )
-            self._ranks = jnp.concatenate(
-                [ranks, jnp.zeros((1, dcap), jnp.int32)]
-            )
+            self._pn = jnp.asarray(_pad(pn[order].astype(np.int32), cap))
+            self._pr = jnp.asarray(_pad(pr[order], cap))
 
     # ------------------------------------------------------------------ #
     def _dedup_new(self, s: np.ndarray, d: np.ndarray):
@@ -235,65 +228,23 @@ class ExactTriangleCount:
         self._seen_keys = np.sort(np.concatenate([self._seen_keys, key]))
         return u.astype(np.int32), v.astype(np.int32)
 
-    def _grow(self, vcap: int, dcap: int) -> None:
-        """Grow the carried device matrices to [vcap+1, dcap] (scratch row
-        last; log-many re-pads over the stream). Appending +INT_MAX columns
-        keeps rows sorted; the old scratch row is cleared when it becomes a
-        real vertex row."""
-        if self._ids is None:
-            self._ids = jnp.full((vcap + 1, dcap), _BIG, jnp.int32)
-            self._ranks = jnp.zeros((vcap + 1, dcap), jnp.int32)
+    def _grow_packed(self, need: int) -> None:
+        """Grow the packed columns to a bucket covering ``need`` entries
+        (appending +INT32_MAX vertex sentinels keeps them sorted)."""
+        cap = bucket_capacity(max(need, 8))
+        if self._pv is None:
+            self._pv = jnp.full(cap, _BIG, jnp.int32)
+            self._pn = jnp.zeros(cap, jnp.int32)
+            self._pr = jnp.zeros(cap, jnp.int32)
             return
-        old_v = self._ids.shape[0] - 1
-        old_d = self._ids.shape[1]
-        if old_v == vcap and old_d == dcap:
+        old = self._pv.shape[0]
+        if cap <= old:
             return
-        ids = self._ids
-        ranks = self._ranks
-        if dcap > old_d:
-            ids = jnp.concatenate(
-                [ids, jnp.full((old_v + 1, dcap - old_d), _BIG, jnp.int32)], 1
-            )
-            ranks = jnp.concatenate(
-                [ranks, jnp.zeros((old_v + 1, dcap - old_d), jnp.int32)], 1
-            )
-        if vcap > old_v:
-            ids = jnp.concatenate(
-                [ids, jnp.full((vcap - old_v, dcap), _BIG, jnp.int32)]
-            )
-            ranks = jnp.concatenate(
-                [ranks, jnp.zeros((vcap - old_v, dcap), jnp.int32)]
-            )
-            # the old scratch row (index old_v) is now a real vertex row;
-            # it holds junk from padded-slot writes — reset it
-            ids = ids.at[old_v].set(jnp.full(dcap, _BIG, jnp.int32))
-            ranks = ranks.at[old_v].set(jnp.zeros(dcap, jnp.int32))
-        self._ids = ids
-        self._ranks = ranks
-
-    @staticmethod
-    def _new_rows(new_u, new_v, new_ranks):
-        """Host-built per-vertex additions: (touched[T], add_ids[T, Dn],
-        add_ranks[T, Dn]) covering both directions of the new edges."""
-        key = np.concatenate([new_u, new_v]).astype(np.int64)
-        nbr = np.concatenate([new_v, new_u]).astype(np.int32)
-        rk = np.concatenate([new_ranks, new_ranks]).astype(np.int32)
-        order = np.argsort(key, kind="stable")
-        k, nb, rr = key[order], nbr[order], rk[order]
-        touched, start = np.unique(k, return_index=True)
-        cnt = np.diff(np.append(start, len(k)))
-        # floor 16: windows flapping between tiny Dn buckets would
-        # recompile the step for negligible memory savings
-        dn = bucket_capacity(int(cnt.max()), minimum=16)
-        t = len(touched)
-        tcap = bucket_capacity(t)
-        add_ids = np.full((tcap, dn), np.iinfo(np.int32).max, np.int32)
-        add_ranks = np.zeros((tcap, dn), np.int32)
-        row = np.repeat(np.arange(t), cnt)
-        col = np.arange(len(k)) - np.repeat(start, cnt)
-        add_ids[row, col] = nb
-        add_ranks[row, col] = rr
-        return touched.astype(np.int32), tcap, add_ids, add_ranks
+        self._pv = jnp.concatenate(
+            [self._pv, jnp.full(cap - old, _BIG, jnp.int32)]
+        )
+        self._pn = jnp.concatenate([self._pn, jnp.zeros(cap - old, jnp.int32)])
+        self._pr = jnp.concatenate([self._pr, jnp.zeros(cap - old, jnp.int32)])
 
     def _process(self, new_u, new_v, vcap: int, vdict) -> List[Tuple[int, int]]:
         n_old = len(self._u)
@@ -315,29 +266,57 @@ class ExactTriangleCount:
             return []
 
         n_acc = len(self._u)
-        new_cap = bucket_capacity(len(new_u))
-        max_deg = bucket_capacity(int(self._deg[:vcap].max()))
-        self._grow(vcap, max_deg)
-
         new_ranks = np.arange(n_old, n_acc, dtype=np.int32)
-        touched, tcap, add_ids, add_ranks = self._new_rows(
-            new_u, new_v, new_ranks
-        )
-        # padded touched slots point at the scratch row (index vcap)
-        touched_p = np.full(tcap, vcap, np.int32)
-        touched_p[: len(touched)] = touched
-        new_mask = np.zeros(new_cap, bool)
-        new_mask[: len(new_u)] = True
 
-        # snapshot counts host-side BEFORE dispatch: the device buffer is
-        # donated to the step and must not be read afterwards
-        old_host = np.asarray(self._counts)
-        self._ids, self._ranks, self._counts, delta = _incremental_step(
-            self._ids, self._ranks, self._counts,
-            jnp.asarray(touched_p), jnp.asarray(add_ids), jnp.asarray(add_ranks),
-            jnp.asarray(_pad(new_u, new_cap)), jnp.asarray(_pad(new_v, new_cap)),
-            jnp.asarray(_pad(new_ranks, new_cap)), jnp.asarray(new_mask),
+        # 1. merge both directions of the new edges into the packed
+        # adjacency (host lexsort of the NEW entries only, device merge)
+        pv_new = np.concatenate([new_u, new_v])
+        pn_new = np.concatenate([new_v, new_u])
+        pr_new = np.concatenate([new_ranks, new_ranks])
+        order = np.lexsort((pn_new, pv_new))
+        n_new = len(pv_new)
+        ncap = bucket_capacity(n_new, minimum=16)
+        self._grow_packed(self._n_packed + n_new)
+        self._pv, self._pn, self._pr = _merge_step(
+            self._pv, self._pn, self._pr,
+            jnp.asarray(_pad_fill(pv_new[order].astype(np.int32), ncap,
+                                  np.iinfo(np.int32).max)),
+            jnp.asarray(_pad(pn_new[order].astype(np.int32), ncap)),
+            jnp.asarray(_pad(pr_new[order], ncap)),
+            jnp.int32(n_new),
         )
+        self._n_packed += n_new
+        row_ptr = _row_ptr_of(self._pv, vcap)
+
+        # 2. count closures per min-degree class: enumeration rows are
+        # only as wide as each class's bucket (no hub-sized dense rows)
+        mindeg = np.minimum(self._deg[new_u], self._deg[new_v])
+        classes = np.int64(1) << np.ceil(
+            np.log2(np.maximum(mindeg, 1))
+        ).astype(np.int64)
+        classes = np.maximum(classes, 16)
+        old_host = np.asarray(self._counts)
+        acc = (self._counts, jnp.int32(0))
+        # the binary search only ever spans the largest row; a tight step
+        # count (vs a blanket 32) cuts the dominant inner loop ~2-3x
+        steps = max(4, int(bucket_capacity(int(self._deg.max()))).bit_length())
+        for c in np.unique(classes):
+            sel = np.nonzero(classes == c)[0]
+            t = len(sel)
+            tcap = bucket_capacity(t, minimum=16)
+            qmask = np.zeros(tcap, bool)
+            qmask[:t] = True
+            acc = _packed_count_step(
+                self._pn, self._pr, row_ptr,
+                jnp.asarray(_pad(new_u[sel], tcap)),
+                jnp.asarray(_pad(new_v[sel], tcap)),
+                jnp.asarray(_pad(new_ranks[sel], tcap)),
+                acc,
+                int(c),
+                steps,
+                qmask=jnp.asarray(qmask),
+            )
+        self._counts, delta = acc
         new_counts = np.asarray(self._counts)
         changed = np.nonzero(new_counts != old_host)[0]
         raw = vdict.decode(changed) if len(changed) else []

@@ -9,10 +9,13 @@ TPU-native replacement for the reference's two triangle paths:
   (:func:`window_triangle_count`): O(E·D·logD) dense vector work.
 - ``example/ExactTriangleCount.java:74-116`` pairs per-edge neighborhood
   snapshots in keyed state so each triangle is counted exactly once, when its
-  last edge arrives. The TPU form (:func:`ranked_triangle_update`) keeps an
+  last edge arrives. The TPU form (:func:`packed_triangle_update` over the
+  :func:`merge_packed_adjacency`-carried sorted adjacency) keeps an
   *arrival rank* per accumulated edge and counts, for each new edge, common
   neighbors whose two closing edges both have smaller rank — the same
-  "closed by the final edge" semantics, batched per window.
+  "closed by the final edge" semantics, batched per window, with O(E)
+  carried memory and per-query enumeration bounded by the min-degree
+  endpoint's class.
 
 All kernels take dense ``[V, D]`` neighbor matrices (see
 ``ops/csr.py:sorted_neighbor_matrix``); invalid slots hold +INT_MAX so
@@ -53,33 +56,6 @@ def dedup_canonical(u: jax.Array, v: jax.Array, mask: jax.Array, num_vertices: i
     )
     keep = jnp.zeros_like(mask).at[si].set(first)
     return u, v, mask & keep
-
-
-def sorted_ranked_rows(
-    u: jax.Array,
-    v: jax.Array,
-    rank: jax.Array,
-    mask: jax.Array,
-    num_vertices: int,
-    max_degree: int,
-) -> Tuple[jax.Array, jax.Array]:
-    """Build ``(nbr_ids[V, D], nbr_ranks[V, D])`` rows sorted by neighbor id.
-
-    Input is the *canonical* edge list; both directions are materialized so a
-    vertex's row holds its full undirected neighborhood. Invalid slots hold
-    +INT_MAX ids (rank irrelevant there).
-    """
-    key = jnp.concatenate([u, v])
-    nbr = jnp.concatenate([v, u])
-    rk = jnp.concatenate([rank, rank])
-    m = jnp.concatenate([mask, mask])
-    csr = build_csr(key, nbr, rk, m, num_vertices)
-    nbr_mat, rank_mat, valid = dense_neighbors(csr, max_degree)
-    ids = jnp.where(valid, nbr_mat, _BIG)
-    order = jnp.argsort(ids, axis=1)
-    ids = jnp.take_along_axis(ids, order, axis=1)
-    ranks = jnp.take_along_axis(rank_mat, order, axis=1)
-    return ids, ranks
 
 
 def _row_membership(rows_a: jax.Array, rows_b: jax.Array):
@@ -172,67 +148,112 @@ def window_triangle_count(
     return total, per_vertex
 
 
-def ranked_triangle_update(
-    nbr_ids: jax.Array,
-    nbr_ranks: jax.Array,
-    u: jax.Array,
-    v: jax.Array,
-    rank: jax.Array,
-    mask: jax.Array,
-    counts: jax.Array,
-    edge_chunk: int = 1 << 16,
-) -> Tuple[jax.Array, jax.Array]:
-    """Count the triangles *closed by* a batch of new edges.
+def ranged_searchsorted(arr, lo, hi, x, *, side: str = "left", steps: int = 32):
+    """Elementwise binary search of ``x`` within ``arr[lo:hi)`` (each
+    element has its own range; ``arr`` ascending within every range).
+    Returns the leftmost (``side='left'``) or rightmost insertion
+    position. Fixed ``steps`` iterations (covers arrays up to 2^steps)."""
+    right = side == "right"
 
-    ``nbr_ids``/``nbr_ranks`` describe the ACCUMULATED graph (new edges
-    included); a new edge (u, v) of arrival rank r closes triangle
-    (u, v, w) iff edges (u, w) and (v, w) both arrived strictly earlier.
-    Updates the running per-vertex ``counts`` (each triangle vertex +1 —
-    the ``(w,1)/(u,c)/(v,c)`` emissions of
-    ``ExactTriangleCount.java:85-106``) and returns ``(counts, delta)``
-    where delta is this batch's new-triangle total (the ``(-1, c)`` stream).
+    def body(_, c):
+        lo, hi = c
+        mid = (lo + hi) >> 1
+        mid_c = jnp.clip(mid, 0, arr.shape[0] - 1)
+        v = arr[mid_c]
+        go_right = (v <= x) if right else (v < x)
+        go_right = go_right & (lo < hi)
+        return jnp.where(go_right, mid + 1, lo), jnp.where(
+            lo < hi, jnp.where(go_right, hi, mid), hi
+        )
 
-    The [E, D] membership intermediates are processed in ``edge_chunk``
-    slices via ``lax.scan`` to bound peak HBM (same pattern as
-    :func:`window_triangle_count`).
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def _count_composite(sv, sn, v, n, side: str):
+    """How many (sv, sn) pairs (sorted, sentinel-padded) compare
+    less [or less-or-equal for side='right'] than each (v, n) query —
+    the composite-key searchsorted, in pure int32."""
+    lt = jnp.searchsorted(sv, v, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sv, v, side="right").astype(jnp.int32)
+    within = ranged_searchsorted(sn, lt, hi, n, side=side)
+    return within
+
+
+def merge_packed_adjacency(pv, pn, pr, new_v, new_n, new_r, n_new):
+    """Merge sorted new (vertex, nbr, rank) entries into the packed sorted
+    adjacency — a composite-key merge path (two-level searchsorted +
+    scatter), not a re-sort of the accumulated arrays; per-window work is
+    O(total) data movement but only O(log) comparisons per element, all
+    int32 (no 64-bit key packing).
+
+    Both inputs sorted by (vertex, nbr) with +INT32_MAX sentinel padding
+    in the vertex column; real keys must be disjoint (callers dedup).
+    Output arrays keep the callers' pre-grown capacity = len(pv).
     """
-    E = u.shape[0]
-    pad_to = -(-E // edge_chunk) * edge_chunk
-
-    def pad(a, fill=0):
-        return jnp.concatenate(
-            [a, jnp.full(pad_to - E, fill, a.dtype)]
-        ) if pad_to != E else a
-
-    uc = pad(u).reshape(-1, edge_chunk)
-    vc = pad(v).reshape(-1, edge_chunk)
-    rc = pad(rank).reshape(-1, edge_chunk)
-    mc = pad(mask.astype(jnp.int32)).astype(bool).reshape(-1, edge_chunk)
-
-    def chunk_step(carry, x):
-        counts, total = carry
-        u_i, v_i, r_i, m_i = x
-        rows_u = jnp.where(m_i[:, None], nbr_ids[u_i], _BIG)
-        ranks_u = nbr_ranks[u_i]
-        rows_v = nbr_ids[v_i]
-        ranks_v = nbr_ranks[v_i]
-        pos, found = _row_membership(rows_u, rows_v)
-        r = r_i[:, None]
-        match = (
-            found
-            & (ranks_u < r)
-            & (jnp.take_along_axis(ranks_v, pos, axis=1) < r)
-        )
-        c = match.sum(axis=1).astype(jnp.int32)
-        w_ids = jnp.where(match, rows_u, 0)
-        counts = counts.at[w_ids.reshape(-1)].add(
-            match.reshape(-1).astype(jnp.int32)
-        )
-        cm = jnp.where(m_i, c, 0)
-        counts = counts.at[u_i].add(cm).at[v_i].add(cm)
-        return (counts, total + cm.sum().astype(jnp.int32)), None
-
-    (counts, delta), _ = jax.lax.scan(
-        chunk_step, (counts, jnp.int32(0)), (uc, vc, rc, mc)
+    cap = pv.shape[0]
+    ncap = new_v.shape[0]
+    pos_old = jnp.arange(cap, dtype=jnp.int32) + _count_composite(
+        new_v, new_n, pv, pn, side="left"
     )
-    return counts, delta
+    pos_new = jnp.arange(ncap, dtype=jnp.int32) + _count_composite(
+        pv, pn, new_v, new_n, side="right"
+    )
+    pos_old = jnp.where(pv == _BIG, cap, pos_old)
+    pos_new = jnp.where(jnp.arange(ncap) < n_new, pos_new, cap)
+    out_v = jnp.full(cap, _BIG, jnp.int32)
+    out_n = jnp.zeros(cap, jnp.int32)
+    out_r = jnp.zeros(cap, jnp.int32)
+    out_v = out_v.at[pos_old].set(pv, mode="drop").at[pos_new].set(new_v, mode="drop")
+    out_n = out_n.at[pos_old].set(pn, mode="drop").at[pos_new].set(new_n, mode="drop")
+    out_r = out_r.at[pos_old].set(pr, mode="drop").at[pos_new].set(new_r, mode="drop")
+    return out_v, out_n, out_r
+
+
+def packed_triangle_update(
+    pn, pr, row_ptr,
+    qu, qv, qrank, qmask,
+    counts,
+    enum_width: int,
+    search_steps: int = 32,
+):
+    """Count triangles closed by query edges against a PACKED adjacency.
+
+    ``pn``/``pr``: neighbor/rank columns of the packed (vertex, nbr)-sorted
+    adjacency; ``row_ptr[v]`` the start of v's run. Each query edge
+    enumerates the neighborhood of its SMALLER-degree endpoint (the caller
+    groups queries into ``enum_width`` degree classes, so dense enumeration
+    rows are only as wide as each class — no hub sizes anyone else's rows;
+    memory is O(E) total) and checks each candidate w against the larger
+    endpoint's run with a ranged binary search, under the closed-by-last-
+    edge rank rule: both closing edges strictly earlier than the query.
+    Returns ``(counts, delta)``.
+    """
+    d_u = row_ptr[qu + 1] - row_ptr[qu]
+    d_v = row_ptr[qv + 1] - row_ptr[qv]
+    take_u = d_u <= d_v
+    small = jnp.where(take_u, qu, qv)
+    big = jnp.where(take_u, qv, qu)
+    idx = row_ptr[small][:, None] + jnp.arange(enum_width)[None, :]
+    valid = (
+        qmask[:, None]
+        & (jnp.arange(enum_width)[None, :] < jnp.minimum(d_u, d_v)[:, None])
+    )
+    idx = jnp.clip(idx, 0, pn.shape[0] - 1)
+    w = pn[idx]
+    wr = pr[idx]
+    lo = jnp.broadcast_to(row_ptr[big][:, None], w.shape)
+    hi = jnp.broadcast_to(row_ptr[big + 1][:, None], w.shape)
+    pos = ranged_searchsorted(pn, lo, hi, w, steps=search_steps)
+    pos_c = jnp.clip(pos, 0, pn.shape[0] - 1)
+    found = (pos < hi) & (pn[pos_c] == w)
+    r = qrank[:, None]
+    match = valid & found & (wr < r) & (pr[pos_c] < r)
+    c = match.sum(axis=1).astype(jnp.int32)
+    w_ids = jnp.where(match, w, 0)
+    counts = counts.at[w_ids.reshape(-1)].add(match.reshape(-1).astype(jnp.int32))
+    cm = jnp.where(qmask, c, 0)
+    counts = counts.at[qu].add(cm).at[qv].add(cm)
+    return counts, cm.sum().astype(jnp.int32)
+
+
