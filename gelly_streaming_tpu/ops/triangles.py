@@ -17,9 +17,10 @@ TPU-native replacement for the reference's two triangle paths:
   carried memory and per-query enumeration bounded by the min-degree
   endpoint's class.
 
-All kernels take dense ``[V, D]`` neighbor matrices (see
-``ops/csr.py:sorted_neighbor_matrix``); invalid slots hold +INT_MAX so
-binary search never matches them.
+The window kernel takes dense ``[V, D]`` neighbor matrices (see
+``ops/csr.py``); the streaming kernels work on the packed sorted columns.
+Invalid slots hold +INT_MAX everywhere so binary search never matches
+them.
 """
 
 from __future__ import annotations
