@@ -211,3 +211,86 @@ def test_exact_streaming_matches_batch_recount_large():
                         want_pv[x] = want_pv.get(x, 0) + 1
     assert total == want_total
     assert {k: v for k, v in per_vertex.items() if v} == want_pv
+
+
+def test_merge_packed_adjacency_property():
+    """Merge-path result == lexsort of the concatenation (random rounds,
+    disjoint keys, sentinel padding)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gelly_streaming_tpu.core.edgeblock import bucket_capacity
+    from gelly_streaming_tpu.ops.triangles import merge_packed_adjacency
+
+    BIG = np.iinfo(np.int32).max
+    rng = np.random.default_rng(21)
+    acc = np.zeros((0, 3), np.int64)  # (v, n, r) rows, unique (v, n)
+    pv = jnp.full(8, BIG, jnp.int32)
+    pn = jnp.zeros(8, jnp.int32)
+    pr = jnp.zeros(8, jnp.int32)
+    seen = set()
+    for round_ in range(5):
+        cand = rng.integers(0, 50, (rng.integers(1, 40), 2))
+        fresh = [tuple(x) for x in cand if tuple(x) not in seen]
+        fresh = list(dict.fromkeys(fresh))
+        if not fresh:
+            continue
+        new = np.array(fresh, np.int64)
+        ranks = rng.integers(0, 1000, len(new))
+        order = np.lexsort((new[:, 1], new[:, 0]))
+        nv, nn, nr = new[order, 0], new[order, 1], ranks[order]
+        ncap = bucket_capacity(len(nv), minimum=8)
+        need = len(seen) + len(fresh)
+        cap = bucket_capacity(max(need, 8))
+        if cap > pv.shape[0]:
+            grow = cap - pv.shape[0]
+            pv = jnp.concatenate([pv, jnp.full(grow, BIG, jnp.int32)])
+            pn = jnp.concatenate([pn, jnp.zeros(grow, jnp.int32)])
+            pr = jnp.concatenate([pr, jnp.zeros(grow, jnp.int32)])
+
+        def pad(a, fill=0):
+            out = np.full(ncap, fill, np.int32)
+            out[: len(a)] = a
+            return out
+
+        pv, pn, pr = merge_packed_adjacency(
+            pv, pn, pr,
+            jnp.asarray(pad(nv, BIG)), jnp.asarray(pad(nn)),
+            jnp.asarray(pad(nr)), len(nv),
+        )
+        seen.update(fresh)
+        acc = np.concatenate([acc, np.stack([nv, nn, nr], 1)])
+        want = acc[np.lexsort((acc[:, 1], acc[:, 0]))]
+        k = len(acc)
+        got_v = np.asarray(pv)[:k]
+        np.testing.assert_array_equal(got_v, want[:, 0])
+        np.testing.assert_array_equal(np.asarray(pn)[:k], want[:, 1])
+        np.testing.assert_array_equal(np.asarray(pr)[:k], want[:, 2])
+        assert (np.asarray(pv)[k:] == BIG).all()
+
+
+def test_ranged_searchsorted_property():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gelly_streaming_tpu.ops.triangles import ranged_searchsorted
+
+    rng = np.random.default_rng(22)
+    # several sorted runs inside one array
+    runs = [np.sort(rng.integers(0, 100, rng.integers(0, 20))) for _ in range(8)]
+    arr = np.concatenate(runs) if runs else np.zeros(0)
+    bounds = np.cumsum([0] + [len(r) for r in runs])
+    for side in ("left", "right"):
+        los, his, xs, want = [], [], [], []
+        for i, r in enumerate(runs):
+            for q in rng.integers(-5, 110, 10):
+                los.append(bounds[i])
+                his.append(bounds[i + 1])
+                xs.append(q)
+                want.append(bounds[i] + np.searchsorted(r, q, side=side))
+        got = ranged_searchsorted(
+            jnp.asarray(arr, jnp.int32), jnp.asarray(los, jnp.int32),
+            jnp.asarray(his, jnp.int32), jnp.asarray(xs, jnp.int32),
+            side=side,
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
