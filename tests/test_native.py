@@ -194,3 +194,28 @@ def test_parser_fuzz_matches_python_fallback(tmp_path):
                 [c[0] for c in native.iter_edge_chunks_i32(str(p), 16)]
             ) if len(ps) else np.zeros(0, np.int32)
             assert cs.tolist() == ps.tolist(), body
+
+
+def test_parser_survives_binary_garbage(tmp_path):
+    """Arbitrary bytes (nulls, high bytes, no newlines, huge runs): the C
+    parser must terminate without crashing and never emit ids it did not
+    parse from digit runs."""
+    rng = np.random.default_rng(77)
+    for trial in range(6):
+        n = int(rng.integers(10, 30000))
+        blob = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        p = tmp_path / f"bin{trial}"
+        p.write_bytes(blob)
+        try:
+            s, d, v = native.parse_edge_file(str(p))
+            assert len(s) == len(d)
+        except IOError:
+            pass  # an oversized "line" rejection is acceptable
+    # digits-only megarun (one enormous number, no separators)
+    p = tmp_path / "digits"
+    p.write_bytes(b"9" * 100000)
+    try:
+        s, d, _ = native.parse_edge_file(str(p))
+        assert len(s) == 0  # a single number is not an edge
+    except IOError:
+        pass
