@@ -111,13 +111,15 @@ def test_degree_batches_are_column_backed():
     import numpy as np
 
     from gelly_streaming_tpu import CountWindow, SimpleEdgeStream
-    from gelly_streaming_tpu.core.emission import ColumnBatch
+    from gelly_streaming_tpu.core.emission import ColumnBatch, DeviceColumnBatch
 
     s = SimpleEdgeStream(
         (np.array([1, 2, 3]), np.array([2, 3, 4])), window=CountWindow(3)
     )
     batches = list(s.get_degrees().batches())
-    assert all(isinstance(b, ColumnBatch) for b in batches)
+    assert all(
+        isinstance(b, (ColumnBatch, DeviceColumnBatch)) for b in batches
+    )
     raw, deg = batches[0].columns
     assert list(zip(raw.tolist(), deg.tolist())) == list(batches[0])
 
