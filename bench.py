@@ -110,21 +110,29 @@ def bench_cc_e2e(path: str, vdict_factory, n_edges: int) -> dict:
     }
 
 
+BASELINE_REPS = 3  # median-of-N: one noisy C++ run must not set the ratio
+
+
 def bench_cc_baseline(path: str) -> tuple:
     """Compiled reference-architecture CC on the same file (parse included).
 
-    Returns (stats, src, dst) — the parsed columns ride along so --all
-    does not re-parse the corpus for the Python tier / binary cache."""
+    The CC fold runs ``BASELINE_REPS`` times and the MEDIAN is used — the
+    round-2 verdict flagged the ratio moving ~2x between runs on a single
+    baseline execution. Returns (stats, src, dst) — the parsed columns
+    ride along so --all does not re-parse the corpus."""
     from gelly_streaming_tpu import native
 
     t0 = time.perf_counter()
     s, d, _ = native.parse_edge_file(path)
     t_parse = time.perf_counter() - t0
-    secs, comps = native.cc_baseline(s, d, window=WINDOW)
+    runs = [native.cc_baseline(s, d, window=WINDOW) for _ in range(BASELINE_REPS)]
+    secs = float(np.median([r[0] for r in runs]))
+    comps = runs[0][1]
     return {
         "eps": len(s) / (t_parse + secs),
         "parse_s": t_parse,
         "cc_s": secs,
+        "cc_s_all": [round(r[0], 3) for r in runs],
         "components": comps,
         "n_edges": len(s),
     }, s, d
@@ -133,7 +141,8 @@ def bench_cc_baseline(path: str) -> tuple:
 def bench_cc_baseline_binary(bin_path: str) -> dict:
     """Compiled reference-architecture CC fed the binary corpus — the
     apples-to-apples comparator for the binary device path (both sides
-    relieved of text parsing; the baseline's load+convert is counted)."""
+    relieved of text parsing; the baseline's load+convert is counted).
+    Median-of-``BASELINE_REPS`` CC folds, like the text baseline."""
     import numpy as np
 
     from gelly_streaming_tpu import datasets, native
@@ -143,11 +152,14 @@ def bench_cc_baseline_binary(bin_path: str) -> dict:
     s = np.concatenate([c[0] for c in chunks]).astype(np.int64)
     d = np.concatenate([c[1] for c in chunks]).astype(np.int64)
     t_load = time.perf_counter() - t0
-    secs, comps = native.cc_baseline(s, d, window=WINDOW)
+    runs = [native.cc_baseline(s, d, window=WINDOW) for _ in range(BASELINE_REPS)]
+    secs = float(np.median([r[0] for r in runs]))
+    comps = runs[0][1]
     return {
         "eps": len(s) / (t_load + secs),
         "load_s": t_load,
         "cc_s": secs,
+        "cc_s_all": [round(r[0], 3) for r in runs],
         "components": comps,
         "n_edges": len(s),
     }
@@ -164,6 +176,45 @@ def bench_cc_e2e_device(bin_path: str, bound: int, n_edges: int) -> dict:
         stream = datasets.stream_file(
             bin_path, window=CountWindow(WINDOW), device_encode=True,
             min_vertex_capacity=bound,
+        )
+        agg = ConnectedComponents()
+        lat = []
+        t0 = time.perf_counter()
+        last_t = t0
+        last = None
+        for last in stream.aggregate(agg):
+            now = time.perf_counter()
+            lat.append(now - last_t)
+            last_t = now
+        dt = time.perf_counter() - t0
+        return dt, lat, last
+
+    one_pass()
+    dt, lat, last = one_pass()
+    lat_ms = np.asarray(lat) * 1e3
+    return {
+        "eps": n_edges / dt,
+        "p50_ms": float(np.percentile(lat_ms, 50)),
+        "p95_ms": float(np.percentile(lat_ms, 95)),
+        "components": len(last.component_sets()),
+    }
+
+
+def bench_cc_e2e_device_text(path: str, cap_hint: int, n_edges: int) -> dict:
+    """GENERAL text ingest, end-to-end: text file -> AVX-512 chunk parse
+    (arbitrary non-negative int32 ids, no dense-id declaration) -> device
+    put -> DEVICE dictionary compaction (growth mode, host novelty
+    tracking) -> CC summary. This is the framework's answer to the
+    reference's native habitat (``env.readTextFile`` +
+    per-line mappers, ``ConnectedComponentsExample.java:106-118``)."""
+    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    def one_pass():
+        stream = datasets.stream_file(
+            path, window=CountWindow(WINDOW), device_encode=True,
+            dense_ids=False, min_vertex_capacity=cap_hint,
         )
         agg = ConnectedComponents()
         lat = []
@@ -408,6 +459,8 @@ def _headline() -> tuple:
     n_edges = base["n_edges"]
     binp = datasets.binary_cache(path, arrays=(s64, d64, None))
     base_bin = bench_cc_baseline_binary(binp)
+    # numerator and denominator must be the same corpus, byte for byte
+    assert base_bin["n_edges"] == n_edges, (binp, path)
     log(f"bench: e2e CC on {binp} ({'real' if is_real else 'surrogate'}, "
         f"{n_edges} edges)...")
     e2e = bench_cc_e2e_device(binp, bound, n_edges)
@@ -449,6 +502,10 @@ def main():
              f"r = bench.bench_cc_e2e({path!r}, lambda: datasets.IdentityDict({bound}), {n_edges}); "
              "print(r['eps'])"),
             ("e2e_dict_eps",
+             "import bench; "
+             f"r = bench.bench_cc_e2e_device_text({path!r}, {bound}, {n_edges}); "
+             "print(r['eps'])"),
+            ("e2e_dict_host_eps",
              "import bench; from gelly_streaming_tpu.core.vertexdict import VertexDict; "
              f"r = bench.bench_cc_e2e({path!r}, lambda: VertexDict(min_capacity={bound}), {n_edges}); "
              "print(r['eps'])"),

@@ -29,6 +29,17 @@
 #include <thread>
 #include <vector>
 
+#include <sys/mman.h>
+
+#if defined(__AVX512BW__)
+#include <immintrin.h>
+#endif
+
+// Read buffers are over-allocated and zero-padded by PAD bytes so the
+// SWAR parsers can load 8 bytes and the AVX-512 newline scanner 64 bytes
+// at any position < len without reading out of bounds.
+#define READ_PAD 64
+
 namespace {
 
 inline const char* skip_sep(const char* p, const char* end) {
@@ -80,8 +91,7 @@ inline bool parse_line(const char*& p, const char* end, int64_t* s, int64_t* d,
 
 // Read [offset, offset+len) of the file into a malloc'd buffer.
 // *at_eof is set when the span reaches the end of the file.
-// The buffer is over-allocated by 8 zero bytes so SWAR parsers can load
-// 8 bytes at any position < len without reading out of bounds.
+// The buffer is over-allocated by READ_PAD zero bytes (see above).
 char* read_span(const char* path, int64_t offset, int64_t* len, bool* at_eof) {
     FILE* f = fopen(path, "rb");
     if (!f) { *len = -1; return nullptr; }  // signal IO error to callers
@@ -90,9 +100,9 @@ char* read_span(const char* path, int64_t offset, int64_t* len, bool* at_eof) {
     if (offset >= size) { fclose(f); *len = 0; *at_eof = true; return nullptr; }
     int64_t want = (*len <= 0 || offset + *len > size) ? size - offset : *len;
     *at_eof = (offset + want) >= size;
-    char* buf = (char*)malloc(want + 8);
+    char* buf = (char*)malloc(want + READ_PAD);
     if (!buf) { fclose(f); return nullptr; }
-    memset(buf + want, 0, 8);
+    memset(buf + want, 0, READ_PAD);
     fseek(f, offset, SEEK_SET);
     int64_t got = (int64_t)fread(buf, 1, want, f);
     fclose(f);
@@ -109,7 +119,11 @@ inline uint32_t parse_eight(uint64_t w) {
 }
 
 // Parse an unsigned decimal run at p (8 bytes at a time); advances p past
-// the digits. Returns false when *p is not a digit.
+// the digits. Returns false when *p is not a digit. Runs whose value
+// exceeds INT64_MAX saturate to INT64_MAX (digit count tracked, plus an
+// exact check for 19-digit runs) so downstream id-bound/oob checks fire —
+// a silent uint64 wrap would let corrupted edges into validated ingest
+// paths, and the Python fallback must agree byte-for-byte.
 inline bool parse_uint_swar(const char*& p, uint64_t* out) {
     uint64_t w;
     memcpy(&w, p, 8);
@@ -118,6 +132,7 @@ inline bool parse_uint_swar(const char*& p, uint64_t* out) {
                        0x8080808080808080ULL;
     if (nd_mask == 0) {  // >= 8 digits: full block, then continue
         uint64_t v = parse_eight(w);
+        int64_t digits = 8;
         p += 8;
         while (true) {
             memcpy(&w, p, 8);
@@ -126,6 +141,7 @@ inline bool parse_uint_swar(const char*& p, uint64_t* out) {
                       0x8080808080808080ULL;
             if (nd_mask == 0) {
                 v = v * 100000000ULL + parse_eight(w);
+                digits += 8;
                 p += 8;
                 continue;
             }
@@ -137,8 +153,13 @@ inline bool parse_uint_swar(const char*& p, uint64_t* out) {
                 static const uint64_t pow10[8] = {1, 10, 100, 1000, 10000,
                                                   100000, 1000000, 10000000};
                 v = v * pow10[nd] + parse_eight(w2);
+                digits += nd;
                 p += nd;
             }
+            // 20+ digits always exceed INT64_MAX; 19 digits fit uint64
+            // exactly, so the comparison below is wrap-free
+            if (digits > 19 || (digits == 19 && v > (uint64_t)INT64_MAX))
+                v = (uint64_t)INT64_MAX;
             *out = v;
             return true;
         }
@@ -262,6 +283,128 @@ inline bool parse_two_col_fast(const char*& p, int64_t* a_out,
     return false;
 }
 
+// Parse one already-delimited line [s, nl) of the dominant unweighted
+// shape "digits SEP digits [\r]" with both ids <= 8 digits (so they fit
+// int32 by construction: max 99,999,999 < 2^31). Returns false — without
+// consuming anything — for any other shape; the caller falls back to the
+// general grammar parser for that line. Two 8-byte SWAR loads, no scan
+// loop: the line boundaries come from the caller's newline mask.
+inline bool parse_line_i32_quick(const char* s, const char* nl, int32_t* a_out,
+                                 int32_t* b_out) {
+    uint64_t w;
+    memcpy(&w, s, 8);
+    uint64_t ndm = ((w - 0x3030303030303030ULL) |
+                    (w + 0x4646464646464646ULL)) &
+                   0x8080808080808080ULL;
+    int nd1 = ndm ? (__builtin_ctzll(ndm) >> 3) : 8;
+    if (nd1 == 0) return false;
+    uint64_t v1 = parse_eight(
+        nd1 == 8 ? w
+                 : ((w << ((8 - nd1) * 8)) |
+                    (0x3030303030303030ULL >> (nd1 * 8))));
+    const char* q = s + nd1;
+    if (q >= nl) return false;
+    char sep = *q;
+    if (sep != '\t' && sep != ' ' && sep != ',') return false;  // 9+ digits land here
+    ++q;
+    memcpy(&w, q, 8);
+    ndm = ((w - 0x3030303030303030ULL) |
+           (w + 0x4646464646464646ULL)) &
+          0x8080808080808080ULL;
+    int nd2 = ndm ? (__builtin_ctzll(ndm) >> 3) : 8;
+    if (nd2 == 0) return false;
+    const char* e2 = q + nd2;
+    if (e2 != nl && !(e2 + 1 == nl && *e2 == '\r')) return false;
+    uint64_t v2 = parse_eight(
+        nd2 == 8 ? w
+                 : ((w << ((8 - nd2) * 8)) |
+                    (0x3030303030303030ULL >> (nd2 * 8))));
+    *a_out = (int32_t)v1;
+    *b_out = (int32_t)v2;
+    return true;
+}
+
+#if defined(__AVX512BW__)
+// Newline-driven int32 region parse: one AVX-512 compare finds the
+// newlines of 64 input bytes (~4-5 lines) at once, and each line is then
+// parsed branch-lean by parse_line_i32_quick — the per-line separator
+// scanning, comment tests, and third-column probing of the scalar loop
+// vanish from the hot path. Lines that are not simple two-column edges
+// fall back to parse_line_fast one line at a time (accepted grammar is
+// identical). ~3x the scalar loop on SNAP-shaped corpora (measured round
+// 3: 26.6M -> ~80M edges/s single core).
+//
+// [buf, end) must end at a line boundary or EOF (reader_fill contract)
+// and carry READ_PAD zero bytes past `end`. Returns edges written;
+// *consumed gets the byte count consumed (always the full span unless
+// `cap` fills).
+int64_t parse_region_i32_simd(const char* buf, const char* end, int32_t* src,
+                              int32_t* dst, double* val, int64_t cap,
+                              int64_t bound, int64_t* oob_out, bool* any_val,
+                              int64_t* consumed) {
+    int64_t n = 0, oob = 0;
+    bool av = false;
+    const char* line = buf;  // start of the current (unconsumed) line
+    const char* p = buf;     // 64-byte scan cursor
+    const __m512i NL = _mm512_set1_epi8('\n');
+    while (p < end && n < cap) {
+        __m512i v = _mm512_loadu_si512((const void*)p);
+        uint64_t m = _mm512_cmpeq_epi8_mask(v, NL);
+        if (end - p < 64) m &= (((uint64_t)1) << (end - p)) - 1;
+        while (m) {
+            if (n >= cap) goto done;
+            const char* nl = p + __builtin_ctzll(m);
+            m &= m - 1;
+            if (nl == line) { ++line; continue; }  // blank line
+            int32_t a, b;
+            if (parse_line_i32_quick(line, nl, &a, &b)) {
+                oob += (a >= bound) | (b >= bound);
+                src[n] = a;
+                dst[n] = b;
+                val[n] = 0.0;
+                ++n;
+            } else {
+                const char* q = line;
+                int64_t s, d;
+                double w;
+                bool h;
+                if (parse_line_fast(q, nl + 1, &s, &d, &w, &h)) {
+                    oob += (s < 0) | (s >= bound) | (d < 0) | (d >= bound);
+                    src[n] = (int32_t)s;
+                    dst[n] = (int32_t)d;
+                    val[n] = w;
+                    av |= h;
+                    ++n;
+                }
+            }
+            line = nl + 1;
+        }
+        p += 64;
+    }
+    // ragged tail (EOF without a trailing newline)
+    while (line < end && n < cap) {
+        const char* q = line;
+        int64_t s, d;
+        double w;
+        bool h;
+        if (parse_line_fast(q, end, &s, &d, &w, &h)) {
+            oob += (s < 0) | (s >= bound) | (d < 0) | (d >= bound);
+            src[n] = (int32_t)s;
+            dst[n] = (int32_t)d;
+            val[n] = w;
+            av |= h;
+            ++n;
+        }
+        line = q;
+    }
+done:
+    *oob_out = oob;
+    *any_val = av;
+    *consumed = line - buf;
+    return n;
+}
+#endif  // __AVX512BW__
+
 // Parse every complete line of [p, end) into the output slices.
 int64_t parse_region(const char* p, const char* end, int64_t* src,
                      int64_t* dst, double* val, int64_t cap, bool* any_val) {
@@ -283,6 +426,56 @@ int64_t parse_region(const char* p, const char* end, int64_t* src,
 
 extern "C" {
 
+// --------------------------------------------------------------------- //
+// First-seen bitmap over the non-negative int32 id space.
+//
+// The general (arbitrary-id) device-encode ingest needs to know, per
+// chunk, how many ids the device dictionary has never seen — growing the
+// device table proactively keeps the whole pipeline free of
+// device->host reads (a single scalar fetch measures ~0.5-3 s through
+// the remote-TPU tunnel; round 3). A 2^31-bit anonymous mmap commits
+// lazily page by page, so clustered real-world id spaces stay a few
+// hundred KB resident and the test-and-set rides the L2 cache.
+// --------------------------------------------------------------------- //
+
+#define VBITMAP_BYTES (((size_t)1 << 31) / 8)  // 256 MB virtual
+
+void* vbitmap_create() {
+    void* bits = mmap(nullptr, VBITMAP_BYTES, PROT_READ | PROT_WRITE,
+                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    return bits == MAP_FAILED ? nullptr : bits;
+}
+
+void vbitmap_destroy(void* ptr) {
+    if (ptr) munmap(ptr, VBITMAP_BYTES);
+}
+
+// Count and record first-seen ids among (a[i], b[i]) in interleaved
+// arrival order; ids outside [0, 2^31) are ignored (the caller's oob
+// check rejects those edges anyway).
+int64_t vbitmap_novel2(void* bitmap, const int32_t* a, const int32_t* b,
+                       int64_t n) {
+    uint8_t* bits = (uint8_t*)bitmap;
+    int64_t novel = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t x = (uint32_t)a[i];
+        if (a[i] >= 0) {
+            uint8_t m = (uint8_t)(1u << (x & 7));
+            uint8_t& cell = bits[x >> 3];
+            novel += !(cell & m);
+            cell |= m;
+        }
+        uint32_t y = (uint32_t)b[i];
+        if (b[i] >= 0) {
+            uint8_t m = (uint8_t)(1u << (y & 7));
+            uint8_t& cell = bits[y >> 3];
+            novel += !(cell & m);
+            cell |= m;
+        }
+    }
+    return novel;
+}
+
 // Persistent reader session: reuses one file handle and one read buffer
 // across span calls. A fresh 40MB malloc per chunk costs ~8-10ns/edge in
 // soft page faults alone (measured); the session touches its pages once.
@@ -300,7 +493,7 @@ void* reader_open(const char* path, int64_t budget) {
     if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return nullptr; }
     int64_t size = ftell(f);
     fseek(f, 0, SEEK_SET);
-    char* buf = (char*)malloc(budget + 8);
+    char* buf = (char*)malloc(budget + READ_PAD);
     if (!buf) { fclose(f); return nullptr; }
     SpanReader* r = (SpanReader*)malloc(sizeof(SpanReader));
     r->f = f; r->buf = buf; r->buf_cap = budget; r->size = size;
@@ -332,7 +525,7 @@ int64_t reader_fill(SpanReader* r, const char** span_end, bool* at_eof) {
     if (fseek(r->f, r->offset, SEEK_SET) != 0) return -1;
     int64_t got = (int64_t)fread(r->buf, 1, want, r->f);
     if (got <= 0) return -1;
-    memset(r->buf + got, 0, 8);
+    memset(r->buf + got, 0, READ_PAD);
     const char* end = r->buf + got;
     if (!*at_eof) {
         while (end > r->buf && *(end - 1) != '\n') --end;
@@ -429,11 +622,18 @@ int64_t reader_next_span_i32(void* ptr, int32_t* src, int32_t* dst,
         if (at_eof) *at_eof_out = 1;
         return 0;
     }
-    const char* p = r->buf;
-    int64_t n = 0, oob = 0;
     int64_t bound = id_bound > 0 ? id_bound : (int64_t)1 << 31;
-    int64_t s, d; double v; bool h;
+    int64_t n, oob = 0;
     bool any_val = false;
+#if defined(__AVX512BW__)
+    int64_t used = 0;
+    n = parse_region_i32_simd(r->buf, end, src, dst, val, cap, bound, &oob,
+                              &any_val, &used);
+    r->offset += used;
+#else
+    const char* p = r->buf;
+    n = 0;
+    int64_t s, d; double v; bool h;
     while (p < end && n < cap) {
         if (parse_two_col_fast(p, &s, &d)) {
             oob += (s >= bound) | (d >= bound);
@@ -453,6 +653,7 @@ int64_t reader_next_span_i32(void* ptr, int32_t* src, int32_t* dst,
         }
     }
     r->offset += p - r->buf;
+#endif
     if (at_eof && r->offset >= r->size) *at_eof_out = 1;
     *has_val = any_val ? 1 : 0;
     *oob_out = oob;

@@ -167,6 +167,10 @@ def test_parser_fuzz_matches_python_fallback(tmp_path):
         "12 34", "5\t6", "7,8", "#x", "%y", "", " ", "9 10 1.5", "11 12 +",
         "13 14 -", "-1 -2", "99999999999 1", "3 4 abc", "a b", "5", "6 7 8 9",
         "0 0", "  15  16  ", "\t", "17 18 -0.25",
+        # >= 20-digit runs: both parsers must saturate to INT64_MAX, not
+        # wrap (round-2 advisor finding: 18446744073709551621 parsed as 5)
+        "18446744073709551621 1", "2 99999999999999999999999",
+        "9223372036854775807 9223372036854775808",
     ]
     for trial in range(8):
         n = int(rng.integers(5, 120))
@@ -219,3 +223,20 @@ def test_parser_survives_binary_garbage(tmp_path):
         assert len(s) == 0  # a single number is not an edge
     except IOError:
         pass
+
+
+def test_novelty_bitmap_native_matches_fallback():
+    rng = np.random.default_rng(9)
+    nat = native.NoveltyBitmap()
+    fb = native.NoveltyBitmap()
+    fb._lib = None  # force the numpy bit-packed fallback
+    assert nat._lib is not None, "native bitmap must load in this image"
+    for _ in range(6):
+        n = int(rng.integers(1, 400))
+        s = rng.integers(0, 2**30, n).astype(np.int32)
+        d = rng.integers(0, 2**30, n).astype(np.int32)
+        assert nat.novel2(s, d) == fb.novel2(s, d)
+    # ids sharing a byte cell in one batch, duplicates, and id 0
+    s = np.array([0, 1, 2, 3, 0, 1], np.int32)
+    d = np.array([4, 5, 6, 7, 4, 5], np.int32)
+    assert nat.novel2(s, d) == fb.novel2(s, d)
