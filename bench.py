@@ -388,6 +388,115 @@ def bench_window_triangles(n_vertices: int = 1 << 17, window: int = 1 << 20) -> 
     return 2 * window / (time.perf_counter() - t0)
 
 
+def bench_window_triangles_e2e(
+    n_vertices: int = 1 << 17, window: int = 1 << 20, n_win: int = 2
+) -> float:
+    """Config #3 as a SYSTEM bench: array stream -> stream.slice(1M-edge
+    CountWindow) -> per-slice device triangle count (BASELINE.md:31
+    'via slice(1M edges)'). Counts stay on device; one sync at the end."""
+    import jax
+
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.library.triangles import WindowTriangles
+
+    src, dst = make_stream(n_vertices, window * n_win, seed=9)
+
+    def one_pass():
+        stream = SimpleEdgeStream(
+            (src, dst), window=CountWindow(window),
+            vertex_dict=IdentityDict(n_vertices),
+        )
+        wt = WindowTriangles(CountWindow(window))
+        t0 = time.perf_counter()
+        last = None
+        for last, _ in wt.run_stream(stream):
+            pass
+        jax.block_until_ready(last)
+        return n_win * window / (time.perf_counter() - t0)
+
+    one_pass()
+    return one_pass()
+
+
+def bench_exact_triangles(
+    n_vertices: int = 1 << 17, window: int = 1 << 18, n_win: int = 4
+) -> float:
+    """Streaming EXACT triangles end-to-end: stream -> per-window packed
+    adjacency carry + rank-closed counting (``ExactTriangleCount``).
+    Emission batches stay lazy (unread); one sync at the end."""
+    import jax
+
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.library.triangles import ExactTriangleCount
+
+    src, dst = make_stream(n_vertices, window * n_win, seed=15)
+
+    def one_pass():
+        stream = SimpleEdgeStream(
+            (src, dst), window=CountWindow(window),
+            vertex_dict=IdentityDict(n_vertices),
+        )
+        etc = ExactTriangleCount()
+        t0 = time.perf_counter()
+        for _ in etc.run(stream):
+            pass
+        jax.block_until_ready((etc._counts, etc._total))
+        return n_win * window / (time.perf_counter() - t0)
+
+    one_pass()
+    return one_pass()
+
+
+def bench_graphsage_e2e(
+    n_vertices: int = 1 << 16, window: int = 1 << 18, feat: int = 128,
+    n_win: int = 2,
+) -> float:
+    """Config #5 as a SYSTEM bench: StreamingGraphSAGE over the stream
+    with a carried DEVICE feature table (TableFeatureSource — no host
+    dict loop), one forward over the accumulated graph per window."""
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.models.graphsage import (
+        StreamingGraphSAGE,
+        TableFeatureSource,
+        init_graphsage,
+    )
+
+    src, dst = make_stream(n_vertices, window * n_win, seed=13)
+    params = init_graphsage(
+        jax.random.PRNGKey(0), [feat, 256, 128], dtype=jnp.bfloat16
+    )
+    table = TableFeatureSource(
+        jax.random.normal(
+            jax.random.PRNGKey(1), (n_vertices, feat), jnp.bfloat16
+        )
+    )
+
+    def one_pass():
+        stream = SimpleEdgeStream(
+            (src, dst), window=CountWindow(window),
+            vertex_dict=IdentityDict(n_vertices),
+        )
+        sage = StreamingGraphSAGE(params, feature_dim=feat)
+        t0 = time.perf_counter()
+        out = None
+        for out in sage.run(stream, table):
+            pass
+        jax.block_until_ready(out)
+        return n_win * window / (time.perf_counter() - t0)
+
+    one_pass()
+    return one_pass()
+
+
 # --------------------------------------------------------------------- #
 # Config #4: incremental PageRank (end-to-end through the stream)
 # --------------------------------------------------------------------- #
@@ -523,8 +632,14 @@ def main():
              f"import bench; print(bench.bench_degrees_e2e({binp!r}, {bound}, {n_edges}))"),
             ("window_triangles_eps",
              "import bench; print(bench.bench_window_triangles())"),
+            ("window_triangles_e2e_eps",
+             "import bench; print(bench.bench_window_triangles_e2e())"),
+            ("exact_triangles_eps",
+             "import bench; print(bench.bench_exact_triangles())"),
             ("pagerank_eps", "import bench; print(bench.bench_pagerank())"),
             ("graphsage_eps", "import bench; print(bench.bench_graphsage())"),
+            ("graphsage_e2e_eps",
+             "import bench; print(bench.bench_graphsage_e2e())"),
         ]:
             log(f"bench: {key}...")
             out = subprocess.run(

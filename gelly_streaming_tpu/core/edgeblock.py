@@ -177,11 +177,20 @@ class EdgeBlock:
             val,
         )
 
-    def with_host_cache(self, src, dst, val) -> "EdgeBlock":
+    def with_host_cache(self, src, dst, val, positions=None) -> "EdgeBlock":
         """Attach pre-padding host columns (not part of the pytree: lost
         across jit/tree operations, which is correct — a transformed block
-        must re-download)."""
+        must re-download).
+
+        ``positions``: device slot index of each cached row. ``None``
+        declares PREFIX alignment (cached row i lives in device slot i) —
+        only valid when the block's mask is a prefix mask. Producers that
+        cache rows of a block with holes in its mask (e.g. ``distinct()``)
+        must pass the real slot positions, or consumers that map host rows
+        back to device slots (``ExactTriangleCount``) silently misalign.
+        """
         object.__setattr__(self, "_host_cache", (src, dst, val))
+        object.__setattr__(self, "_host_cache_pos", positions)
         return self
 
     def with_vertices(self, n_vertices: int) -> "EdgeBlock":
@@ -295,4 +304,8 @@ def from_arrays_tree(
         val=val_tree,
         mask=jnp.asarray(mask_p),
         n_vertices=int(n_vertices),
+    ).with_host_cache(
+        np.asarray(src, np.int32), np.asarray(dst, np.int32),
+        jax.tree.map(np.asarray, val) if val is not None
+        else np.zeros(n, np.float32),
     )

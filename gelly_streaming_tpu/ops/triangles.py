@@ -211,6 +211,51 @@ def merge_packed_adjacency(pv, pn, pr, new_v, new_n, new_r, n_new):
     return out_v, out_n, out_r
 
 
+def prepare_packed_window(
+    pv, pn, pr, src, dst, mask, rank0, num_vertices: int,
+    search_steps: int = 32,
+):
+    """One-dispatch window prep for streaming exact triangles: canonicalize
+    the window's raw edges, drop self-loops, dedup in-window, reject edges
+    already present in the packed adjacency (ranged binary search), sort
+    the survivors' two directed entries, merge them into the packed
+    columns, and rebuild the row pointer — entirely on device.
+
+    The previous design did the dedup (np.unique + hash set) and the
+    entry sort (np.lexsort) on the host: ~220 ms per 256k-edge window,
+    which WAS the system rate (round-3 profile). Returns
+    ``(pv, pn, pr, row_ptr, qu, qv, qrank, qmask)`` where the q-arrays
+    are the accepted query edges aligned with the input slots.
+    """
+    n = src.shape[0]
+    u, v, m = canonicalize(src, dst, mask)
+    u, v, m = dedup_canonical(u, v, m, num_vertices)
+    # cross-window duplicates: is (u, v) already a packed row of u?
+    row_ptr0 = jnp.searchsorted(
+        pv, jnp.arange(num_vertices + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    uc = jnp.clip(u, 0, num_vertices - 1)
+    lo = row_ptr0[uc]
+    hi = row_ptr0[uc + 1]
+    pos = ranged_searchsorted(pn, lo, hi, v, steps=search_steps)
+    pos_c = jnp.clip(pos, 0, pn.shape[0] - 1)
+    dup = (pos < hi) & (pn[pos_c] == v)
+    m = m & ~dup
+    qrank = rank0 + jnp.arange(n, dtype=jnp.int32)
+    # both directed entries of every accepted edge; rejected slots become
+    # +INT32_MAX sentinels and sort to the tail
+    pv_new = jnp.concatenate([jnp.where(m, u, _BIG), jnp.where(m, v, _BIG)])
+    pn_new = jnp.concatenate([jnp.where(m, v, 0), jnp.where(m, u, 0)])
+    pr_new = jnp.concatenate([jnp.where(m, qrank, 0)] * 2)
+    spv, spn, spr = jax.lax.sort((pv_new, pn_new, pr_new), num_keys=2)
+    n_new = 2 * m.sum().astype(jnp.int32)
+    pv2, pn2, pr2 = merge_packed_adjacency(pv, pn, pr, spv, spn, spr, n_new)
+    row_ptr = jnp.searchsorted(
+        pv2, jnp.arange(num_vertices + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    return pv2, pn2, pr2, row_ptr, u, v, qrank, m
+
+
 def packed_triangle_update(
     pn, pr, row_ptr,
     qu, qv, qrank, qmask,
