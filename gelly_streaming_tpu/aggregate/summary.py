@@ -79,12 +79,19 @@ class SummaryAggregation(abc.ABC):
     Contract for the state hooks (initial/update/combine): they must be
     pure functions of their arguments for a given constructor
     configuration. Subclasses whose constructor parameters change hook
-    behavior MUST include those parameters in :meth:`step_cache_key`, or
-    two differently-configured instances would share one compiled step.
+    behavior declare them in ``config_fields`` — the default
+    :meth:`step_cache_key` hashes those attribute values, so two
+    differently-configured instances of one class can never silently
+    share a compiled step (round-2 verdict #9 / advisor finding).
     """
 
     #: False for host-state aggregations (update/combine get host edge arrays)
     device: bool = True
+
+    #: names of instance attributes whose values change the behavior of
+    #: initial_state/update/combine/transform; hashed into the step-cache
+    #: key. Values must be hashable.
+    config_fields: tuple = ()
 
     def __init__(self, transient_state: bool = False, mesh=None):
         self.transient_state = transient_state
@@ -94,7 +101,9 @@ class SummaryAggregation(abc.ABC):
 
     def step_cache_key(self):
         """Hashable identity of the compiled window step (see class doc)."""
-        return (type(self),)
+        return (type(self),) + tuple(
+            getattr(self, f) for f in self.config_fields
+        )
 
     # ------------------------------------------------------------------ #
     # State protocol (the updateFun / combineFun / transform slots)

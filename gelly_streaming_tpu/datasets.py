@@ -196,9 +196,15 @@ class IdentityDict:
 
     def __init__(self, id_bound: int):
         self.id_bound = int(id_bound)
+        self._observed = 0  # max encoded id + 1
 
     def __len__(self) -> int:
-        return self.id_bound
+        """Number of ids actually observed (max + 1), NOT the declared
+        bound: consumers that treat ``len(vdict)`` as the seen-vertex
+        count (IncrementalPageRank's teleport mass) would otherwise
+        spread rank over the whole declared id space (round-2 advisor
+        finding)."""
+        return self._observed
 
     @property
     def capacity(self) -> int:
@@ -206,13 +212,23 @@ class IdentityDict:
 
         return bucket_capacity(max(1, self.id_bound))
 
+    def observe(self, max_id: int) -> None:
+        """Advance the observed-id watermark (the single implementation of
+        the ``len()`` semantics — encode and the parser fast path both
+        route through here)."""
+        if max_id >= self._observed:
+            self._observed = max_id + 1
+
     def encode(self, raw):
         a = np.asarray(raw)
-        if a.size and (int(a.min()) < 0 or int(a.max()) >= self.id_bound):
-            raise ValueError(
-                f"raw id outside [0, {self.id_bound}) — not a dense-id "
-                "corpus; use VertexDict"
-            )
+        if a.size:
+            hi = int(a.max())
+            if int(a.min()) < 0 or hi >= self.id_bound:
+                raise ValueError(
+                    f"raw id outside [0, {self.id_bound}) — not a dense-id "
+                    "corpus; use VertexDict"
+                )
+            self.observe(hi)
         return a if a.dtype == np.int32 else a.astype(np.int32)
 
     def encode_pair(self, src, dst):
@@ -228,7 +244,10 @@ class IdentityDict:
         return int(raw) if 0 <= int(raw) < self.id_bound else None
 
     def raw_ids(self) -> np.ndarray:
-        return np.arange(self.id_bound, dtype=np.int64)
+        """Ids observed so far (the checkpoint surface): restoring these
+        through ``encode`` reproduces the watermark instead of resetting
+        ``len()`` to the whole declared bound."""
+        return np.arange(self._observed, dtype=np.int64)
 
     def raw_table(self):
         import jax.numpy as jnp
@@ -253,8 +272,19 @@ def binary_cache(path: str, bin_path: Optional[str] = None, arrays=None) -> str:
     already holds the parsed columns."""
     if bin_path is None:
         bin_path = path + ".gbin"
-    if os.path.exists(bin_path) and os.path.getmtime(bin_path) >= os.path.getmtime(path):
-        return bin_path
+    # freshness by source size+mtime sidecar, not mtime ORDER: a restored
+    # or copied corpus file can carry any mtime and would silently serve
+    # a stale cache (round-2 advisor finding; same fix as the .so build)
+    st = os.stat(path)
+    stamp = f"{st.st_size}:{int(st.st_mtime_ns)}"
+    sidecar = bin_path + ".src"
+    if os.path.exists(bin_path):
+        try:
+            with open(sidecar) as f:
+                if f.read().strip() == stamp:
+                    return bin_path
+        except OSError:
+            pass
     src, dst, val = arrays if arrays is not None else native.parse_edge_file(path)
     if src.size and (
         max(src.max(), dst.max()) > np.iinfo(np.int32).max or min(src.min(), dst.min()) < 0
@@ -269,6 +299,8 @@ def binary_cache(path: str, bin_path: Optional[str] = None, arrays=None) -> str:
         if val is not None:
             val.astype(np.float32).tofile(f)
     os.replace(bin_path + ".tmp", bin_path)
+    with open(sidecar, "w") as f:
+        f.write(stamp)
     return bin_path
 
 
@@ -419,7 +451,11 @@ def stream_file(
 
     The returned stream re-reads the file on every iteration (streams are
     lazily re-iterable). ``prefetch_depth > 0`` overlaps parse/window/encode
-    against device compute on a background thread. ``min_vertex_capacity``
+    against device compute on a background thread; as with
+    ``SimpleEdgeStream.prefetched``, the shared vertex dict (including
+    ``IdentityDict``'s observed-id watermark) may then run up to ``depth``
+    windows ahead of the consumer — only mid-stream ``len(vertex_dict)``
+    readers observe the lead. ``min_vertex_capacity``
     pre-sizes the vertex table (e.g. from the corpus spec) so carried device
     state compiles once instead of once per capacity-growth bucket.
 
@@ -476,10 +512,17 @@ def stream_file(
             pairs = windower.blocks_from_chunks(chunks, encoded=True)
         elif identity:
             # the i32 parser already bound-checks against the id space, so
-            # the columns pass through with no further validation/convert
-            chunks = native.iter_edge_chunks_i32(
+            # the columns pass through with no further validation/convert;
+            # only the observed-id watermark (len(vdict)) needs updating
+            def _tracked(chunks, vd=vd):
+                for s, d, v in chunks:
+                    if len(s):
+                        vd.observe(int(max(int(s.max()), int(d.max()))))
+                    yield s, d, v
+
+            chunks = _tracked(native.iter_edge_chunks_i32(
                 path, chunk_edges, id_bound=vd.id_bound
-            )
+            ))
             pairs = windower.blocks_from_chunks(chunks, encoded=True)
         elif getattr(vd, "_native", None) is not None:
             # fused native ingest: parse+encode in one C pass per chunk

@@ -275,15 +275,53 @@ class BroadcastTriangleCount:
                 len(cache[0]) if cache is not None
                 else int(np.asarray(block.mask).sum())
             )
+            beta = int(beta_sum)
+            self._last_beta = beta
             estimate = int(
                 (1.0 / self.samples)
-                * int(beta_sum)
+                * beta
                 * host_edge_count
                 * (self.vertex_count - 2)
             )
             if estimate != self._previous:
                 self._previous = estimate
                 yield host_edge_count, estimate
+
+    def run_estimates(self, edges: Iterable[Tuple]):
+        """``run()`` with typed emissions: yields the
+        :class:`~gelly_streaming_tpu.utils.types.TriangleEstimate` partial
+        behind each change-only emission — the record the reference's
+        samplers route to their collector (``util/TriangleEstimate.java``,
+        ``BroadcastTriangleCount.java:150-170``). ``source`` is 0: the
+        vectorized estimator is one logical subtask."""
+        from ..utils.types import TriangleEstimate
+
+        for edge_count, _ in self.run(edges):
+            yield TriangleEstimate(
+                source=0, edge_count=edge_count,
+                beta=getattr(self, "_last_beta", 0),
+            )
+
+    def sampled_edges(self) -> list:
+        """The current reservoir as typed
+        :class:`~gelly_streaming_tpu.utils.types.SampledEdge` records
+        (``util/SampledEdge.java``): one per occupied sample instance.
+        ``resample`` is False — the vectorized reservoir replaces edges in
+        place rather than routing resample messages between subtasks."""
+        from ..core.types import Edge
+        from ..utils.types import SampledEdge
+
+        src = np.asarray(self._state["src"])
+        trg = np.asarray(self._state["trg"])
+        n = int(self._edge_count)
+        return [
+            SampledEdge(
+                subtask=0, instance=int(i), edge=Edge(int(s), int(t), None),
+                edge_count=n, resample=False,
+            )
+            for i, (s, t) in enumerate(zip(src.tolist(), trg.tolist()))
+            if s >= 0
+        ]
 
 
 class IncidenceSamplingTriangleCount(BroadcastTriangleCount):

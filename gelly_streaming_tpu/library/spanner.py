@@ -37,6 +37,7 @@ class Spanner(SummaryBulkAggregation):
     """k-spanner over the edge stream (``library/Spanner.java``)."""
 
     device = False
+    config_fields = ("k",)
 
     def __init__(self, k: int, transient_state: bool = False):
         super().__init__(transient_state=transient_state)

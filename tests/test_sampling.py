@@ -115,3 +115,34 @@ def test_vectorized_matches_scan_statistically():
     true = 560
     assert 0.5 * true < a < 2.0 * true, a
     assert 0.5 * true < b < 2.0 * true, b
+
+
+def test_typed_sampler_emissions():
+    """SampledEdge / TriangleEstimate are live emission types: the sampler
+    materializes its reservoir and its partial estimates as the
+    reference's record shapes (round-2 verdict #8)."""
+    import numpy as np
+
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library.sampling import BroadcastTriangleCount
+    from gelly_streaming_tpu.utils.types import SampledEdge, TriangleEstimate
+
+    rng = np.random.default_rng(2)
+    edges = [
+        (int(a), int(b))
+        for a, b in zip(rng.integers(0, 30, 400), rng.integers(0, 30, 400))
+        if a != b
+    ]
+    btc = BroadcastTriangleCount(
+        vertex_count=30, samples=64, window=CountWindow(50), seed=1
+    )
+    ests = list(btc.run_estimates(edges))
+    assert ests, "a dense 30-vertex stream must change the estimate"
+    assert all(isinstance(e, TriangleEstimate) for e in ests)
+    assert all(e.beta >= 0 and e.edge_count > 0 for e in ests)
+    assert ests[-1].edge_count == len(edges)
+    sampled = btc.sampled_edges()
+    assert sampled and all(isinstance(s, SampledEdge) for s in sampled)
+    assert len(sampled) <= 64
+    ids = {v for s in sampled for v in (s.edge.src, s.edge.dst)}
+    assert ids <= set(range(30))
