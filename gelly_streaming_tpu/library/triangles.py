@@ -37,8 +37,12 @@ import numpy as np
 from ..core.edgeblock import bucket_capacity
 from ..core.window import CountWindow, WindowPolicy, Windower
 from ..ops.triangles import (
+    build_sorted_directed,
+    degree_class_plan,
+    grow_packed_columns,
     packed_triangle_update,
     prepare_packed_window,
+    sticky_search_steps,
     window_triangle_count,
 )
 
@@ -74,24 +78,33 @@ def _prep_step(pv, pn, pr, src, dst, mask, rank0, num_vertices: int,
     )
 
 
-@functools.partial(jax.jit, static_argnums=(9, 10))
+@functools.partial(jax.jit, static_argnums=(9, 10, 11))
 def _packed_count_step(
     pn, pr, row_ptr, qu, qv, qrank, qmask, sel, counts_and_delta,
-    enum_width: int, search_steps: int,
+    enum_width: int, search_steps: int, chunk: int,
 ):
     # no donation: emission is lazy (consumers may download a window's
     # counts after later windows have dispatched), so every window's
     # counts array must stay valid. `sel` (padded with -1) selects this
     # degree class's queries — the gather runs on device, so the host
-    # never materializes per-class columns.
-    counts, delta = counts_and_delta
-    selc = jnp.clip(sel, 0, qu.shape[0] - 1)
-    mask_s = (sel >= 0) & qmask[selc]
-    counts, d = packed_triangle_update(
-        pn, pr, row_ptr, qu[selc], qv[selc], qrank[selc], mask_s, counts,
-        enum_width, search_steps=search_steps,
-    )
-    return counts, delta + d
+    # never materializes per-class columns. Queries process in `chunk`
+    # slices via lax.scan: the [chunk, enum_width] enumeration block
+    # stays within a fixed budget instead of scaling with class size.
+    T = sel.shape[0]
+    sel_r = sel.reshape(T // chunk, chunk)
+
+    def body(carry, s_i):
+        counts, delta = carry
+        selc = jnp.clip(s_i, 0, qu.shape[0] - 1)
+        mask_s = (s_i >= 0) & qmask[selc]
+        counts, d = packed_triangle_update(
+            pn, pr, row_ptr, qu[selc], qv[selc], qrank[selc], mask_s,
+            counts, enum_width, search_steps=search_steps,
+        )
+        return (counts, delta + d), None
+
+    out, _ = jax.lax.scan(body, counts_and_delta, sel_r)
+    return out
 
 
 @jax.jit
@@ -252,12 +265,6 @@ class ExactTriangleCount:
     changed (downloaded lazily on first read).
     """
 
-    # min-degree classes are bucketed by powers of this factor: fewer,
-    # coarser classes = fewer per-window dispatches (each enqueue is
-    # milliseconds through the remote tunnel) at the price of up to
-    # CLASS_FACTOR x enumeration-width waste inside a class
-    CLASS_FACTOR = 4
-
     def __init__(self):
         # host carry: the RAW edge columns in arrival order (checkpoint
         # source of truth — canonicalization/dedup happen on device) and a
@@ -324,38 +331,19 @@ class ExactTriangleCount:
             ranks = pos_all[first].astype(np.int32)
             cu = cu[first].astype(np.int32)
             cv = cv[first].astype(np.int32)
-            pv = np.concatenate([cu, cv])
-            pn = np.concatenate([cv, cu])
-            pr = np.concatenate([ranks, ranks])
-            order = np.lexsort((pn, pv))
-            self._n_packed = len(pv)
-            cap = bucket_capacity(self._n_packed)
-            self._pv = jnp.asarray(
-                _pad_fill(pv[order], cap, np.iinfo(np.int32).max)
-            )
-            self._pn = jnp.asarray(_pad(pn[order], cap))
-            self._pr = jnp.asarray(_pad(pr[order], cap))
+            pvp, pnp, prp, n_new = build_sorted_directed(cu, cv, ranks)
+            self._n_packed = n_new
+            self._pv = jnp.asarray(pvp)
+            self._pn = jnp.asarray(pnp)
+            self._pr = jnp.asarray(prp)
             # future ranks must exceed every rebuilt rank
             self._n_raw = max(self._n_raw, len(self._u))
 
     # ------------------------------------------------------------------ #
     def _grow_packed(self, need: int) -> None:
-        """Grow the packed columns to a bucket covering ``need`` entries
-        (appending +INT32_MAX vertex sentinels keeps them sorted)."""
-        cap = bucket_capacity(max(need, 8))
-        if self._pv is None:
-            self._pv = jnp.full(cap, _BIG, jnp.int32)
-            self._pn = jnp.zeros(cap, jnp.int32)
-            self._pr = jnp.zeros(cap, jnp.int32)
-            return
-        old = self._pv.shape[0]
-        if cap <= old:
-            return
-        self._pv = jnp.concatenate(
-            [self._pv, jnp.full(cap - old, _BIG, jnp.int32)]
+        self._pv, self._pn, self._pr = grow_packed_columns(
+            self._pv, self._pn, self._pr, need
         )
-        self._pn = jnp.concatenate([self._pn, jnp.zeros(cap - old, jnp.int32)])
-        self._pr = jnp.concatenate([self._pr, jnp.zeros(cap - old, jnp.int32)])
 
     def _process(self, block, vdict) -> List[Tuple[int, int]]:
         vcap = block.n_vertices
@@ -410,35 +398,24 @@ class ExactTriangleCount:
         )
         self._n_packed += 2 * n_raw  # upper bound (dups masked on device)
 
-        # 2. count closures per min-degree class: enumeration rows are
-        # only as wide as each class's bucket (no hub-sized dense rows).
-        # Classes are powers of CLASS_FACTOR, not 2: a handful of
-        # dispatches per window instead of ~15 (each enqueue costs
-        # milliseconds through the remote tunnel), for at most
-        # CLASS_FACTOR x width waste inside a class. The duplicate-
-        # inflated degree bound only ever WIDENS a class — sound.
+        # 2. count closures per min-degree class (shared coarse-class /
+        # enum-budget / sticky-steps policy: ops/triangles.py). The
+        # duplicate-inflated degree bound only ever WIDENS a class — sound.
         mindeg = np.minimum(self._deg[s], self._deg[d])
-        fbits = int(self.CLASS_FACTOR).bit_length() - 1
-        exp = np.ceil(
-            np.log2(np.maximum(np.maximum(mindeg, 16), 1)) / fbits
-        ).astype(np.int64)
-        classes = np.int64(1) << (exp * fbits)
         acc = (self._counts, jnp.int32(0))
-        # the binary search only ever spans the largest row; a tight step
-        # count (vs a blanket 32) cuts the dominant inner loop ~2-3x
-        steps = max(4, int(bucket_capacity(int(self._deg.max()))).bit_length())
-        for c in np.unique(classes):
-            sel = np.nonzero(classes == c)[0].astype(np.int32)
+        self._search_steps = sticky_search_steps(
+            getattr(self, "_search_steps", 8), int(self._deg.max())
+        )
+        for width, sel, tcap, chunk in degree_class_plan(mindeg):
             if pos is not None:
                 sel = pos[sel]
-            t = len(sel)
-            tcap = bucket_capacity(t, minimum=16)
             acc = _packed_count_step(
                 self._pn, self._pr, row_ptr, qu, qv, qrank, qmask,
                 jnp.asarray(_pad_fill(sel, tcap, np.int32(-1))),
                 acc,
-                int(c),
-                steps,
+                width,
+                self._search_steps,
+                chunk,
             )
         self._counts, delta = acc
         self._total = _accum_total(self._total, delta)

@@ -101,6 +101,13 @@ def window_triangle_count(
     Returns ``(total, per_vertex[V])``; ``per_vertex[w]`` = number of window
     triangles containing ``w``.
     """
+    a, b, m, ids = _oriented_rows(src, dst, mask, num_vertices, max_degree)
+    return _membership_pass(ids, a, b, m, num_vertices, edge_chunk)
+
+
+def _oriented_rows(src, dst, mask, num_vertices: int, max_degree: int):
+    """Shared prep of the window kernel: canonical dedup'd edges oriented
+    low->high (degree, id) plus the sorted dense out-neighbor rows."""
     u, v, m = canonicalize(src, dst, mask)
     u, v, m = dedup_canonical(u, v, m, num_vertices)
     mi = m.astype(jnp.int32)
@@ -115,7 +122,12 @@ def window_triangle_count(
     csr = build_csr(a, b, zeros, m, num_vertices)
     nbr_mat, _, valid = dense_neighbors(csr, max_degree)
     ids = jnp.sort(jnp.where(valid, nbr_mat, _BIG), axis=1)
+    return a, b, m, ids
 
+
+def _membership_pass(ids, a, b, m, num_vertices: int, edge_chunk: int):
+    """Membership counting over (a, b) edge slices against the replicated
+    ``ids`` rows; [E, D] intermediates bounded by ``edge_chunk`` scan."""
     E = a.shape[0]
     pad_to = -(-E // edge_chunk) * edge_chunk
     ap = jnp.concatenate([a, jnp.zeros(pad_to - E, a.dtype)])
@@ -141,12 +153,52 @@ def window_triangle_count(
         counts = counts.at[a_i].add(cm).at[b_i].add(cm)
         return (counts, total + cm.sum()), None
 
-    (per_vertex, total), _ = jax.lax.scan(
-        chunk_step,
-        (jnp.zeros(num_vertices, jnp.int32), jnp.int32(0)),
-        (ac, bc, mc),
-    )
+    init = (jnp.zeros(num_vertices, jnp.int32), jnp.int32(0))
+    (per_vertex, total), _ = jax.lax.scan(chunk_step, init, (ac, bc, mc))
     return total, per_vertex
+
+
+def window_triangle_count_sharded(
+    src: jax.Array,
+    dst: jax.Array,
+    mask: jax.Array,
+    num_vertices: int,
+    max_degree: int,
+    mesh,
+    edge_chunk: int = 1 << 13,
+) -> Tuple[jax.Array, jax.Array]:
+    """Edge-sharded :func:`window_triangle_count` (SURVEY §2.5 P1 + P3).
+
+    The prep (canonicalize/dedup/orient/row build) is replicated — it
+    needs the whole window and is O(E log E) sort work; the membership
+    pass (the O(E*D) dominant cost) splits over the mesh's ``"edges"``
+    axis with the dense rows replicated, and the per-vertex counts and
+    total ``psum`` back over ICI. Deterministic: per-shard counting is
+    order-independent integer adds. The block capacity (a power of two)
+    must divide by the edge-axis size.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import comm
+    from ..parallel.mesh import EDGE_AXIS
+
+    a, b, m, ids = _oriented_rows(src, dst, mask, num_vertices, max_degree)
+
+    def shard_fn(ids_r, a_s, b_s, m_s):
+        total, counts = _membership_pass(
+            ids_r, a_s, b_s, m_s, num_vertices, edge_chunk
+        )
+        return (
+            jax.lax.psum(total, EDGE_AXIS),
+            jax.lax.psum(counts, EDGE_AXIS),
+        )
+
+    return comm.shard_map(
+        shard_fn,
+        mesh,
+        in_specs=(P(None, None), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)),
+        out_specs=(P(), P()),
+    )(ids, a, b, m)
 
 
 def ranged_searchsorted(arr, lo, hi, x, *, side: str = "left", steps: int = 32):
@@ -254,6 +306,135 @@ def prepare_packed_window(
         pv2, jnp.arange(num_vertices + 1, dtype=jnp.int32)
     ).astype(jnp.int32)
     return pv2, pn2, pr2, row_ptr, u, v, qrank, m
+
+
+# --------------------------------------------------------------------- #
+# Shared packed-adjacency carry helpers (used by the streaming triangle
+# pipeline AND the k=2 device spanner — one implementation of the growth,
+# host-side build, class-binning, and recompile-avoidance policies).
+# --------------------------------------------------------------------- #
+
+def grow_packed_columns(pv, pn, pr, need: int, minimum: int = 8):
+    """Grow (or create) packed (vertex, nbr, rank) columns to a pow2
+    bucket covering ``need`` entries — appending +INT32_MAX vertex
+    sentinels keeps the sort order."""
+    from ..core.edgeblock import bucket_capacity
+
+    cap = bucket_capacity(max(need, minimum))
+    if pv is None:
+        return (
+            jnp.full(cap, _BIG, jnp.int32),
+            jnp.zeros(cap, jnp.int32),
+            jnp.zeros(cap, jnp.int32),
+        )
+    old = pv.shape[0]
+    if cap <= old:
+        return pv, pn, pr
+    return (
+        jnp.concatenate([pv, jnp.full(cap - old, _BIG, jnp.int32)]),
+        jnp.concatenate([pn, jnp.zeros(cap - old, jnp.int32)]),
+        jnp.concatenate([pr, jnp.zeros(cap - old, jnp.int32)]),
+    )
+
+
+def build_sorted_directed(u, v, ranks=None, cap=None):
+    """Host-side build of both directed entries of canonical edges,
+    (vertex, nbr)-lexsorted and sentinel-padded: the merge input format
+    of :func:`merge_packed_adjacency`. Returns numpy
+    ``(pv, pn, pr, n_new)``."""
+    import numpy as _np
+
+    from ..core.edgeblock import bucket_capacity
+
+    pv_new = _np.concatenate([u, v])
+    pn_new = _np.concatenate([v, u])
+    if ranks is None:
+        pr_new = _np.zeros(len(pv_new), _np.int32)
+    else:
+        pr_new = _np.concatenate([ranks, ranks])
+    order = _np.lexsort((pn_new, pv_new))
+    n_new = len(pv_new)
+    ncap = cap if cap is not None else bucket_capacity(n_new, minimum=16)
+    pvp = _np.full(ncap, _np.iinfo(_np.int32).max, _np.int32)
+    pnp = _np.zeros(ncap, _np.int32)
+    prp = _np.zeros(ncap, _np.int32)
+    pvp[:n_new] = pv_new[order]
+    pnp[:n_new] = pn_new[order]
+    prp[:n_new] = pr_new[order]
+    return pvp, pnp, prp, n_new
+
+
+#: min-degree classes coarsen by powers of this factor: a handful of
+#: dispatches per window (each enqueue is milliseconds through the remote
+#: tunnel) for at most CLASS_FACTOR x enumeration-width waste in a class
+CLASS_FACTOR = 4
+
+#: [chunk, width] int32 entries budget for dense enumeration blocks
+ENUM_BUDGET = 1 << 24  # 64 MB
+
+
+def degree_class_plan(mindeg, class_factor: int = CLASS_FACTOR,
+                      enum_budget: int = ENUM_BUDGET):
+    """Group query indices into coarse min-degree classes.
+
+    Yields ``(width, sel, tcap, chunk)`` per class: ``sel`` the query
+    indices (numpy int32), ``tcap`` their pow2 padding, ``chunk`` the
+    scan slice keeping [chunk, width] within ``enum_budget``.
+    """
+    import numpy as _np
+
+    from ..core.edgeblock import bucket_capacity
+
+    fbits = int(class_factor).bit_length() - 1
+    exp = _np.ceil(
+        _np.log2(_np.maximum(_np.maximum(mindeg, 16), 1)) / fbits
+    ).astype(_np.int64)
+    classes = _np.int64(1) << (exp * fbits)
+    for c in _np.unique(classes):
+        sel = _np.nonzero(classes == c)[0].astype(_np.int32)
+        tcap = bucket_capacity(len(sel), minimum=16)
+        chunk = min(tcap, bucket_capacity(max(enum_budget // int(c), 16)))
+        yield int(c), sel, tcap, int(chunk)
+
+
+def sticky_search_steps(current: int, max_degree: int) -> int:
+    """Monotone, 8-quantized binary-search step count covering the
+    longest adjacency row: at most a few distinct jit signatures over a
+    stream's lifetime (each recompile costs ~20-40 s through the remote
+    compiler), instead of churning every time the max degree crosses a
+    pow2 bucket."""
+    from ..core.edgeblock import bucket_capacity
+
+    needed = max(4, int(bucket_capacity(max(int(max_degree), 1))).bit_length())
+    return max(current, ((needed + 7) // 8) * 8)
+
+
+def packed_common_neighbor_exists(
+    pn, row_ptr, qu, qv, qmask, enum_width: int, search_steps: int = 32,
+):
+    """For each query pair (qu, qv): do their packed-adjacency rows share
+    a neighbor? The k=2 reachability primitive of the device spanner —
+    common-neighbor existence over the same packed sorted adjacency the
+    triangle pipeline carries, with per-class dense enumeration rows (the
+    caller groups queries by min-degree class). No [B, V] frontier."""
+    d_u = row_ptr[qu + 1] - row_ptr[qu]
+    d_v = row_ptr[qv + 1] - row_ptr[qv]
+    take_u = d_u <= d_v
+    small = jnp.where(take_u, qu, qv)
+    big = jnp.where(take_u, qv, qu)
+    idx = row_ptr[small][:, None] + jnp.arange(enum_width)[None, :]
+    valid = (
+        qmask[:, None]
+        & (jnp.arange(enum_width)[None, :] < jnp.minimum(d_u, d_v)[:, None])
+    )
+    idx = jnp.clip(idx, 0, pn.shape[0] - 1)
+    w = pn[idx]
+    lo = jnp.broadcast_to(row_ptr[big][:, None], w.shape)
+    hi = jnp.broadcast_to(row_ptr[big + 1][:, None], w.shape)
+    pos = ranged_searchsorted(pn, lo, hi, w, steps=search_steps)
+    pos_c = jnp.clip(pos, 0, pn.shape[0] - 1)
+    found = valid & (pos < hi) & (pn[pos_c] == w)
+    return found.any(axis=1)
 
 
 def packed_triangle_update(

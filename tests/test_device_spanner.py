@@ -61,9 +61,13 @@ def test_device_spanner_valid_for_any_windowing(window, k):
     )
 
 
-def test_device_spanner_window1_matches_host():
+@pytest.mark.parametrize("k", [2, 3])
+def test_device_spanner_window1_matches_host(k):
     """With one edge per window the batch degenerates to the sequential
-    fold — identical spanner to the host-exact Spanner."""
+    fold — identical spanner to the host-exact Spanner. k=2 exercises the
+    packed common-neighbor fast path; k=3 the bitplane frontier BFS
+    (a false-NEGATIVE reachability bug would keep extra edges, which only
+    this equality check catches)."""
     rng = np.random.default_rng(9)
     raw = [
         (int(a), int(b), 0.0)
@@ -72,7 +76,6 @@ def test_device_spanner_window1_matches_host():
         # self-loops (boundedBFS never 'finds' src from src); the device
         # flavor drops them — compare on loop-free input
     ]
-    k = 3
     dev = DeviceSpanner(k=k)
     for out in dev.run(SimpleEdgeStream(raw, window=CountWindow(1))):
         pass

@@ -138,17 +138,18 @@ def test_matching_and_spanner_checkpoint_resume(tmp_path):
     list(m_full.run(EDGES))
     assert m2.matching() == m_full.matching()
 
-    sp1 = DeviceSpanner(k=3)
-    stream = SimpleEdgeStream(EDGES[:SPLIT], window=CountWindow(4))
-    for _ in sp1.run(stream):
-        pass
-    spath = str(tmp_path / "sp")
-    checkpoint.save_workload(spath, sp1, stream.vertex_dict)
-    sp2 = DeviceSpanner(k=3)
-    vdict = checkpoint.restore_workload(spath, sp2)
-    for _ in sp2.run(_resume_stream(vdict, EDGES[SPLIT:])):
-        pass
-    # resumed spanner is a valid 3-spanner of the full edge set
     from tests.test_device_spanner import assert_valid_spanner
 
-    assert_valid_spanner([(s, d) for s, d, _ in EDGES], sp2.edges(), 3)
+    for k in (2, 3):  # k=2: packed-adjacency rebuild; k=3: frontier BFS
+        sp1 = DeviceSpanner(k=k)
+        stream = SimpleEdgeStream(EDGES[:SPLIT], window=CountWindow(4))
+        for _ in sp1.run(stream):
+            pass
+        spath = str(tmp_path / f"sp{k}")
+        checkpoint.save_workload(spath, sp1, stream.vertex_dict)
+        sp2 = DeviceSpanner(k=k)
+        vdict = checkpoint.restore_workload(spath, sp2)
+        for _ in sp2.run(_resume_stream(vdict, EDGES[SPLIT:])):
+            pass
+        # resumed spanner is a valid k-spanner of the full edge set
+        assert_valid_spanner([(s, d) for s, d, _ in EDGES], sp2.edges(), k)
