@@ -47,15 +47,13 @@ def _span_row_ptr(pv, num_vertices: int):
 def _k2_exists_step(pn, row_ptr, qu, qv, sel, acc, enum_width: int,
                     search_steps: int, chunk: int):
     """One min-degree class of common-neighbor existence queries; results
-    scatter into the shared per-window accumulator. Queries process in
-    ``chunk`` slices via ``lax.scan`` so the [chunk, enum_width]
-    enumeration block stays within a fixed memory budget — a whole
-    1M-query class at width 4096 would otherwise materialize 16 GB."""
-    from ..ops.triangles import packed_common_neighbor_exists
-
-    T = sel.shape[0]
-    n_chunks = T // chunk
-    sel_r = sel.reshape(n_chunks, chunk)
+    scatter into the shared per-window accumulator. ``chunked_class_scan``
+    bounds the [chunk, enum_width] enumeration block — a whole 1M-query
+    class at width 4096 would otherwise materialize 16 GB."""
+    from ..ops.triangles import (
+        chunked_class_scan,
+        packed_common_neighbor_exists,
+    )
 
     def body(acc, s_i):
         selc = jnp.clip(s_i, 0, qu.shape[0] - 1)
@@ -64,13 +62,9 @@ def _k2_exists_step(pn, row_ptr, qu, qv, sel, acc, enum_width: int,
             pn, row_ptr, qu[selc], qv[selc], mask, enum_width,
             search_steps=search_steps,
         )
-        return (
-            acc.at[jnp.where(mask, selc, acc.shape[0])].set(ex, mode="drop"),
-            None,
-        )
+        return acc.at[jnp.where(mask, selc, acc.shape[0])].set(ex, mode="drop")
 
-    acc, _ = jax.lax.scan(body, acc, sel_r)
-    return acc
+    return chunked_class_scan(body, acc, sel, chunk)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -218,10 +212,11 @@ class DeviceSpanner:
         self._deg = np.zeros(0, np.int64)
 
     def _batch_cap(self, vcap: int) -> int:
-        # budget counts frontier ENTRIES ([B/32, V] uint32 words hold 32
-        # queries each), so the bitplane packing buys 32x the batch at the
-        # same footprint; the kernel needs B to be a multiple of 32
-        words = max(1, self.mem_budget_entries // max(vcap, 1))
+        # budget is BYTES of frontier: [B/32, V] uint32 words hold 32
+        # queries per 4 bytes, so bitplane packing buys 8x the queries of
+        # the old [B, V] bool frontier at the same footprint; the kernel
+        # needs B to be a multiple of 32
+        words = max(1, self.mem_budget_entries // (4 * max(vcap, 1)))
         b = max(32, min(self.query_chunk, words * 32))
         b = (b // 32) * 32
         return bucket_capacity(b) // 2 if bucket_capacity(b) > b else b

@@ -87,11 +87,8 @@ def _packed_count_step(
     # counts after later windows have dispatched), so every window's
     # counts array must stay valid. `sel` (padded with -1) selects this
     # degree class's queries — the gather runs on device, so the host
-    # never materializes per-class columns. Queries process in `chunk`
-    # slices via lax.scan: the [chunk, enum_width] enumeration block
-    # stays within a fixed budget instead of scaling with class size.
-    T = sel.shape[0]
-    sel_r = sel.reshape(T // chunk, chunk)
+    # never materializes per-class columns.
+    from ..ops.triangles import chunked_class_scan
 
     def body(carry, s_i):
         counts, delta = carry
@@ -101,10 +98,9 @@ def _packed_count_step(
             pn, pr, row_ptr, qu[selc], qv[selc], qrank[selc], mask_s,
             counts, enum_width, search_steps=search_steps,
         )
-        return (counts, delta + d), None
+        return counts, delta + d
 
-    out, _ = jax.lax.scan(body, counts_and_delta, sel_r)
-    return out
+    return chunked_class_scan(body, counts_and_delta, sel, chunk)
 
 
 @jax.jit

@@ -397,6 +397,18 @@ def degree_class_plan(mindeg, class_factor: int = CLASS_FACTOR,
         yield int(c), sel, tcap, int(chunk)
 
 
+def chunked_class_scan(body_fn, carry, sel, chunk: int):
+    """Scan one degree class's padded selection (``-1`` padding) in
+    ``chunk`` slices: ``body_fn(carry, sel_slice) -> carry``. The shared
+    scaffold of the per-class query kernels (triangle counting, spanner
+    common-neighbor tests) — bounds the [chunk, width] enumeration block
+    instead of materializing the whole class at once. ``sel`` length and
+    ``chunk`` are both powers of two, so the reshape is exact."""
+    sel_r = sel.reshape(sel.shape[0] // chunk, chunk)
+    out, _ = jax.lax.scan(lambda c, s: (body_fn(c, s), None), carry, sel_r)
+    return out
+
+
 def sticky_search_steps(current: int, max_degree: int) -> int:
     """Monotone, 8-quantized binary-search step count covering the
     longest adjacency row: at most a few distinct jit signatures over a

@@ -33,11 +33,14 @@ class EngineConfig:
     capacity: Optional[int] = None
     #: edge-axis shards for the device mesh (None = all devices)
     edge_shards: Optional[int] = None
-    #: run the vertex mapping on the accelerator (dense-id corpora;
-    #: requires ``id_bound``) — see ``datasets.stream_file``
+    #: run the vertex mapping on the accelerator — see
+    #: ``datasets.stream_file``. With ``id_bound`` set, the device table
+    #: covers the declared dense id space; with ``id_bound=0`` this is the
+    #: GENERAL arbitrary-id path (growth mode, exact host-side novelty
+    #: tracking, zero device->host reads)
     device_encode: bool = False
-    #: raw id-space bound for identity/device vertex mappings (0 = general
-    #: host dictionary)
+    #: raw id-space bound for identity/device vertex mappings (0 = general:
+    #: host dictionary, or device growth mode under ``device_encode``)
     id_bound: int = 0
 
     def window(self, timestamp_fn=None) -> WindowPolicy:
@@ -51,7 +54,10 @@ class EngineConfig:
 
         kw = {}
         if self.device_encode:
-            kw = dict(device_encode=True, min_vertex_capacity=self.id_bound)
+            kw = dict(
+                device_encode=True, min_vertex_capacity=self.id_bound,
+                dense_ids=bool(self.id_bound),
+            )
         elif self.id_bound:
             kw = dict(vertex_dict=datasets.IdentityDict(self.id_bound))
         return datasets.stream_file(path, window=self.window(), **kw)
