@@ -2,7 +2,11 @@
 
 The compute path is JAX/XLA; the runtime AROUND it is native where it
 matters. Today that is file ingest (``ingest.cpp``): parsing large edge
-lists in Python is ~50x slower than the device consumes them.
+lists in Python is ~50x slower than the device consumes them. Reference
+analog: Flink's parallel text sources + per-line split mappers
+(``env.readTextFile``, ``ConnectedComponentsExample.java:106-118``) — the
+reference itself is 100% Java with no native code (SURVEY.md §2), so this
+layer replaces the JVM runtime, not a C++ one.
 
 The shared library builds lazily on first use with ``g++ -O3`` and is
 cached next to the source; every entry point has a pure-numpy fallback so

@@ -1,5 +1,10 @@
 """Device-resident vertex dictionary: the keyBy ON the accelerator.
 
+Reference analog: the raw-id keyed state behind every ``keyBy(vertex)``
+(``SimpleEdgeStream.java:119,303,537``; ``summaries/DisjointSet.java:30``
+keys HashMaps by raw ``Long`` directly). The TPU form needs dense compact
+ids; this module produces them without host hashing.
+
 The host ``VertexDict`` (C++ hash map) costs ~20 ns per id on the single
 host core — at corpus scale that is the end-to-end ceiling (ROADMAP #1).
 This module keeps the raw-id -> compact-id mapping AS DEVICE STATE and
