@@ -42,6 +42,96 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# --------------------------------------------------------------------- #
+# Backend availability: probe + stale fallback (round-3 verdict #1)
+# --------------------------------------------------------------------- #
+# Per-try subprocess timeouts + sleeps before each try. First jit through
+# the tunnel can cost 20-40 s, so try 1 gets 180 s; a hard-down tunnel
+# hangs every try to its full timeout, so the worst-case stall before the
+# stale fallback fires is sum(both) = ~7.5 min — keep that bounded or the
+# driver's own timeout kills the process before the fallback can emit.
+PROBE_TIMEOUTS_S = (180, 90, 90)
+PROBE_BACKOFFS = (0, 30, 60)
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp; "
+    "x = jnp.ones((256, 256)); "
+    "print(float((x @ x).sum()))"
+)
+
+
+def probe_backend() -> bool:
+    """True iff a trivial jit completes on the default backend.
+
+    Runs in a SUBPROCESS with a hard timeout: a down tunnel HANGS (the
+    round-3 outage hung trivial jits >4 min) rather than erroring, so an
+    in-process probe would wedge the whole bench. Bounded retry/backoff:
+    transient tunnel blips recover in under a minute; a hard-down tunnel
+    fails all tries and the caller falls back to the stale headline."""
+    import subprocess
+
+    for i, (tmo, backoff) in enumerate(zip(PROBE_TIMEOUTS_S, PROBE_BACKOFFS)):
+        if backoff:
+            log(f"bench: backend probe retry in {backoff}s "
+                f"({i}/{len(PROBE_BACKOFFS) - 1})...")
+            time.sleep(backoff)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=tmo,
+            )
+            if out.returncode == 0:
+                return True
+            log(f"bench: backend probe failed rc={out.returncode}: "
+                f"{out.stderr[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"bench: backend probe hung >{tmo}s (tunnel down)")
+    return False
+
+
+def stale_headline() -> dict:
+    """Last-good headline, tagged stale — emitted (rc 0) when the backend
+    stays down so an outage costs freshness, not the round's artifact.
+    Sources, newest first: BENCH_DETAIL.json, then driver BENCH_r*.json."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [os.path.join(here, "BENCH_DETAIL.json")] + sorted(
+        glob.glob(os.path.join(here, "BENCH_r*.json")), reverse=True
+    )
+    for path in candidates:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        h = doc.get("headline", doc)
+        if isinstance(h, dict) and "metric" in h and "value" in h:
+            h = dict(h)
+            h["stale"] = True
+            h["stale_source"] = os.path.basename(path)
+            return h
+    return {
+        "metric": "streaming_cc_e2e_edges_per_sec", "value": 0.0,
+        "unit": "edges/sec", "vs_baseline": 0.0, "stale": True,
+        "stale_source": None,
+    }
+
+
+STEADY_REPS = 3  # median-of-N steady passes per e2e config (verdict #1c)
+
+
+def median_steady(one_pass, n: int = STEADY_REPS):
+    """Warm once (pays jit compiles), then ``n`` steady passes; returns
+    (median_pass_result, all_eps) keyed by the 'eps'/first element."""
+    one_pass()
+    passes = [one_pass() for _ in range(n)]
+    key = (lambda p: p["eps"]) if isinstance(passes[0], dict) else (lambda p: p)
+    passes.sort(key=key)
+    return passes[n // 2], [round(key(p), 1) for p in passes]
+
+
 def make_stream(n_vertices: int, n_edges: int, seed: int = 7):
     """Power-law-ish random edge stream (Zipf endpoints, like social graphs)."""
     rng = np.random.default_rng(seed)
@@ -97,17 +187,17 @@ def bench_cc_e2e(path: str, vdict_factory, n_edges: int) -> dict:
         # the final summary's labels are already synced by the engine;
         # component materialization is lazy and not part of the pipe rate
         dt = time.perf_counter() - t0
-        return dt, lat, last
+        lat_ms = np.asarray(lat) * 1e3
+        return {
+            "eps": n_edges / dt,
+            "p50_ms": float(np.percentile(lat_ms, 50)),
+            "p95_ms": float(np.percentile(lat_ms, 95)),
+            "components": len(last.component_sets()),
+        }
 
-    one_pass()  # warm: pays the jit compile for this (vcap, window) shape
-    dt, lat, last = one_pass()
-    lat_ms = np.asarray(lat) * 1e3
-    return {
-        "eps": n_edges / dt,
-        "p50_ms": float(np.percentile(lat_ms, 50)),
-        "p95_ms": float(np.percentile(lat_ms, 95)),
-        "components": len(last.component_sets()),
-    }
+    out, eps_all = median_steady(one_pass)
+    out["eps_all"] = eps_all
+    return out
 
 
 BASELINE_REPS = 3  # median-of-N: one noisy C++ run must not set the ratio
@@ -187,17 +277,17 @@ def bench_cc_e2e_device(bin_path: str, bound: int, n_edges: int) -> dict:
             lat.append(now - last_t)
             last_t = now
         dt = time.perf_counter() - t0
-        return dt, lat, last
+        lat_ms = np.asarray(lat) * 1e3
+        return {
+            "eps": n_edges / dt,
+            "p50_ms": float(np.percentile(lat_ms, 50)),
+            "p95_ms": float(np.percentile(lat_ms, 95)),
+            "components": len(last.component_sets()),
+        }
 
-    one_pass()
-    dt, lat, last = one_pass()
-    lat_ms = np.asarray(lat) * 1e3
-    return {
-        "eps": n_edges / dt,
-        "p50_ms": float(np.percentile(lat_ms, 50)),
-        "p95_ms": float(np.percentile(lat_ms, 95)),
-        "components": len(last.component_sets()),
-    }
+    out, eps_all = median_steady(one_pass)
+    out["eps_all"] = eps_all
+    return out
 
 
 def bench_cc_e2e_device_text(path: str, cap_hint: int, n_edges: int) -> dict:
@@ -226,16 +316,40 @@ def bench_cc_e2e_device_text(path: str, cap_hint: int, n_edges: int) -> dict:
             lat.append(now - last_t)
             last_t = now
         dt = time.perf_counter() - t0
-        return dt, lat, last
+        lat_ms = np.asarray(lat) * 1e3
+        return {
+            "eps": n_edges / dt,
+            "p50_ms": float(np.percentile(lat_ms, 50)),
+            "p95_ms": float(np.percentile(lat_ms, 95)),
+            "components": len(last.component_sets()),
+        }
 
-    one_pass()
-    dt, lat, last = one_pass()
-    lat_ms = np.asarray(lat) * 1e3
+    out, eps_all = median_steady(one_pass)
+    out["eps_all"] = eps_all
+    return out
+
+
+def bench_cc_flink_proxy(src, dst) -> dict:
+    """Flink-representative CPU baseline (round-3 verdict #4): the
+    reference's CC job graph with per-record serialized shuffles + a
+    serialized partial-merge hop, compiled (``native.flink_proxy``).
+    No JVM is available in this image, so the real reference cannot run
+    here; this proxy deliberately over-estimates Flink (C++, in-process
+    queues, no GC/netty), making ``vs_flink`` a conservative lower bound.
+    Median-of-``BASELINE_REPS``; the caller cross-checks the bracket
+    python_unionfind <= proxy <= compiled_baseline."""
+    from gelly_streaming_tpu import native
+
+    runs = [native.flink_proxy(src, dst, window=WINDOW)
+            for _ in range(BASELINE_REPS)]
+    secs = float(np.median([r[0] for r in runs]))
     return {
-        "eps": n_edges / dt,
-        "p50_ms": float(np.percentile(lat_ms, 50)),
-        "p95_ms": float(np.percentile(lat_ms, 95)),
-        "components": len(last.component_sets()),
+        "eps": round(len(src) / secs, 1),
+        "cc_s_all": [round(r[0], 3) for r in runs],
+        "components": runs[0][1],
+        "model": "compiled reference job graph + per-record serialized "
+                 "shuffle + serialized partial merge; upper-bounds real "
+                 "single-host Flink (no JVM/GC/netty modeled)",
     }
 
 
@@ -270,7 +384,13 @@ def bench_cc_python_tier(src, dst, sample: int) -> float:
 # --------------------------------------------------------------------- #
 # Kernel-only CC (round-1 headline, kept as the device-side number)
 # --------------------------------------------------------------------- #
-def bench_cc_kernel(src, dst, n_vertices: int, window: int) -> float:
+def bench_cc_kernel(src, dst, n_vertices: int, window: int) -> dict:
+    """Median-of-N kernel rate. Every timed dispatch carries a DISTINCT
+    (summary, block) pair: the remote runtime memoizes identical
+    dispatches, so re-timing the same block chain (including the warm
+    block) replays cached results and inflates the rate (round-3 roofline
+    bug, same mechanism). Each rep streams its own disjoint window span;
+    the warm window is outside every timed span."""
     import jax
     import jax.numpy as jnp
 
@@ -283,30 +403,41 @@ def bench_cc_kernel(src, dst, n_vertices: int, window: int) -> float:
         part = cc_fold(init_labels(n_vertices), s, d, m)
         return label_combine(summary, part)
 
-    n_win = n_edges // window
+    n_total = n_edges // window
+    assert n_total >= 2, (
+        "need >=2 windows: one warms the jit, the rest are timed"
+    )
+    reps = min(STEADY_REPS, n_total - 1)
+    n_win = (n_total - 1) // reps
     blocks = [
         (
             jnp.asarray(src[i * window : (i + 1) * window]),
             jnp.asarray(dst[i * window : (i + 1) * window]),
             jnp.ones(window, bool),
         )
-        for i in range(n_win)
+        for i in range(1 + reps * n_win)
     ]
     summary = init_labels(n_vertices)
     warm = step(summary, *blocks[0])
     jax.block_until_ready(warm)
 
-    t0 = time.perf_counter()
-    for s, d, m in blocks:
-        summary = step(summary, s, d, m)
-    jax.block_until_ready(summary)
-    dt = time.perf_counter() - t0
+    rates = []
+    summary = warm
+    for r in range(reps):
+        span = blocks[1 + r * n_win : 1 + (r + 1) * n_win]
+        t0 = time.perf_counter()
+        for s, d, m in span:
+            summary = step(summary, s, d, m)
+        jax.block_until_ready(summary)
+        rates.append(n_win * window / (time.perf_counter() - t0))
     lab = np.asarray(summary["labels"])
     assert (lab[lab] == lab).all()
-    return n_win * window / dt
+    rates.sort()
+    return {"eps": round(rates[len(rates) // 2], 1),
+            "eps_all": [round(x, 1) for x in rates]}
 
 
-def bench_degrees_e2e(bin_path: str, bound: int, n_edges: int) -> float:
+def bench_degrees_e2e(bin_path: str, bound: int, n_edges: int) -> dict:
     """BASELINE config #1 end-to-end: binary corpus -> stream ->
     continuous degree emission (batched view consumed per window)."""
     from gelly_streaming_tpu import datasets
@@ -322,14 +453,16 @@ def bench_degrees_e2e(bin_path: str, bound: int, n_edges: int) -> float:
             pass
         return n_edges / (time.perf_counter() - t0)
 
-    one_pass()
-    return one_pass()
+    med, eps_all = median_steady(one_pass)
+    return {"eps": round(med, 1), "eps_all": eps_all}
 
 
 # --------------------------------------------------------------------- #
 # Config #1: continuous degree aggregate
 # --------------------------------------------------------------------- #
-def bench_degrees(src, dst, n_vertices: int, window: int) -> float:
+def bench_degrees(src, dst, n_vertices: int, window: int) -> dict:
+    """Median-of-N; the carried ``deg`` makes every dispatch distinct
+    (no memoization hazard), but each rep still times a disjoint span."""
     import jax
     import jax.numpy as jnp
 
@@ -338,59 +471,83 @@ def bench_degrees(src, dst, n_vertices: int, window: int) -> float:
         ones = jnp.ones(s.shape[0], jnp.int32)
         return deg.at[s].add(ones).at[d].add(ones)
 
-    n_win = src.shape[0] // window
+    n_total = src.shape[0] // window
+    assert n_total >= 2, (
+        "need >=2 windows: one warms the jit, the rest are timed"
+    )
+    reps = min(STEADY_REPS, n_total - 1)
+    n_win = (n_total - 1) // reps
     deg = jnp.zeros(n_vertices, jnp.int32)
     blocks = [
         (jnp.asarray(src[i * window : (i + 1) * window]),
          jnp.asarray(dst[i * window : (i + 1) * window]))
-        for i in range(n_win)
+        for i in range(1 + reps * n_win)
     ]
     deg = step(deg, *blocks[0])
     jax.block_until_ready(deg)
-    t0 = time.perf_counter()
-    for s, d in blocks:
-        deg = step(deg, s, d)
-    jax.block_until_ready(deg)
-    return n_win * window / (time.perf_counter() - t0)
+    rates = []
+    for r in range(reps):
+        span = blocks[1 + r * n_win : 1 + (r + 1) * n_win]
+        t0 = time.perf_counter()
+        for s, d in span:
+            deg = step(deg, s, d)
+        jax.block_until_ready(deg)
+        rates.append(n_win * window / (time.perf_counter() - t0))
+    rates.sort()
+    return {"eps": round(rates[len(rates) // 2], 1),
+            "eps_all": [round(x, 1) for x in rates]}
 
 
 # --------------------------------------------------------------------- #
 # Config #3: window triangle count (1M-edge windows)
 # --------------------------------------------------------------------- #
-def bench_window_triangles(n_vertices: int = 1 << 17, window: int = 1 << 20) -> float:
+def bench_window_triangles(n_vertices: int = 1 << 17, window: int = 1 << 20) -> dict:
+    """Median-of-N over DISTINCT window blocks. The round-3 version timed
+    the warm block again inside the loop — an identical dispatch the
+    remote runtime memoizes, inflating the rate (the recorded 5.8G eps
+    was ~2x reality for exactly this reason)."""
     import jax
-
-    from gelly_streaming_tpu.library.triangles import _window_step
-
-    # Zipf-skewed stream: the degree-oriented kernel bounds row width by
-    # the max out-degree (~sqrt(2E)), so hubs no longer size the rows.
-    from gelly_streaming_tpu.library.triangles import _oriented_degree_bucket
-
-    src, dst = make_stream(n_vertices, window * 2, seed=9)
-    max_deg = max(
-        _oriented_degree_bucket(src[:window], dst[:window], n_vertices),
-        _oriented_degree_bucket(src[window:], dst[window:], n_vertices),
-    )
     import jax.numpy as jnp
 
+    from gelly_streaming_tpu.library.triangles import (
+        _oriented_degree_bucket,
+        _window_step,
+    )
+
+    n_blocks = 1 + STEADY_REPS * 2  # warm + STEADY_REPS groups of 2
+    # Zipf-skewed stream: the degree-oriented kernel bounds row width by
+    # the max out-degree (~sqrt(2E)), so hubs no longer size the rows.
+    src, dst = make_stream(n_vertices, window * n_blocks, seed=9)
+    spans = [
+        (src[i * window : (i + 1) * window], dst[i * window : (i + 1) * window])
+        for i in range(n_blocks)
+    ]
+    max_deg = max(
+        _oriented_degree_bucket(s, d, n_vertices) for s, d in spans
+    )
     blocks = [
-        (jnp.asarray(src[i * window : (i + 1) * window]),
-         jnp.asarray(dst[i * window : (i + 1) * window]),
-         jnp.ones(window, bool))
-        for i in range(2)
+        (jnp.asarray(s), jnp.asarray(d), jnp.ones(window, bool))
+        for s, d in spans
     ]
     out = _window_step(*blocks[0], n_vertices, max_deg)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for b in blocks:
-        out = _window_step(*b, n_vertices, max_deg)
-    jax.block_until_ready(out)
-    return 2 * window / (time.perf_counter() - t0)
+    rates = []
+    group = 2
+    for r in range(STEADY_REPS):
+        span = blocks[1 + r * group : 1 + (r + 1) * group]
+        t0 = time.perf_counter()
+        outs = [_window_step(*b, n_vertices, max_deg) for b in span]
+        # sync every output (the runtime completes dispatches out of order)
+        jax.block_until_ready(outs)
+        rates.append(group * window / (time.perf_counter() - t0))
+    rates.sort()
+    return {"eps": round(rates[len(rates) // 2], 1),
+            "eps_all": [round(x, 1) for x in rates]}
 
 
 def bench_window_triangles_e2e(
     n_vertices: int = 1 << 17, window: int = 1 << 20, n_win: int = 2
-) -> float:
+) -> dict:
     """Config #3 as a SYSTEM bench: array stream -> stream.slice(1M-edge
     CountWindow) -> per-slice device triangle count (BASELINE.md:31
     'via slice(1M edges)'). Counts stay on device; one sync at the end."""
@@ -416,13 +573,13 @@ def bench_window_triangles_e2e(
         jax.block_until_ready(last)
         return n_win * window / (time.perf_counter() - t0)
 
-    one_pass()
-    return one_pass()
+    med, eps_all = median_steady(one_pass)
+    return {"eps": round(med, 1), "eps_all": eps_all}
 
 
 def bench_exact_triangles(
     n_vertices: int = 1 << 17, window: int = 1 << 18, n_win: int = 4
-) -> float:
+) -> dict:
     """Streaming EXACT triangles end-to-end: stream -> per-window packed
     adjacency carry + rank-closed counting (``ExactTriangleCount``).
     Emission batches stay lazy (unread); one sync at the end."""
@@ -447,14 +604,14 @@ def bench_exact_triangles(
         jax.block_until_ready((etc._counts, etc._total))
         return n_win * window / (time.perf_counter() - t0)
 
-    one_pass()
-    return one_pass()
+    med, eps_all = median_steady(one_pass)
+    return {"eps": round(med, 1), "eps_all": eps_all}
 
 
 def bench_graphsage_e2e(
     n_vertices: int = 1 << 16, window: int = 1 << 18, feat: int = 128,
     n_win: int = 2,
-) -> float:
+) -> dict:
     """Config #5 as a SYSTEM bench: StreamingGraphSAGE over the stream
     with a carried DEVICE feature table (TableFeatureSource — no host
     dict loop), one forward over the accumulated graph per window."""
@@ -493,14 +650,14 @@ def bench_graphsage_e2e(
         jax.block_until_ready(out)
         return n_win * window / (time.perf_counter() - t0)
 
-    one_pass()
-    return one_pass()
+    med, eps_all = median_steady(one_pass)
+    return {"eps": round(med, 1), "eps_all": eps_all}
 
 
 # --------------------------------------------------------------------- #
 # Config #4: incremental PageRank (end-to-end through the stream)
 # --------------------------------------------------------------------- #
-def bench_pagerank(n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int = 4) -> float:
+def bench_pagerank(n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int = 4) -> dict:
     from gelly_streaming_tpu.core.stream import SimpleEdgeStream
     from gelly_streaming_tpu.core.window import CountWindow
     from gelly_streaming_tpu.library.pagerank import IncrementalPageRank
@@ -522,36 +679,49 @@ def bench_pagerank(n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int 
             pass
         return n_win * window / (time.perf_counter() - t0)
 
-    one_pass()  # warm pass: pays the per-capacity-bucket compiles
-    return one_pass()  # steady state (same capacities -> cached executables)
+    # warm pass inside median_steady pays the per-capacity-bucket compiles
+    med, eps_all = median_steady(one_pass)
+    return {"eps": round(med, 1), "eps_all": eps_all}
 
 
 # --------------------------------------------------------------------- #
 # Config #5: streaming GraphSAGE layer
 # --------------------------------------------------------------------- #
-def bench_graphsage(n_vertices: int = 1 << 16, window: int = 1 << 18, feat: int = 128) -> float:
+def bench_graphsage(n_vertices: int = 1 << 16, window: int = 1 << 18, feat: int = 128) -> dict:
+    """Median-of-N over DISTINCT (h, block) dispatches, grouped with one
+    trailing sync per group. The round-3 version re-dispatched the warm
+    block with identical inputs — memoized by the remote runtime, so the
+    recorded 1.5G eps was inflated."""
     import jax
     import jax.numpy as jnp
 
     from gelly_streaming_tpu.models.graphsage import init_graphsage, sage_forward
 
-    src, dst = make_stream(n_vertices, window * 2, seed=13)
+    group = 2
+    n_blocks = 1 + STEADY_REPS * group
+    src, dst = make_stream(n_vertices, window * n_blocks, seed=13)
     params = init_graphsage(jax.random.PRNGKey(0), [feat, 256, 128], dtype=jnp.bfloat16)
-    h = jax.random.normal(jax.random.PRNGKey(1), (n_vertices, feat), jnp.bfloat16)
     fwd = jax.jit(sage_forward)
     blocks = [
-        (jnp.asarray(src[i * window : (i + 1) * window]),
+        (jax.random.normal(jax.random.PRNGKey(100 + i), (n_vertices, feat),
+                           jnp.bfloat16),
+         jnp.asarray(src[i * window : (i + 1) * window]),
          jnp.asarray(dst[i * window : (i + 1) * window]),
          jnp.ones(window, bool))
-        for i in range(2)
+        for i in range(n_blocks)
     ]
-    out = fwd(params, h, *blocks[0])
+    out = fwd(params, blocks[0][0], *blocks[0][1:])
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for b in blocks:
-        out = fwd(params, h, *b)
-    jax.block_until_ready(out)
-    return 2 * window / (time.perf_counter() - t0)
+    rates = []
+    for r in range(STEADY_REPS):
+        span = blocks[1 + r * group : 1 + (r + 1) * group]
+        t0 = time.perf_counter()
+        outs = [fwd(params, h, s, d, m) for h, s, d, m in span]
+        jax.block_until_ready(outs)
+        rates.append(group * window / (time.perf_counter() - t0))
+    rates.sort()
+    return {"eps": round(rates[len(rates) // 2], 1),
+            "eps_all": [round(x, 1) for x in rates]}
 
 
 ROOFLINE_REPS = 8  # number of DISTINCT input variants per roofline kernel
@@ -559,7 +729,7 @@ ROOFLINE_REPS = 8  # number of DISTINCT input variants per roofline kernel
 
 def bench_spanner(
     n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int = 4,
-) -> float:
+) -> dict:
     """Streaming k=2 spanner end-to-end: stream -> per-window class-
     bounded common-neighbor rejection on the packed device adjacency."""
     from gelly_streaming_tpu.core.stream import SimpleEdgeStream
@@ -580,8 +750,8 @@ def bench_spanner(
             pass
         return n_win * window / (time.perf_counter() - t0)
 
-    one_pass()
-    return one_pass()
+    med, eps_all = median_steady(one_pass)
+    return {"eps": round(med, 1), "eps_all": eps_all}
 
 
 def bench_roofline(part: str = "all") -> dict:
@@ -790,7 +960,21 @@ def _headline() -> tuple:
     return headline, e2e, base, base_bin, path, binp, bound, n_edges, s64, d64
 
 
+def _parse_sub(out_text: str):
+    """Subprocess configs print ONE JSON line last; accept bare floats."""
+    last = out_text.strip().splitlines()[-1]
+    try:
+        return json.loads(last)
+    except json.JSONDecodeError:
+        return round(float(last), 1)
+
+
 def main():
+    if "--no-probe" not in sys.argv and not probe_backend():
+        log("bench: backend down after all retries — emitting stale headline")
+        print(json.dumps(stale_headline()))
+        return
+
     (headline, e2e, base, base_bin, path, binp, bound, n_edges,
      s64, d64) = _headline()
 
@@ -798,12 +982,21 @@ def main():
         import subprocess
 
         py_eps = bench_cc_python_tier(s64, d64, sample=min(n_edges, 400_000))
+        flink = bench_cc_flink_proxy(s64, d64)
+        assert flink["components"] == base_bin["components"], (
+            "flink proxy correctness cross-check failed"
+        )
+        if not (py_eps <= flink["eps"] <= base_bin["eps"] * 1.05):
+            log(f"bench: WARNING flink proxy {flink['eps']:.0f} eps outside "
+                f"bracket [{py_eps:.0f}, {base_bin['eps']:.0f}]")
+        headline["vs_flink"] = round(e2e["eps"] / flink["eps"], 2)
         detail = {
             "headline": headline,
             "e2e_device_encode": e2e,
             "baseline_compiled_text": base,
             "baseline_compiled_binary": base_bin,
             "python_unionfind_eps": round(py_eps, 1),
+            "flink_proxy": flink,
             "corpus": path,
         }
         n_vertices = 1 << 18
@@ -811,48 +1004,51 @@ def main():
         n_e = window * 8
         for key, expr in [
             ("e2e_text_identity_eps",
-             "import bench; from gelly_streaming_tpu import datasets; "
+             "import bench, json; from gelly_streaming_tpu import datasets; "
              f"r = bench.bench_cc_e2e({path!r}, lambda: datasets.IdentityDict({bound}), {n_edges}); "
-             "print(r['eps'])"),
+             "print(json.dumps(r))"),
             ("e2e_dict_eps",
-             "import bench; "
+             "import bench, json; "
              f"r = bench.bench_cc_e2e_device_text({path!r}, {bound}, {n_edges}); "
-             "print(r['eps'])"),
+             "print(json.dumps(r))"),
             ("e2e_dict_host_eps",
-             "import bench; from gelly_streaming_tpu.core.vertexdict import VertexDict; "
+             "import bench, json; from gelly_streaming_tpu.core.vertexdict import VertexDict; "
              f"r = bench.bench_cc_e2e({path!r}, lambda: VertexDict(min_capacity={bound}), {n_edges}); "
-             "print(r['eps'])"),
+             "print(json.dumps(r))"),
             ("e2e_binary_identity_eps",
-             "import bench; from gelly_streaming_tpu import datasets; "
+             "import bench, json; from gelly_streaming_tpu import datasets; "
              f"r = bench.bench_cc_e2e({binp!r}, lambda: datasets.IdentityDict({bound}), {n_edges}); "
-             "print(r['eps'])"),
+             "print(json.dumps(r))"),
             ("kernel_cc_eps",
-             f"import bench; s,d=bench.make_stream({n_vertices},{n_e}); "
-             f"print(bench.bench_cc_kernel(s,d,{n_vertices},{window}))"),
+             f"import bench, json; s,d=bench.make_stream({n_vertices},{n_e}); "
+             f"print(json.dumps(bench.bench_cc_kernel(s,d,{n_vertices},{window})))"),
             ("degrees_eps",
-             f"import bench; s,d=bench.make_stream({n_vertices},{n_e}); "
-             f"print(bench.bench_degrees(s,d,{n_vertices},{window}))"),
+             f"import bench, json; s,d=bench.make_stream({n_vertices},{n_e}); "
+             f"print(json.dumps(bench.bench_degrees(s,d,{n_vertices},{window})))"),
             ("degrees_e2e_eps",
-             f"import bench; print(bench.bench_degrees_e2e({binp!r}, {bound}, {n_edges}))"),
+             f"import bench, json; print(json.dumps(bench.bench_degrees_e2e({binp!r}, {bound}, {n_edges})))"),
             ("window_triangles_eps",
-             "import bench; print(bench.bench_window_triangles())"),
+             "import bench, json; print(json.dumps(bench.bench_window_triangles()))"),
             ("window_triangles_e2e_eps",
-             "import bench; print(bench.bench_window_triangles_e2e())"),
+             "import bench, json; print(json.dumps(bench.bench_window_triangles_e2e()))"),
             ("exact_triangles_eps",
-             "import bench; print(bench.bench_exact_triangles())"),
-            ("spanner_eps", "import bench; print(bench.bench_spanner())"),
-            ("pagerank_eps", "import bench; print(bench.bench_pagerank())"),
-            ("graphsage_eps", "import bench; print(bench.bench_graphsage())"),
+             "import bench, json; print(json.dumps(bench.bench_exact_triangles()))"),
+            ("spanner_eps",
+             "import bench, json; print(json.dumps(bench.bench_spanner()))"),
+            ("pagerank_eps",
+             "import bench, json; print(json.dumps(bench.bench_pagerank()))"),
+            ("graphsage_eps",
+             "import bench, json; print(json.dumps(bench.bench_graphsage()))"),
             ("graphsage_e2e_eps",
-             "import bench; print(bench.bench_graphsage_e2e())"),
+             "import bench, json; print(json.dumps(bench.bench_graphsage_e2e()))"),
         ]:
             log(f"bench: {key}...")
             out = subprocess.run(
                 [sys.executable, "-c", expr],
-                capture_output=True, text=True, timeout=420,
+                capture_output=True, text=True, timeout=600,
             )
             if out.returncode == 0:
-                detail[key] = round(float(out.stdout.strip().splitlines()[-1]), 1)
+                detail[key] = _parse_sub(out.stdout)
             else:
                 detail[key] = None
                 log(out.stderr[-500:])
@@ -866,7 +1062,7 @@ def main():
                 [sys.executable, "-c",
                  "import bench, json; "
                  f"print(json.dumps(bench.bench_roofline(part={part!r})))"],
-                capture_output=True, text=True, timeout=420,
+                capture_output=True, text=True, timeout=600,
             )
             if out.returncode == 0:
                 roof.update(json.loads(out.stdout.strip().splitlines()[-1]))

@@ -110,6 +110,10 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.cc_baseline_run.argtypes = [
                 p64, p64, i64, i64, ctypes.c_int32, ctypes.POINTER(i64),
             ]
+            lib.flink_proxy_run.restype = i64
+            lib.flink_proxy_run.argtypes = [
+                p64, p64, i64, i64, ctypes.c_int32, ctypes.POINTER(i64),
+            ]
             pi32a = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
             lib.encoder_create.restype = ctypes.c_void_p
             lib.encoder_destroy.argtypes = [ctypes.c_void_p]
@@ -361,6 +365,32 @@ def cc_baseline(
         partitions = min(8, os.cpu_count() or 1)
     comps = ctypes.c_int64(0)
     ns = lib.cc_baseline_run(
+        src, dst, src.size, window, partitions, ctypes.byref(comps)
+    )
+    return ns / 1e9, int(comps.value)
+
+
+def flink_proxy(
+    src: np.ndarray,
+    dst: np.ndarray,
+    window: int,
+    partitions: Optional[int] = None,
+) -> Tuple[float, int]:
+    """Run the Flink-representative streaming-CC proxy: the reference's
+    job graph with per-record serialized shuffles and a serialized
+    partial-merge boundary (``ingest.cpp:flink_proxy_run``). An UPPER
+    bound on real single-host Flink throughput for this job — no JVM,
+    no netty, no GC — so ratios against it are conservative. Returns
+    (seconds, component_count)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native toolchain unavailable for the proxy")
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    if partitions is None:
+        partitions = min(8, os.cpu_count() or 1)
+    comps = ctypes.c_int64(0)
+    ns = lib.flink_proxy_run(
         src, dst, src.size, window, partitions, ctypes.byref(comps)
     )
     return ns / 1e9, int(comps.value)
