@@ -173,7 +173,8 @@ def bench_cc_e2e(path: str, vdict_factory, n_edges: int) -> dict:
 
     def one_pass():
         stream = datasets.stream_file(
-            path, window=CountWindow(WINDOW), vertex_dict=vdict_factory()
+            path, window=CountWindow(WINDOW), vertex_dict=vdict_factory(),
+            prefetch_depth=2,
         )
         agg = ConnectedComponents()
         lat = []
@@ -255,7 +256,9 @@ def bench_cc_baseline_binary(bin_path: str) -> dict:
     }
 
 
-def bench_cc_e2e_device(bin_path: str, bound: int, n_edges: int) -> dict:
+def bench_cc_e2e_device(
+    bin_path: str, bound: int, n_edges: int, window: int = WINDOW
+) -> dict:
     """Binary corpus -> memmap -> device put -> DEVICE vertex compaction ->
     CC summary (stream_file(device_encode=True)), warm + steady."""
     from gelly_streaming_tpu import datasets
@@ -264,8 +267,8 @@ def bench_cc_e2e_device(bin_path: str, bound: int, n_edges: int) -> dict:
 
     def one_pass():
         stream = datasets.stream_file(
-            bin_path, window=CountWindow(WINDOW), device_encode=True,
-            min_vertex_capacity=bound,
+            bin_path, window=CountWindow(window), device_encode=True,
+            min_vertex_capacity=bound, prefetch_depth=2,
         )
         agg = ConnectedComponents()
         lat = []
@@ -305,6 +308,7 @@ def bench_cc_e2e_device_text(path: str, cap_hint: int, n_edges: int) -> dict:
         stream = datasets.stream_file(
             path, window=CountWindow(WINDOW), device_encode=True,
             dense_ids=False, min_vertex_capacity=cap_hint,
+            prefetch_depth=2,
         )
         agg = ConnectedComponents()
         lat = []
@@ -322,6 +326,54 @@ def bench_cc_e2e_device_text(path: str, cap_hint: int, n_edges: int) -> dict:
             "p50_ms": float(np.percentile(lat_ms, 50)),
             "p95_ms": float(np.percentile(lat_ms, 95)),
             "components": len(last.component_sets()),
+        }
+
+    out, eps_all = median_steady(one_pass)
+    out["eps_all"] = eps_all
+    return out
+
+
+def bench_latency_window(binp: str, bound: int, window: int,
+                         n_edges: int = 1 << 22) -> dict:
+    """One point of the latency/throughput curve (round-3 verdict missing
+    #1: the low-latency micro-batch configuration was never measured):
+    streaming CC over a corpus prefix at the given CountWindow, recording
+    per-window p50/p95 latency alongside throughput. Small windows buy
+    latency with dispatch overhead; the curve quantifies the trade."""
+    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    cols = []
+    have = 0
+    for c in datasets.iter_binary_chunks(binp, 1 << 22):
+        cols.append(c)
+        have += len(c[0])
+        if have >= n_edges:
+            break
+    src = np.concatenate([c[0] for c in cols])[:n_edges]
+    dst = np.concatenate([c[1] for c in cols])[:n_edges]
+
+    def one_pass():
+        stream = SimpleEdgeStream(
+            (src, dst), window=CountWindow(window),
+            vertex_dict=datasets.IdentityDict(bound),
+        )
+        lat = []
+        t0 = time.perf_counter()
+        last_t = t0
+        for _ in stream.aggregate(ConnectedComponents()):
+            now = time.perf_counter()
+            lat.append(now - last_t)
+            last_t = now
+        dt = time.perf_counter() - t0
+        lat_ms = np.asarray(lat) * 1e3
+        return {
+            "window": window,
+            "eps": len(src) / dt,
+            "p50_ms": float(np.percentile(lat_ms, 50)),
+            "p95_ms": float(np.percentile(lat_ms, 95)),
         }
 
     out, eps_all = median_steady(one_pass)
@@ -446,7 +498,7 @@ def bench_degrees_e2e(bin_path: str, bound: int, n_edges: int) -> dict:
     def one_pass():
         stream = datasets.stream_file(
             bin_path, window=CountWindow(WINDOW),
-            vertex_dict=datasets.IdentityDict(bound),
+            vertex_dict=datasets.IdentityDict(bound), prefetch_depth=2,
         )
         t0 = time.perf_counter()
         for _ in stream.get_degrees().batches():
@@ -960,6 +1012,55 @@ def _headline() -> tuple:
     return headline, e2e, base, base_bin, path, binp, bound, n_edges, s64, d64
 
 
+def run_northstar() -> dict:
+    """The BASELINE.md north-star shape (round-3 verdict #5): streaming CC
+    at >=100M streamed edges — a scale-23 R-MAT surrogate ~2x the real
+    LiveJournal (the real corpus is used instead when $GELLY_DATA provides
+    it) — at both the headline 1M-edge windows (with p50/p95 window
+    latency) and ONE 100M-edge window (BASELINE.md: "100M-edge windows").
+    Writes BENCH_NORTHSTAR.json."""
+    from gelly_streaming_tpu import datasets
+
+    real = datasets.locate("livejournal")
+    if real is not None:
+        path, bound = real, 1 << 23
+    else:
+        path, _ = datasets.ensure_corpus("livejournal-xl")
+        bound = 1 << 23
+    log(f"northstar: corpus {path}")
+    binp = datasets.binary_cache(path)
+    base = bench_cc_baseline_binary(binp)
+    n_edges = base["n_edges"]
+    chunks = list(datasets.iter_binary_chunks(binp, 1 << 24))
+    s64 = np.concatenate([c[0] for c in chunks]).astype(np.int64)
+    d64 = np.concatenate([c[1] for c in chunks]).astype(np.int64)
+    del chunks
+    flink = bench_cc_flink_proxy(s64, d64)
+    del s64, d64
+    log(f"northstar: {n_edges} edges; 1M-edge windows...")
+    e2e = bench_cc_e2e_device(binp, bound, n_edges)
+    assert e2e["components"] == base["components"], (
+        e2e["components"], base["components"]
+    )
+    log("northstar: one 100M-edge window...")
+    mega = bench_cc_e2e_device(binp, bound, n_edges,
+                               window=max(n_edges, 100_000_000))
+    out = {
+        "corpus": path,
+        "n_edges": n_edges,
+        "window_1m": e2e,
+        "window_100m": mega,
+        "baseline_compiled_binary": base,
+        "flink_proxy": flink,
+        "vs_baseline": round(e2e["eps"] / base["eps"], 2),
+        "vs_flink": round(e2e["eps"] / flink["eps"], 2),
+    }
+    with open("BENCH_NORTHSTAR.json", "w") as f:
+        json.dump(out, f, indent=2)
+    log(f"northstar: {json.dumps(out)}")
+    return out
+
+
 def _parse_sub(out_text: str):
     """Subprocess configs print ONE JSON line last; accept bare floats."""
     last = out_text.strip().splitlines()[-1]
@@ -973,6 +1074,16 @@ def main():
     if "--no-probe" not in sys.argv and not probe_backend():
         log("bench: backend down after all retries — emitting stale headline")
         print(json.dumps(stale_headline()))
+        return
+
+    if "--northstar" in sys.argv:
+        out = run_northstar()
+        print(json.dumps({
+            "metric": "northstar_cc_e2e_edges_per_sec",
+            "value": round(out["window_1m"]["eps"], 1),
+            "unit": "edges/sec",
+            "vs_baseline": out["vs_baseline"],
+        }))
         return
 
     (headline, e2e, base, base_bin, path, binp, bound, n_edges,
@@ -1052,6 +1163,23 @@ def main():
             else:
                 detail[key] = None
                 log(out.stderr[-500:])
+        # latency/throughput curve: window size sweep, one subprocess per
+        # point (same discipline); quantifies the micro-batch trade
+        curve = []
+        for wexp in (12, 14, 16, 18, 20):
+            log(f"bench: latency_curve window=2^{wexp}...")
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import bench, json; "
+                 f"print(json.dumps(bench.bench_latency_window({binp!r}, "
+                 f"{bound}, {1 << wexp})))"],
+                capture_output=True, text=True, timeout=600,
+            )
+            if out.returncode == 0:
+                curve.append(_parse_sub(out.stdout))
+            else:
+                log(out.stderr[-500:])
+        detail["latency_curve"] = curve
         # roofline: ONE KERNEL PER SUBPROCESS (the same in-process
         # degradation discipline as the configs above)
         roof = {}

@@ -207,7 +207,10 @@ class EdgeAccumulator:
     Capacity grows in power-of-two buckets (bounded recompiles downstream).
     """
 
-    def __init__(self):
+    def __init__(self, min_capacity: int = 8):
+        # callers sharding the columns over a mesh axis pass the axis size
+        # so every capacity bucket stays divisible by it
+        self.min_capacity = min_capacity
         self.src = jnp.zeros(0, jnp.int32)
         self.dst = jnp.zeros(0, jnp.int32)
         self.n_edges = 0
@@ -215,7 +218,7 @@ class EdgeAccumulator:
     def append(self, s: np.ndarray, d: np.ndarray) -> None:
         n_new = len(s)
         total = self.n_edges + n_new
-        cap = bucket_capacity(total)
+        cap = bucket_capacity(total, minimum=self.min_capacity)
         if cap > self.src.shape[0]:
             pad = jnp.zeros(cap - self.src.shape[0], jnp.int32)
             self.src = jnp.concatenate([self.src, pad])

@@ -79,3 +79,7 @@ def prefetch(iterator: Iterator[T], depth: int = 2) -> Iterator[T]:
             yield item
     finally:
         stop.set()
+        # wait for the producer to leave its current item: a daemon thread
+        # killed at interpreter teardown MID-DEVICE-OP aborts the process
+        # (libc terminate), so hand-off must complete before shutdown
+        t.join(timeout=10.0)

@@ -1,0 +1,122 @@
+"""Unbounded / live edge sources.
+
+The reference gets these free from Flink ``DataStream``: sockets
+(``env.socketTextStream``), collections, files (SURVEY.md §1 L1;
+``/root/reference/pom.xml:19-29`` pulls the whole streaming runtime). The
+repo's file/array/iterator ingest covers the bounded cases; this module
+adds the LIVE ones — an edge stream with no known end, consumed as it
+arrives:
+
+- :class:`SocketEdgeSource` — line-delimited edge records over TCP, the
+  ``socketTextStream`` parity path.
+- :class:`GeneratorSource` — unbounded synthetic stream (R-MAT chunks),
+  for tests/benches that need "no end" semantics without a network.
+
+Both yield ``None`` ticks while idle so a
+:class:`~gelly_streaming_tpu.core.window.ProcessingTimeWindow` can close
+an open window on schedule even when no records arrive — the windower's
+records-driven analog of Flink's processing-time timers.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class SocketEdgeSource:
+    """Unbounded edge records over TCP (``env.socketTextStream`` parity).
+
+    Lines are whitespace- or tab-separated ``src dst [val]``; malformed
+    lines and ``#`` comments are skipped, like the file parser. Iteration
+    ends when the peer closes the connection (a live deployment would
+    simply never close). ``tick_s``: receive timeout after which a
+    ``None`` time tick is yielded instead of a record.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        tick_s: float = 0.05,
+        weighted: bool = False,
+    ):
+        self.host = host
+        self.port = port
+        self.tick_s = tick_s
+        self.weighted = weighted
+
+    def __iter__(self) -> Iterator[Optional[Tuple]]:
+        sock = socket.create_connection((self.host, self.port))
+        sock.settimeout(self.tick_s)
+        buf = b""
+        try:
+            while True:
+                try:
+                    data = sock.recv(1 << 16)
+                except socket.timeout:
+                    yield None  # idle tick: lets time windows close
+                    continue
+                if not data:  # peer closed: the stream's (test-only) end
+                    break
+                buf += data
+                if b"\n" not in buf:
+                    continue
+                lines, buf = buf.rsplit(b"\n", 1)
+                for line in lines.split(b"\n"):
+                    rec = self._parse(line)
+                    if rec is not None:
+                        yield rec
+            rec = self._parse(buf)
+            if rec is not None:
+                yield rec
+        finally:
+            sock.close()
+
+    def _parse(self, line: bytes) -> Optional[Tuple]:
+        line = line.strip()
+        if not line or line.startswith(b"#"):
+            return None
+        parts = line.split()
+        if len(parts) < 2:
+            return None
+        try:
+            s, d = int(parts[0]), int(parts[1])
+            v = float(parts[2]) if self.weighted and len(parts) > 2 else 0.0
+        except ValueError:
+            return None
+        return (s, d, v)
+
+
+class GeneratorSource:
+    """Unbounded synthetic edge stream: R-MAT chunks, forever (or for
+    ``limit`` edges when given — tests need an end)."""
+
+    def __init__(
+        self,
+        scale: int = 16,
+        chunk: int = 1 << 14,
+        seed: int = 0,
+        limit: Optional[int] = None,
+    ):
+        self.scale = scale
+        self.chunk = chunk
+        self.seed = seed
+        self.limit = limit
+
+    def __iter__(self) -> Iterator[Tuple]:
+        from ..datasets import rmat_edges
+
+        produced = 0
+        step = 0
+        while self.limit is None or produced < self.limit:
+            n = self.chunk
+            if self.limit is not None:
+                n = min(n, self.limit - produced)
+            src, dst = rmat_edges(n, self.scale, seed=self.seed + step)
+            for s, d in zip(src.tolist(), dst.tolist()):
+                yield (s, d, 0.0)
+            produced += n
+            step += 1

@@ -425,22 +425,23 @@ class SimpleEdgeStream(GraphStream):
     # Property streams (continuously improving, per-block change-only)
     # ------------------------------------------------------------------ #
     def get_edges(self) -> "EmissionStream":
+        """Edge property stream. LAZY batches: the decode (and, for
+        device-transformed blocks, the ``to_host`` download) runs when a
+        consumer first reads a window — the producer loop performs zero
+        per-window D2H (round-3 verdict #8)."""
         vdict = self._vdict
 
         def batches():
             for b in self.blocks():
-                src, dst, val = b.to_host()
-                raw_s = vdict.decode(src)
-                raw_d = vdict.decode(dst)
-                vals = _host_vals(val)
-                # columns live in the batch; Edge objects construct only
-                # when a consumer actually iterates records
-                yield RecordColumnBatch(
-                    lambda s, d, v: Edge(int(s), int(d), v),
-                    raw_s, raw_d, vals,
+                def thunk(b=b):
+                    src, dst, val = b.to_host()
+                    return vdict.decode(src), vdict.decode(dst), _host_vals(val)
+
+                yield LazyRecordBatch(
+                    lambda s, d, v: Edge(int(s), int(d), v), thunk
                 )
 
-        from .emission import EmissionStream, RecordColumnBatch
+        from .emission import EmissionStream, LazyRecordBatch
 
         return EmissionStream(batches)
 
@@ -448,33 +449,59 @@ class SimpleEdgeStream(GraphStream):
         """Distinct vertices, emitted on first appearance
         (``SimpleEdgeStream.java:116-121,181-202``).
 
-        Vectorized: per window, a numpy first-occurrence pass against a
-        carried seen-mask, then one batched decode — no per-record Python.
+        Ingest-path blocks (host columns cached) take a vectorized numpy
+        first-occurrence pass; device-transformed blocks keep the seen
+        mask ON DEVICE — one dispatch per window, emission packed and
+        downloaded lazily (O(window) bytes, only when read) — so neither
+        path does per-window D2H in the producer loop.
         """
         vdict = self._vdict
 
         def batches():
             seen = np.zeros(0, bool)
+            seen_dev = None
             for b in self.blocks():
-                src, dst, _ = b.to_host()
-                if len(src) == 0:
-                    yield []
+                cache = getattr(b, "_host_cache", None)
+                if cache is not None and seen_dev is None:
+                    src, dst = cache[0], cache[1]
+                    if len(src) == 0:
+                        yield []
+                        continue
+                    if seen.size < b.n_vertices:
+                        seen = np.concatenate(
+                            [seen, np.zeros(b.n_vertices - seen.size, bool)]
+                        )
+                    both = np.stack([src, dst], axis=1).ravel()
+                    uniq, first = np.unique(both, return_index=True)
+                    fresh = ~seen[uniq]
+                    new_ids = uniq[fresh]
+                    seen[new_ids] = True
+                    # first-appearance (arrival) order, as the reference
+                    order = np.argsort(first[fresh], kind="stable")
+                    raw = vdict.decode(new_ids[order])
+                    yield RecordColumnBatch(lambda r: Vertex(int(r), None), raw)
                     continue
-                if seen.size < b.n_vertices:
-                    seen = np.concatenate(
-                        [seen, np.zeros(b.n_vertices - seen.size, bool)]
-                    )
-                both = np.stack([src, dst], axis=1).ravel()
-                uniq, first = np.unique(both, return_index=True)
-                fresh = ~seen[uniq]
-                new_ids = uniq[fresh]
-                seen[new_ids] = True
-                # first-appearance (arrival) order, matching the reference
-                order = np.argsort(first[fresh], kind="stable")
-                raw = vdict.decode(new_ids[order])
-                yield RecordColumnBatch(lambda r: Vertex(int(r), None), raw)
+                # device path: carry the seen mask on device from the host
+                # watermark so far; stays on device for the rest of the run
+                if seen_dev is None or seen_dev.shape[0] < b.n_vertices:
+                    base = np.zeros(b.n_vertices, bool)
+                    if seen_dev is None:
+                        base[: seen.size] = seen
+                    else:
+                        base[: seen_dev.shape[0]] = np.asarray(seen_dev)
+                    seen_dev = jnp.asarray(base)
+                seen_dev, packed = _first_seen_update(
+                    seen_dev, b.src, b.dst, b.mask
+                )
 
-        from .emission import EmissionStream, RecordColumnBatch
+                def thunk(packed=packed):
+                    h = jax.device_get(packed)
+                    k = int(np.count_nonzero(h >= 0))
+                    return (vdict.decode(h[:k]),)
+
+                yield LazyRecordBatch(lambda r: Vertex(int(r), None), thunk)
+
+        from .emission import EmissionStream, LazyRecordBatch, RecordColumnBatch
 
         return EmissionStream(batches)
 
@@ -539,15 +566,30 @@ class SimpleEdgeStream(GraphStream):
 
     def number_of_edges(self) -> "EmissionStream":
         """Running edge count, one emission per edge
-        (``SimpleEdgeStream.java:388-404``)."""
-        from .emission import EmissionStream
+        (``SimpleEdgeStream.java:388-404``).
+
+        Ingest-path blocks count from the cached host columns (free);
+        device-transformed blocks chain the running total ON DEVICE and
+        emit lazy ranges — the round-3 version downloaded every block's
+        mask (a per-window D2H on a stack that otherwise forbids them)."""
+        from .emission import EmissionStream, LazyCountRange
 
         def batches():
-            total = 0
+            total = 0  # int while counts are host-known; device scalar after
+            device_mode = False
             for b in self.blocks():
-                n = int(np.asarray(b.mask).sum())
-                yield range(total + 1, total + n + 1)
-                total += n
+                cache = getattr(b, "_host_cache", None)
+                if cache is not None and not device_mode:
+                    n = len(cache[0])
+                    yield range(total + 1, total + n + 1)
+                    total += n
+                    continue
+                if not device_mode:
+                    total = jnp.int32(total)
+                    device_mode = True
+                n = _mask_count(b.mask)
+                yield LazyCountRange(total, n)
+                total = total + n
 
         return EmissionStream(batches)
 
@@ -689,6 +731,38 @@ def _degree_update(deg: jax.Array, block: EdgeBlock, *, in_: bool, out: bool):
     ids = ids.at[jnp.where(is_first, pos, K)].set(sorted_c, mode="drop")
     degs = new_deg[jnp.clip(ids, 0, max(V - 1, 0))] if V else jnp.zeros(K, jnp.int32)
     return new_deg, jnp.stack([ids.astype(jnp.int32), degs])
+@jax.jit
+def _mask_count(mask):
+    return mask.sum(dtype=jnp.int32)
+
+
+@jax.jit
+def _first_seen_update(seen, src, dst, mask):
+    """One window's first-appearance pass, fully on device: scatter-min
+    the arrival position of every masked endpoint, mark vertices not in
+    ``seen``, and emit their ids packed in ARRIVAL order (-1 padding past
+    the new-vertex count) — the consumer downloads O(window) lazily.
+    Module-level jit: shared across streams (same reason as
+    :func:`_degree_update`)."""
+    V = seen.shape[0]
+    E = src.shape[0]
+    big = jnp.int32(2 * E)
+    # interleaved endpoints, matching the host path's arrival order:
+    # src_0, dst_0, src_1, dst_1, ...
+    both = jnp.stack([src, dst], axis=1).ravel()
+    bm = jnp.stack([mask, mask], axis=1).ravel()
+    posv = jnp.full(V, big, jnp.int32).at[
+        jnp.where(bm, both, V)
+    ].min(jnp.arange(2 * E, dtype=jnp.int32), mode="drop")
+    occurred = posv < big
+    new = occurred & ~seen
+    sortkey = jnp.where(new, posv, big)
+    K = min(2 * E, V)  # new vertices per window <= masked endpoints
+    order = jnp.argsort(sortkey)[:K]
+    ids = jnp.where(sortkey[order] < big, order.astype(jnp.int32), -1)
+    return seen | occurred, ids
+
+
 def _host_vals(val) -> list:
     """Convert a (possibly pytree) value batch to a list of python records."""
     leaves = jax.tree.leaves(val)

@@ -68,6 +68,23 @@ class CountWindow(WindowPolicy):
 
 
 @dataclasses.dataclass
+class ProcessingTimeWindow(WindowPolicy):
+    """Tumbling wall-clock window: close when ``seconds`` have elapsed
+    since the window's first record — the micro-batch/low-latency policy
+    for unbounded live sources (Flink's processing-time ``timeWindow``).
+
+    ``max_count`` additionally caps the window's record count (close on
+    whichever trips first), bounding device block capacity under bursts.
+    Live sources that can go idle should yield ``None`` ticks (see
+    :class:`~gelly_streaming_tpu.core.sources.SocketEdgeSource`): the
+    windower treats them as pure time signals, so an open window still
+    closes on schedule when no records arrive."""
+
+    seconds: float
+    max_count: int = 1 << 20
+
+
+@dataclasses.dataclass
 class EventTimeWindow(WindowPolicy):
     """Tumbling event-time window of ``size`` time units.
 
@@ -187,11 +204,33 @@ class Windower:
         if isinstance(policy, CountWindow):
             buf: list[Tuple] = []
             for e in edges:
+                if e is None:  # live-source time tick; count windows ignore
+                    continue
                 buf.append(e)
                 if len(buf) >= policy.size:
                     yield WindowInfo(index, None, None), self._make_block(buf)
                     index += 1
                     buf = []
+            if buf:
+                yield WindowInfo(index, None, None), self._make_block(buf)
+        elif isinstance(policy, ProcessingTimeWindow):
+            import time as _time
+
+            buf = []
+            t0: Optional[float] = None
+            for e in edges:
+                now = _time.perf_counter()
+                if e is not None:
+                    if t0 is None:
+                        t0 = now
+                    buf.append(e)
+                if buf and (
+                    now - t0 >= policy.seconds or len(buf) >= policy.max_count
+                ):
+                    yield WindowInfo(index, None, None), self._make_block(buf)
+                    index += 1
+                    buf = []
+                    t0 = None
             if buf:
                 yield WindowInfo(index, None, None), self._make_block(buf)
         elif isinstance(policy, EventTimeWindow):
@@ -204,6 +243,10 @@ class Windower:
             buf = []
             current: Optional[int] = None
             for e in edges:
+                if e is None:
+                    # live-source idle tick: event-time windows close on
+                    # event time, never wall clock, so ticks are no-ops
+                    continue
                 w = int(ts_fn(e) // policy.size)
                 if current is None:
                     current = w

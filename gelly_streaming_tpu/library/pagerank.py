@@ -52,15 +52,14 @@ class PageRankEmission(NamedTuple):
     l1_delta: "jax.Array"
 
 
-@functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("chunk", "max_chunks")
-)
-def _pr_step(
-    carry, bsrc, bdst, n_edges0, n_new, n_seen, damping, tol,
-    *, chunk: int, max_chunks: int,
-):
-    """One window = append + warm-start + chunked fixpoint, one dispatch.
+@functools.lru_cache(maxsize=None)
+def _build_pr_step(mesh, chunk: int, max_chunks: int):
+    """Build the jitted window step, optionally edge-sharded over a mesh.
+    Memoized on (mesh, chunk, max_chunks): every instance with the same
+    config shares one jit (and therefore XLA's compile cache) — a
+    per-instance wrapper would re-trace the whole fixpoint each time.
 
+    One window = append + warm-start + chunked fixpoint, one dispatch.
     ``carry`` is ``(src, dst, ranks)`` device arrays at bucketed capacity,
     donated so the buffers are reused in place. ``bsrc``/``bdst`` are the
     window's padded block columns; only the first ``n_new`` entries are
@@ -68,64 +67,108 @@ def _pr_step(
     ``n_edges0 + n_new`` (the host guarantees edge capacity >= n_edges0 +
     block capacity) and masked out of every reduction, then overwritten by
     the next window's append.
+
+    With ``mesh``, the fixpoint runs inside ``shard_map``: the edge
+    columns split over the ``"edges"`` axis, each shard scatters its
+    slice's rank messages into a replicated vertex table, and the
+    partials ``psum`` over ICI per iteration (P1 + P3, the same shape as
+    the CC engine's sharded fold). The while_loop trip count stays
+    consistent across shards because every per-iteration decision reads
+    post-psum (replicated) values.
     """
-    src, dst, ranks = carry
-    ecap = src.shape[0]
-    num_vertices = ranks.shape[0]
-    src = jax.lax.dynamic_update_slice(src, bsrc, (n_edges0,))
-    dst = jax.lax.dynamic_update_slice(dst, bdst, (n_edges0,))
-    n_edges = n_edges0 + n_new
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
 
-    # Warm start: never-ranked active vertices enter at uniform mass, then
-    # renormalize so the seen ranks sum to 1. (Padding slots stay 0: the
-    # `active` mask keeps them out of teleport/dangling terms below.)
-    active = jnp.arange(num_vertices) < n_seen
-    n = jnp.maximum(n_seen, 1).astype(ranks.dtype)
-    ranks = jnp.where(active & (ranks == 0.0), 1.0 / n, ranks)
-    ranks = ranks / jnp.maximum(ranks.sum(), 1e-30)
+        from ..parallel import comm
+        from ..parallel.mesh import EDGE_AXIS
 
-    mask = jnp.arange(ecap) < n_edges
-    m = mask.astype(ranks.dtype)
-    ones = jnp.zeros(num_vertices, ranks.dtype).at[src].add(m)
-    out_deg = jnp.maximum(ones, 1.0)
-    dangling = active & (ones == 0.0)
+    def fixpoint(src, dst, mask, ranks, active, n, damping, tol,
+                 axis_name=None):
+        num_vertices = ranks.shape[0]
+        m = mask.astype(ranks.dtype)
+        ones = jnp.zeros(num_vertices, ranks.dtype).at[src].add(m)
+        if axis_name is not None:
+            ones = jax.lax.psum(ones, axis_name)
+        out_deg = jnp.maximum(ones, 1.0)
+        dangling = active & (ones == 0.0)
 
-    def one_iter(r):
-        contrib = jnp.where(mask, r[src] / out_deg[src], 0.0)
-        new = jnp.zeros(num_vertices, r.dtype).at[dst].add(contrib)
-        dangling_mass = jnp.sum(jnp.where(dangling, r, 0.0))
-        new = (1.0 - damping) / n + damping * (new + dangling_mass / n)
-        new = jnp.where(active, new, 0.0)
-        return new, jnp.abs(new - r).sum()
+        def one_iter(r):
+            contrib = jnp.where(mask, r[src] / out_deg[src], 0.0)
+            new = jnp.zeros(num_vertices, r.dtype).at[dst].add(contrib)
+            if axis_name is not None:
+                new = jax.lax.psum(new, axis_name)
+            dangling_mass = jnp.sum(jnp.where(dangling, r, 0.0))
+            new = (1.0 - damping) / n + damping * (new + dangling_mass / n)
+            new = jnp.where(active, new, 0.0)
+            return new, jnp.abs(new - r).sum()
 
-    # Early exit at chunk granularity: a while_loop whose body is a fixed
-    # `chunk`-length scan with a converged-freeze flag. Data-dependent trip
-    # count without per-iteration host sync; at most chunk-1 frozen
-    # (wasted) passes after convergence.
-    def scan_body(c, _):
-        r, delta, iters, done = c
-        new, dl = one_iter(r)
-        r = jnp.where(done, r, new)
-        delta = jnp.where(done, delta, dl)
-        iters = iters + (~done).astype(jnp.int32)
-        done = done | (dl <= tol)
-        return (r, delta, iters, done), None
+        # Early exit at chunk granularity: a while_loop whose body is a
+        # fixed `chunk`-length scan with a converged-freeze flag. Data-
+        # dependent trip count without per-iteration host sync; at most
+        # chunk-1 frozen (wasted) passes after convergence.
+        def scan_body(c, _):
+            r, delta, iters, done = c
+            new, dl = one_iter(r)
+            r = jnp.where(done, r, new)
+            delta = jnp.where(done, delta, dl)
+            iters = iters + (~done).astype(jnp.int32)
+            done = done | (dl <= tol)
+            return (r, delta, iters, done), None
 
-    def chunk_body(state):
-        k, inner = state
-        inner, _ = jax.lax.scan(scan_body, inner, None, length=chunk)
-        return k + 1, inner
+        def chunk_body(state):
+            k, inner = state
+            inner, _ = jax.lax.scan(scan_body, inner, None, length=chunk)
+            return k + 1, inner
 
-    def chunk_cond(state):
-        k, (_, _, _, done) = state
-        return (~done) & (k < max_chunks)
+        def chunk_cond(state):
+            k, (_, _, _, done) = state
+            return (~done) & (k < max_chunks)
 
-    init = (ranks, jnp.asarray(jnp.inf, ranks.dtype), jnp.int32(0),
-            jnp.bool_(False))
-    _, (ranks, delta, iters, _) = jax.lax.while_loop(
-        chunk_cond, chunk_body, (jnp.int32(0), init)
-    )
-    return (src, dst, ranks), delta, iters
+        init = (ranks, jnp.asarray(jnp.inf, ranks.dtype), jnp.int32(0),
+                jnp.bool_(False))
+        _, (ranks, delta, iters, _) = jax.lax.while_loop(
+            chunk_cond, chunk_body, (jnp.int32(0), init)
+        )
+        return ranks, delta, iters
+
+    def step(carry, bsrc, bdst, n_edges0, n_new, n_seen, damping, tol):
+        src, dst, ranks = carry
+        ecap = src.shape[0]
+        num_vertices = ranks.shape[0]
+        src = jax.lax.dynamic_update_slice(src, bsrc, (n_edges0,))
+        dst = jax.lax.dynamic_update_slice(dst, bdst, (n_edges0,))
+        n_edges = n_edges0 + n_new
+
+        # Warm start: never-ranked active vertices enter at uniform mass,
+        # then renormalize so the seen ranks sum to 1. (Padding slots stay
+        # 0: the `active` mask keeps them out of teleport/dangling terms.)
+        active = jnp.arange(num_vertices) < n_seen
+        n = jnp.maximum(n_seen, 1).astype(ranks.dtype)
+        ranks = jnp.where(active & (ranks == 0.0), 1.0 / n, ranks)
+        ranks = ranks / jnp.maximum(ranks.sum(), 1e-30)
+        mask = jnp.arange(ecap) < n_edges
+
+        if mesh is None:
+            ranks, delta, iters = fixpoint(
+                src, dst, mask, ranks, active, n, damping, tol
+            )
+        else:
+            def shard_fn(src_s, dst_s, mask_s, ranks, active, n, damping,
+                         tol):
+                return fixpoint(
+                    src_s, dst_s, mask_s, ranks, active, n, damping, tol,
+                    axis_name=EDGE_AXIS,
+                )
+
+            ranks, delta, iters = comm.shard_map(
+                shard_fn, mesh,
+                in_specs=(P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS),
+                          P(), P(), P(), P(), P()),
+                out_specs=(P(), P(), P()),
+            )(src, dst, mask, ranks, active, n, damping, tol)
+        return (src, dst, ranks), delta, iters
+
+    return jax.jit(step, donate_argnums=(0,))
 
 
 class IncrementalPageRank:
@@ -142,11 +185,16 @@ class IncrementalPageRank:
         tol: float = 1e-6,
         max_iter: int = 100,
         chunk: int = 10,
+        mesh=None,
     ):
         self.damping = damping
         self.tol = tol
         self.chunk = chunk
         self.max_chunks = max(1, -(-max_iter // chunk))
+        #: optional device mesh: the per-window fixpoint shards the edge
+        #: columns over the ``"edges"`` axis with per-iteration psum
+        self.mesh = mesh
+        self._step = _build_pr_step(mesh, self.chunk, self.max_chunks)
         self._carry = None  # (src, dst, ranks) device arrays
         self._n_edges = 0  # host mirror of the append position
         self._vdict = None
@@ -158,8 +206,13 @@ class IncrementalPageRank:
         Edge capacity must hold n_edges + the whole padded block so the
         in-step ``dynamic_update_slice`` never clamps into real edges.
         """
+        # the sharded step splits the edge columns over the mesh's edge
+        # axis: capacity must be divisible by (>= and pow2 covers) it
+        min_cap = 8
+        if self.mesh is not None:
+            min_cap = max(min_cap, dict(self.mesh.shape).get("edges", 1))
         if self._carry is None:
-            ecap = bucket_capacity(self._n_edges + block_cap)
+            ecap = bucket_capacity(self._n_edges + block_cap, minimum=min_cap)
             self._carry = (
                 jnp.zeros(ecap, jnp.int32),
                 jnp.zeros(ecap, jnp.int32),
@@ -167,7 +220,7 @@ class IncrementalPageRank:
             )
             return
         src, dst, ranks = self._carry
-        ecap = bucket_capacity(self._n_edges + block_cap)
+        ecap = bucket_capacity(self._n_edges + block_cap, minimum=min_cap)
         if ecap > src.shape[0]:
             grow = ecap - src.shape[0]
             src = jnp.pad(src, (0, grow))
@@ -182,11 +235,10 @@ class IncrementalPageRank:
             n_new = int(np.asarray(block.to_host()[0]).shape[0])
             n_seen = len(self._vdict)
             self._ensure_capacity(block.capacity, block.n_vertices)
-            self._carry, delta, iters = _pr_step(
+            self._carry, delta, iters = self._step(
                 self._carry, block.src, block.dst,
                 jnp.int32(self._n_edges), jnp.int32(n_new),
                 jnp.int32(n_seen), self.damping, self.tol,
-                chunk=self.chunk, max_chunks=self.max_chunks,
             )
             self._n_edges += n_new
             yield PageRankEmission(w, n_seen, iters, delta)

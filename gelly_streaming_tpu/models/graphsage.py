@@ -50,17 +50,25 @@ def init_graphsage(
     return params
 
 
-def mean_aggregate(h, src, dst, mask, num_vertices: int):
-    """Masked mean of in-neighbor features: messages flow src -> dst."""
+def mean_aggregate(h, src, dst, mask, num_vertices: int, axis_name=None):
+    """Masked mean of in-neighbor features: messages flow src -> dst.
+
+    ``axis_name``: inside ``shard_map`` with the edge columns sharded over
+    that mesh axis, the partial sums/counts all-reduce over ICI (P1 edge
+    sharding + P3 reduce) before the divide — the sharded mean is exact."""
     m = mask.astype(h.dtype)
     msgs = h[src] * m[:, None]
     agg = jnp.zeros((num_vertices, h.shape[1]), h.dtype).at[dst].add(msgs)
     cnt = jnp.zeros(num_vertices, h.dtype).at[dst].add(m)
+    if axis_name is not None:
+        agg = jax.lax.psum(agg, axis_name)
+        cnt = jax.lax.psum(cnt, axis_name)
     return agg / jnp.maximum(cnt, 1.0)[:, None]
 
 
 def sage_layer(
-    params, h, src, dst, mask, *, activation=jax.nn.relu, use_pallas=False
+    params, h, src, dst, mask, *, activation=jax.nn.relu, use_pallas=False,
+    axis_name=None,
 ):
     """One GraphSAGE layer: act(h @ W_self + mean_nbr(h) @ W_nbr + b).
 
@@ -68,7 +76,7 @@ def sage_layer(
     Pallas kernel (``ops/pallas_kernels.py``) — relu activation only;
     aggregation stays on the XLA scatter path either way.
     """
-    agg = mean_aggregate(h, src, dst, mask, h.shape[0])
+    agg = mean_aggregate(h, src, dst, mask, h.shape[0], axis_name=axis_name)
     if use_pallas:
         from ..ops.pallas_kernels import fused_sage_matmul, pallas_available
 
@@ -91,7 +99,9 @@ def sage_layer(
     return activation(out).astype(h.dtype)
 
 
-def sage_forward(params_stack, h, src, dst, mask, *, remat: bool = False):
+def sage_forward(
+    params_stack, h, src, dst, mask, *, remat: bool = False, axis_name=None
+):
     """Full model: all layers, last layer linear (no activation).
 
     ``remat=True`` wraps each layer in ``jax.checkpoint`` (rematerialize
@@ -99,7 +109,9 @@ def sage_forward(params_stack, h, src, dst, mask, *, remat: bool = False):
     n = len(params_stack)
     for i, p in enumerate(params_stack):
         act = jax.nn.relu if i < n - 1 else (lambda x: x)
-        layer = functools.partial(sage_layer, activation=act)
+        layer = functools.partial(
+            sage_layer, activation=act, axis_name=axis_name
+        )
         if remat:
             layer = jax.checkpoint(layer)
         h = layer(p, h, src, dst, mask)
@@ -109,6 +121,36 @@ def sage_forward(params_stack, h, src, dst, mask, *, remat: bool = False):
 @jax.jit
 def _forward_jit(params_stack, h, src, dst, mask):
     return sage_forward(params_stack, h, src, dst, mask)
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_forward(mesh):
+    """Jitted edge-sharded streaming forward (P1 + P3): the window's edge
+    columns split over the mesh's ``"edges"`` axis, each shard scatters
+    its slice's messages into a replicated [V, F] table, and the partial
+    aggregates ``psum`` over ICI before the (replicated) MXU matmuls.
+    This is the streaming-inference counterpart of
+    :func:`make_sharded_train_step` (round-3 verdict #8: the streaming
+    path was single-device)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import comm
+    from ..parallel.mesh import EDGE_AXIS
+
+    def fwd(params_stack, h, src, dst, mask):
+        def shard_fn(params_stack, h, src_s, dst_s, mask_s):
+            return sage_forward(
+                params_stack, h, src_s, dst_s, mask_s, axis_name=EDGE_AXIS
+            )
+
+        p_spec = jax.tree.map(lambda _: P(), params_stack)
+        return comm.shard_map(
+            shard_fn, mesh,
+            in_specs=(p_spec, P(), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)),
+            out_specs=P(),
+        )(params_stack, h, src, dst, mask)
+
+    return jax.jit(fwd)
 
 
 def make_sharded_train_step(mesh, lr=1e-2):
@@ -168,13 +210,20 @@ class StreamingGraphSAGE:
       end if exact row counts matter.
     """
 
-    def __init__(self, params_stack, feature_dim: int):
+    def __init__(self, params_stack, feature_dim: int, mesh=None):
         self.params = params_stack
         self.feature_dim = feature_dim
+        #: optional device mesh: the per-window forward shards the edge
+        #: columns over the ``"edges"`` axis (:func:`make_sharded_forward`)
+        self.mesh = mesh
+        self._fwd = _forward_jit if mesh is None else make_sharded_forward(mesh)
         # accumulated graph + feature matrix carried ON DEVICE at bucketed
         # capacity; per window only new edges / new vertices' feature rows
         # transfer host->device
-        self._edges = EdgeAccumulator()
+        min_cap = 8 if mesh is None else max(
+            8, dict(mesh.shape).get("edges", 1)
+        )
+        self._edges = EdgeAccumulator(min_capacity=min_cap)
         self._h = None
         self._n_seen = 0
 
@@ -188,14 +237,14 @@ class StreamingGraphSAGE:
             vcap = block.n_vertices
             if device_source:
                 self._extend_features_device(vdict, vcap, features, dtype)
-                yield _forward_jit(
+                yield self._fwd(
                     self.params, self._h, self._edges.src, self._edges.dst,
                     self._edges.mask(),
                 )
                 continue
             n = len(vdict)
             self._extend_features(vdict, n, vcap, features, dtype)
-            out = _forward_jit(
+            out = self._fwd(
                 self.params, self._h, self._edges.src, self._edges.dst,
                 self._edges.mask(),
             )

@@ -1,0 +1,92 @@
+"""Subprocess worker for the kill-and-resume test (``test_autockpt.py``).
+
+Usage: python _ckpt_worker.py <kind> <ckpt_path> <out_path> <kill_after>
+
+Runs a fixed deterministic stream under :class:`AutoCheckpoint`. With
+``kill_after >= 0`` the process dies hard (``os._exit``) after that many
+consumed windows — simulating a crash between barriers. With ``-1`` it
+runs to completion and writes the FINAL STATE as JSON to ``out_path``
+(plus ``resumed_from``: the barrier it restored, 0 on a fresh run).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from gelly_streaming_tpu.aggregate.autockpt import AutoCheckpoint
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow
+
+WINDOW = 16
+N_EDGES = 160
+
+
+def edges():
+    """Deterministic stream with SPARSE raw ids (vertex-dict replay must
+    reproduce the exact compact-id assignment across restarts)."""
+    rng = np.random.default_rng(1234)
+    pairs = rng.integers(0, 40, size=(N_EDGES, 2))
+    return [(int(a) * 3 + 11, int(b) * 3 + 11, 0.0) for a, b in pairs]
+
+
+def main():
+    kind, ckpt_path, out_path, kill_after = (
+        sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+    )
+    raw = edges()
+
+    def make_stream(vdict):
+        return SimpleEdgeStream(
+            raw, window=CountWindow(WINDOW), vertex_dict=vdict
+        )
+
+    ac = AutoCheckpoint(ckpt_path, every=2)
+    resumed_from = ac.windows_done()
+
+    if kind == "triangles":
+        from gelly_streaming_tpu.library.triangles import ExactTriangleCount
+
+        work = ExactTriangleCount()
+        n = 0
+        for batch in ac.run(make_stream, work):
+            list(batch)  # materialize the change-only emission
+            n += 1
+            if kill_after >= 0 and n >= kill_after:
+                os._exit(17)
+        state = work.state_dict()
+        counts = state["counts"]
+        final = {
+            "resumed_from": resumed_from,
+            "total": state["total"],
+            "counts": [
+                [int(i), int(c)] for i, c in enumerate(counts) if c
+            ] if counts is not None else [],
+        }
+    elif kind == "cc":
+        from gelly_streaming_tpu.library import ConnectedComponents
+
+        work = ConnectedComponents()
+        n = 0
+        last = None
+        for last in ac.run(make_stream, work):
+            n += 1
+            if kill_after >= 0 and n >= kill_after:
+                os._exit(17)
+        final = {"resumed_from": resumed_from, "components": str(last)}
+    else:
+        raise SystemExit(f"unknown kind {kind}")
+
+    with open(out_path, "w") as f:
+        json.dump(final, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Kill-and-resume: a crashed process restarts from the last barrier and
+finishes with output identical to an uninterrupted run — the e2e parity
+proof for Flink-transparent restore (``SummaryAggregation.java:127-135``;
+round-3 verdict #7)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_ckpt_worker.py")
+
+
+def _run_worker(kind, ckpt, out, kill_after, timeout=300):
+    return subprocess.run(
+        [sys.executable, _WORKER, kind, ckpt, out, str(kill_after)],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.parametrize("kind", ["triangles", "cc"])
+def test_kill_and_resume_matches_uninterrupted(tmp_path, kind):
+    ref_out = str(tmp_path / "ref.json")
+    r = _run_worker(kind, str(tmp_path / "ref.ckpt"), ref_out, -1)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # crash after 5 consumed windows (barriers land every 2)
+    kr_ckpt = str(tmp_path / "kr.ckpt")
+    kr_out = str(tmp_path / "kr.json")
+    r = _run_worker(kind, kr_ckpt, kr_out, 5)
+    assert r.returncode == 17, (r.returncode, r.stderr[-2000:])
+    assert not os.path.exists(kr_out), "killed run must not write output"
+    assert os.path.exists(kr_ckpt), "a barrier must have committed"
+
+    # restart the PROCESS; it restores the barrier and finishes
+    r = _run_worker(kind, kr_ckpt, kr_out, -1)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    with open(ref_out) as f:
+        ref = json.load(f)
+    with open(kr_out) as f:
+        resumed = json.load(f)
+    assert resumed["resumed_from"] == 4, "resume must start from barrier 4"
+    ref.pop("resumed_from")
+    resumed.pop("resumed_from")
+    assert resumed == ref, "resumed final state diverged from uninterrupted"
+
+
+def test_snapshot_commit_is_atomic(tmp_path):
+    """A barrier file is replaced atomically: a temp file left behind (the
+    mid-write crash artifact) never shadows the committed one."""
+    from gelly_streaming_tpu.aggregate.autockpt import AutoCheckpoint
+
+    path = str(tmp_path / "c.ckpt")
+    ac = AutoCheckpoint(path, every=1)
+
+    class W:
+        def state_dict(self):
+            return {"x": 1}
+
+    ac._snapshot(W(), None, windows_done=3)
+    # simulate a crash mid-snapshot: garbage temp next to the real file
+    with open(path + ".tmp", "wb") as f:
+        f.write(b"partial garbage")
+    assert ac.windows_done() == 3

@@ -170,3 +170,64 @@ def test_window_triangles_sharded_matches_single_device():
         )
         assert int(total) == int(ref_total), shards
         np.testing.assert_array_equal(np.asarray(counts), np.asarray(ref_counts))
+
+
+def test_incremental_pagerank_sharded_matches_single_device():
+    """The edge-sharded streaming PageRank (P1 scatter + per-iteration P3
+    psum, round-3 verdict #6) converges to the same ranks at every mesh
+    width. Float scatter order differs across widths, so the standard is
+    numerical closeness, not bit-identity (the integer workloads above
+    keep the bit-identical bar)."""
+    from gelly_streaming_tpu.library.pagerank import IncrementalPageRank
+
+    edges = _random_stream(31, n_edges=128, n_vertices=32)
+
+    def final_ranks(mesh):
+        stream = SimpleEdgeStream(edges, window=CountWindow(32))
+        pr = IncrementalPageRank(tol=1e-9, max_iter=200, mesh=mesh)
+        for _ in pr.run(stream):
+            pass
+        return pr.ranks()
+
+    base = final_ranks(None)
+    assert abs(sum(base.values()) - 1.0) < 1e-4
+    for p in SHARD_WIDTHS[1:]:
+        got = final_ranks(make_mesh(p))
+        assert got.keys() == base.keys(), p
+        for v in base:
+            assert abs(got[v] - base[v]) < 1e-5, (p, v, got[v], base[v])
+
+
+def test_streaming_graphsage_sharded_matches_single_device():
+    """The edge-sharded streaming SAGE forward (psum'd mean aggregation)
+    embeds every window identically (to float tolerance) at every mesh
+    width (round-3 verdict #6: configs #4/#5 streaming paths were
+    single-device)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.models.graphsage import (
+        StreamingGraphSAGE,
+        TableFeatureSource,
+        init_graphsage,
+    )
+
+    edges = _random_stream(33, n_edges=128, n_vertices=32)
+    params = init_graphsage(jax.random.PRNGKey(0), [8, 16, 8],
+                            dtype=jnp.float32)
+    table = TableFeatureSource(
+        jax.random.normal(jax.random.PRNGKey(1), (64, 8), jnp.float32)
+    )
+
+    def embeddings(mesh):
+        stream = SimpleEdgeStream(edges, window=CountWindow(32))
+        sage = StreamingGraphSAGE(params, feature_dim=8, mesh=mesh)
+        return [np.asarray(out) for out in sage.run(stream, table)]
+
+    base = embeddings(None)
+    for p in SHARD_WIDTHS[1:]:
+        got = embeddings(make_mesh(p))
+        assert len(got) == len(base), p
+        for w, (g, b) in enumerate(zip(got, base)):
+            np.testing.assert_allclose(g, b, rtol=2e-4, atol=2e-5,
+                                       err_msg=f"{p} shards, window {w}")

@@ -194,3 +194,42 @@ def test_distinct_fallback_matches_native(sample_edges):
     b = run(True)
     assert a == b
     assert len(a) == len({p for p in zip(s.tolist(), d.tolist())})
+
+
+def test_property_streams_on_device_transformed_blocks(sample_edges):
+    """Blocks produced by device transforms carry no host column cache;
+    the property streams must take their on-device paths (device seen
+    mask / device running count, lazy downloads) and still match the
+    reference semantics (round-3 verdict #8)."""
+    def filtered():
+        return make_stream(sample_edges, n=2).filter_edges(
+            lambda s, d, v: v < 40.0
+        )
+
+    kept = [(s, d, v) for s, d, v in sample_edges if v < 40.0]
+    got_edges = sorted((e.src, e.dst, float(e.val)) for e in filtered().get_edges())
+    assert got_edges == sorted(kept)
+
+    # distinct vertices in first-appearance order
+    expect_vs, seen = [], set()
+    for s, d, _ in kept:
+        for x in (s, d):
+            if x not in seen:
+                seen.add(x)
+                expect_vs.append(x)
+    assert [v.id for v in filtered().get_vertices()] == expect_vs
+
+    # running edge count: 1..len(kept), windows chained on device
+    assert list(filtered().number_of_edges()) == list(range(1, len(kept) + 1))
+
+    # laziness: producing every batch must trigger no materialization
+    from gelly_streaming_tpu.core.emission import LazyCountRange, LazyRecordBatch
+
+    batches = list(filtered().get_vertices().batches())
+    assert any(isinstance(b, LazyRecordBatch) for b in batches)
+    assert all(b._cols is None for b in batches if isinstance(b, LazyRecordBatch))
+    cbatches = list(filtered().number_of_edges().batches())
+    assert any(isinstance(b, LazyCountRange) for b in cbatches)
+    assert all(
+        b._range is None for b in cbatches if isinstance(b, LazyCountRange)
+    )
