@@ -19,9 +19,17 @@ Quick tour::
 from .core.types import Edge, EdgeDirection, EventType, Vertex
 from .core.edgeblock import EdgeBlock, bucket_capacity, concat_blocks
 from .core.vertexdict import VertexDict
-from .core.window import CountWindow, EventTimeWindow, Windower, blocks_from_edges
+from .core.window import (
+    CountWindow,
+    EventTimeWindow,
+    ProcessingTimeWindow,
+    Windower,
+    blocks_from_edges,
+)
 from .core.stream import GraphStream, SimpleEdgeStream, StreamContext
 from .core.snapshot import SnapshotStream
+from .core.sources import GeneratorSource, SocketEdgeSource
+from .aggregate.autockpt import AutoCheckpoint
 
 __version__ = "0.1.0"
 
@@ -36,10 +44,14 @@ __all__ = [
     "VertexDict",
     "CountWindow",
     "EventTimeWindow",
+    "ProcessingTimeWindow",
     "Windower",
     "blocks_from_edges",
     "GraphStream",
     "SimpleEdgeStream",
     "StreamContext",
     "SnapshotStream",
+    "SocketEdgeSource",
+    "GeneratorSource",
+    "AutoCheckpoint",
 ]

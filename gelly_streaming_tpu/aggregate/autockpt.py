@@ -71,6 +71,12 @@ class AutoCheckpoint:
     def __init__(self, path: str, every: int = 8):
         self.path = path
         self.every = int(every)
+        self._cache = None  # loaded payload (invalidated on snapshot)
+        #: vertex dictionary restored by the last :meth:`run` (None on a
+        #: fresh start) — the public surface for consumers that need to
+        #: decode restored state when the resumed stream yields nothing
+        #: (barrier already covers the whole source)
+        self.restored_vdict = None
 
     # ------------------------------------------------------------------ #
     def windows_done(self) -> int:
@@ -86,6 +92,7 @@ class AutoCheckpoint:
             done = payload["windows_done"]
             vdict = self._restore_vdict(payload["vdict"])
             self._restore_work(work, payload)
+        self.restored_vdict = vdict
         stream = make_stream(vdict)
         src = _SkipStream(stream, done) if done else stream
         w = done
@@ -117,12 +124,19 @@ class AutoCheckpoint:
         with open(tmp, "wb") as f:
             pickle.dump(payload, f)
         os.replace(tmp, self.path)  # atomic barrier commit
+        self._cache = payload
 
     def _load(self) -> Optional[dict]:
+        """Read (and cache) the barrier payload: the label table + vertex
+        dict can be multi-MB, so repeated ``windows_done()`` calls must
+        not re-unpickle the file each time."""
+        if self._cache is not None:
+            return self._cache
         if not os.path.exists(self.path):
             return None
         with open(self.path, "rb") as f:
-            return pickle.load(f)
+            self._cache = pickle.load(f)
+        return self._cache
 
     def _restore_work(self, work, payload: dict) -> None:
         if payload["kind"] == "workload":

@@ -18,9 +18,57 @@ from ..library import ConnectedComponents
 from .common import default_chain_edges, read_edges, run_main, usage, write_lines
 
 
-def run(edges, window_size: int, output_path: Optional[str] = None):
+def run(
+    edges,
+    window_size: int,
+    output_path: Optional[str] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 64,
+):
+    """``checkpoint_path`` enables transparent fault tolerance: an atomic
+    barrier every ``checkpoint_every`` windows; re-running the same
+    command after a crash resumes from the last barrier and ends with
+    identical output (``aggregate/autockpt.py``; the reference gets this
+    from Flink checkpointing, ``SummaryAggregation.java:127-135``)."""
+    if checkpoint_path is not None:
+        import time
+
+        from ..aggregate.autockpt import AutoCheckpoint
+
+        ac = AutoCheckpoint(checkpoint_path, every=checkpoint_every)
+        agg = ConnectedComponents()
+        done = ac.windows_done()
+        if done:
+            print(f"resuming from barrier at window {done}")
+        last = None
+        t0 = time.perf_counter()
+        for last in ac.run(
+            lambda vd: SimpleEdgeStream(
+                edges, window=CountWindow(window_size), vertex_dict=vd
+            ),
+            agg,
+        ):
+            pass
+        runtime_ms = (time.perf_counter() - t0) * 1000
+        if last is None and done:
+            # the barrier already covers the whole stream: emit the
+            # restored summary instead of an empty re-run
+            last = agg.transform(agg._summary, ac.restored_vdict)
+        return _emit(last, output_path, runtime_ms)
     stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
     return _drain(stream, output_path)
+
+
+def _emit(last, output_path: Optional[str], runtime_ms: float):
+    """Shared emission tail: BOTH the plain and checkpoint-resumed paths
+    must format identically for the resume-parity guarantee to hold."""
+    lines = [
+        f"{root}={members}"
+        for root, members in sorted(last.components.items())
+    ] if last else []
+    write_lines(output_path, lines)
+    print(f"Runtime: {runtime_ms:.1f}")
+    return last
 
 
 def _drain(stream, output_path: Optional[str] = None):
@@ -31,13 +79,7 @@ def _drain(stream, output_path: Optional[str] = None):
     for comps in stream.aggregate(ConnectedComponents()):
         last = comps
     runtime_ms = (time.perf_counter() - t0) * 1000
-    lines = [
-        f"{root}={members}"
-        for root, members in sorted(last.components.items())
-    ] if last else []
-    write_lines(output_path, lines)
-    print(f"Runtime: {runtime_ms:.1f}")
-    return last
+    return _emit(last, output_path, runtime_ms)
 
 
 def run_corpus(
@@ -80,15 +122,33 @@ def main(args: List[str]) -> None:
         run_corpus(name, window, device_encode=dev, id_bound=bound)
         return
     if args:
-        if len(args) not in (2, 3):
-            print(
-                "Usage: connected_components [--corpus <name|path> [window] "
-                "[--device-encode <id bound>]] | <input edges path> "
-                "<merge window size (edges)> [output path]"
-            )
+        usage_line = (
+            "Usage: connected_components [--corpus <name|path> [window] "
+            "[--device-encode <id bound>]] | <input edges path> "
+            "<merge window size (edges)> [output path] "
+            "[--checkpoint <path> [--every <windows>]]"
+        )
+        try:
+            ckpt = None
+            every = 64
+            if "--checkpoint" in args:
+                i = args.index("--checkpoint")
+                ckpt = args[i + 1]
+                args = args[:i] + args[i + 2 :]
+                if "--every" in args:
+                    j = args.index("--every")
+                    every = int(args[j + 1])
+                    args = args[:j] + args[j + 2 :]
+            if len(args) not in (2, 3):
+                print(usage_line)
+                return
+            window = int(args[1])
+        except (IndexError, ValueError):
+            print(usage_line)
             return
         edges = read_edges(args[0])
-        run(edges, int(args[1]), args[2] if len(args) > 2 else None)
+        run(edges, window, args[2] if len(args) > 2 else None,
+            checkpoint_path=ckpt, checkpoint_every=every)
     else:
         usage(
             "connected_components",
