@@ -102,6 +102,17 @@ class AutoCheckpoint:
             if w % self.every == 0:
                 self._snapshot(work, stream.vertex_dict, w)
 
+    def restored_emission(self, work):
+        """For ENGINE aggregations: the emission the restored barrier's
+        summary would produce — what a consumer should surface when
+        :meth:`run` yields nothing because the barrier already covers the
+        whole source. Returns None for workload-kind objects (their state
+        surface is ``state_dict``; emissions are not reconstructible
+        generically)."""
+        if hasattr(work, "state_dict") or not hasattr(work, "transform"):
+            return None
+        return work.transform(work._summary, self.restored_vdict)
+
     # ------------------------------------------------------------------ #
     def _snapshot(self, work, vdict, windows_done: int) -> None:
         if hasattr(work, "state_dict"):
@@ -124,7 +135,10 @@ class AutoCheckpoint:
         with open(tmp, "wb") as f:
             pickle.dump(payload, f)
         os.replace(tmp, self.path)  # atomic barrier commit
-        self._cache = payload
+        # invalidate, do NOT cache: payload["state"] aliases LIVE workload
+        # arrays (e.g. the degree shadow mutated by later windows); only
+        # the pickled file is a true point-in-time snapshot
+        self._cache = None
 
     def _load(self) -> Optional[dict]:
         """Read (and cache) the barrier payload: the label table + vertex

@@ -307,25 +307,16 @@ class SimpleEdgeStream(GraphStream):
 
         def gen(blocks):
             from ..native import NativeEncoder
+            from ..utils.keyruns import SortedRunSet
 
             try:
                 keyset = NativeEncoder()
             except Exception:
                 keyset = None
-            # fallback path: LSM-style sorted chunks — geometric merges
-            # (only when the newest chunk has caught up with its
-            # neighbor) give O(N log N) amortized total instead of the
-            # O(seen) array copy np.insert paid per window (round-2
-            # verdict weak #6); lookups touch <= log N chunks
-            seen_chunks: list = []
-
-            def seen_dup(key):
-                dup = np.zeros(len(key), bool)
-                for chunk in seen_chunks:
-                    pos = np.searchsorted(chunk, key)
-                    pos_c = np.minimum(pos, len(chunk) - 1)
-                    dup |= chunk[pos_c] == key
-                return dup
+            # fallback path: LSM sorted-run key set (utils/keyruns.py) —
+            # amortized O(N log N) instead of the O(seen) array copy
+            # np.insert paid per window (round-2 verdict weak #6)
+            seen = SortedRunSet()
 
             for b in blocks:
                 cache = getattr(b, "_host_cache", None)
@@ -359,20 +350,13 @@ class SimpleEdgeStream(GraphStream):
                     _, first_idx = np.unique(key, return_index=True)
                     is_first = np.zeros(key.shape[0], dtype=bool)
                     is_first[first_idx] = True
-                    dup = seen_dup(key) if seen_chunks else np.zeros(
+                    dup = seen.contains(key) if len(seen) else np.zeros(
                         len(key), bool
                     )
                     fresh = mask & is_first & ~dup
                     new_keys = key[fresh]
                     if new_keys.size:
-                        seen_chunks.append(np.sort(new_keys))
-                        while (
-                            len(seen_chunks) >= 2
-                            and len(seen_chunks[-1]) >= len(seen_chunks[-2])
-                        ):
-                            b2 = seen_chunks.pop()
-                            a2 = seen_chunks.pop()
-                            seen_chunks.append(np.sort(np.concatenate([a2, b2])))
+                        seen.add(np.sort(new_keys))
                 import dataclasses as dc
 
                 out = dc.replace(b, mask=jnp.asarray(fresh))

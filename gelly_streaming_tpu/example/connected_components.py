@@ -53,7 +53,7 @@ def run(
         if last is None and done:
             # the barrier already covers the whole stream: emit the
             # restored summary instead of an empty re-run
-            last = agg.transform(agg._summary, ac.restored_vdict)
+            last = ac.restored_emission(agg)
         return _emit(last, output_path, runtime_ms)
     stream = SimpleEdgeStream(edges, window=CountWindow(window_size))
     return _drain(stream, output_path)

@@ -48,6 +48,7 @@ from ..ops.triangles import (
     sticky_search_steps,
 )
 from ..summaries.adjacency import AdjacencyListGraph
+from ..utils.keyruns import SortedRunSet
 
 _BIG = jnp.iinfo(jnp.int32).max
 
@@ -313,10 +314,11 @@ class DeviceSpanner:
         #: exploding HBM.
         self.mem_budget_entries = mem_budget_entries
         self._vdict = None
-        # host shadow ([[novelty-tracked]] growth): sorted first-seen
-        # candidate keys + candidate degrees (sound upper bounds on the
-        # accepted structures the device carries)
-        self._seen = np.zeros(0, np.int64)
+        # host shadow ([[novelty-tracked]] growth): first-seen candidate
+        # keys (LSM sorted runs — amortized O(N log N), no per-window
+        # O(total) np.insert) + candidate degrees (sound upper bounds on
+        # the accepted structures the device carries)
+        self._seen = SortedRunSet()
         self._deg = np.zeros(0, np.int64)
         self._cnt_ub = 0  # upper bound on carried device entries
         # k=2 packed-adjacency carry (device)
@@ -354,14 +356,8 @@ class DeviceSpanner:
             ok = u != v
             u, v = u[ok], v[ok]
             if u.size:
-                key = np.unique((u << 32) | v)
-                if len(self._seen) and len(key):
-                    pos = np.searchsorted(self._seen, key)
-                    pos = np.minimum(pos, len(self._seen) - 1)
-                    key = key[self._seen[pos] != key]
-                if len(key):
-                    ins = np.searchsorted(self._seen, key)
-                    self._seen = np.insert(self._seen, ins, key)
+                key = self._seen.filter_new(np.unique((u << 32) | v))
+                self._seen.add(key)
                 u = (key >> 32).astype(np.int32)
                 v = (key & 0xFFFFFFFF).astype(np.int32)
             if u.size == 0:
@@ -515,9 +511,9 @@ class DeviceSpanner:
             return
         su, sv = self._restore
         self._restore = None
-        self._seen = (
-            np.unique((su.astype(np.int64) << 32) | sv.astype(np.int64))
-            if len(su) else np.zeros(0, np.int64)
+        self._seen = SortedRunSet(
+            (su.astype(np.int64) << 32) | sv.astype(np.int64)
+            if len(su) else None
         )
         self._deg = np.zeros(vcap, np.int64)
         if len(su):
@@ -553,7 +549,7 @@ class DeviceSpanner:
         self._restore = (
             np.asarray(d["su"], np.int32), np.asarray(d["sv"], np.int32)
         )
-        self._seen = np.zeros(0, np.int64)
+        self._seen = SortedRunSet()
         self._deg = np.zeros(0, np.int64)
         self._cnt_ub = 0
         self._pv = self._pn = self._pr = None

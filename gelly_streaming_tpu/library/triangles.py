@@ -36,6 +36,7 @@ import numpy as np
 
 from ..core.edgeblock import bucket_capacity
 from ..core.window import CountWindow, WindowPolicy, Windower
+from ..utils.keyruns import SortedRunSet
 from ..ops.triangles import (
     build_sorted_directed,
     degree_class_plan,
@@ -267,10 +268,13 @@ class ExactTriangleCount:
         # duplicate-inflated degree bound (bincount only, no sorts) that
         # soundly over-covers every true adjacency-row length for class
         # assignment
-        self._u = np.zeros(0, np.int32)
-        self._v = np.zeros(0, np.int32)
+        # raw columns as per-window chunks (concatenated only at the
+        # checkpoint sync point: a per-window concatenate of the whole
+        # history is O(stream) memcpy per window — quadratic)
+        self._u_chunks: List[np.ndarray] = []
+        self._v_chunks: List[np.ndarray] = []
         self._deg = np.zeros(0, np.int64)
-        self._have = np.zeros(0, np.int64)  # sorted distinct canonical keys
+        self._have = SortedRunSet()  # distinct canonical keys (LSM runs)
         self._n_raw = 0  # cumulative rank offset (padded block widths)
         self._emit_prev = None  # host counts at the last materialized batch
         self._emit_prev_total = 0
@@ -292,13 +296,24 @@ class ExactTriangleCount:
         for block in stream.blocks():
             yield self._process(block, vdict)
 
+    def _raw_columns(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Flatten (and collapse) the per-window raw-column chunks — the
+        checkpoint-time sync point; per-window code never concatenates."""
+        if len(self._u_chunks) > 1:
+            self._u_chunks = [np.concatenate(self._u_chunks)]
+            self._v_chunks = [np.concatenate(self._v_chunks)]
+        if not self._u_chunks:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        return self._u_chunks[0], self._v_chunks[0]
+
     def state_dict(self) -> dict:
         """Checkpoint surface (``aggregate/checkpoint.py:save_workload``).
         The packed adjacency is NOT serialized — ``load_state_dict``
         rebuilds it from the raw edge columns (rank ORDER, the only thing
         the counting rule reads, survives the renumbering)."""
+        u, v = self._raw_columns()
         return {
-            "u": self._u, "v": self._v,
+            "u": u, "v": v,
             "deg": self._deg,
             "n_raw": self._n_raw,
             "counts": None if self._counts is None else np.asarray(self._counts),
@@ -306,27 +321,29 @@ class ExactTriangleCount:
         }
 
     def load_state_dict(self, d: dict) -> None:
-        self._u, self._v = d["u"], d["v"]
+        u, v = np.asarray(d["u"]), np.asarray(d["v"])
+        self._u_chunks = [u] if len(u) else []
+        self._v_chunks = [v] if len(v) else []
         self._deg = d["deg"]
-        self._n_raw = int(d.get("n_raw", len(self._u)))
+        self._n_raw = int(d.get("n_raw", len(u)))
         self._counts = None if d["counts"] is None else jnp.asarray(d["counts"])
         self._total = jnp.int32(int(d["total"]))
         self._emit_prev = None if d["counts"] is None else np.asarray(d["counts"]).copy()
         self._emit_prev_total = int(d["total"])
         self._pv = self._pn = self._pr = None
         self._n_packed = 0
-        self._have = np.zeros(0, np.int64)
-        if len(self._u):
+        self._have = SortedRunSet()
+        if len(u):
             # rebuild the packed adjacency from the raw columns: canonical
             # first occurrences, ranked by raw arrival position
-            cu = np.minimum(self._u, self._v).astype(np.int64)
-            cv = np.maximum(self._u, self._v).astype(np.int64)
+            cu = np.minimum(u, v).astype(np.int64)
+            cv = np.maximum(u, v).astype(np.int64)
             ok = cu != cv
             pos_all = np.nonzero(ok)[0]
             cu, cv = cu[ok], cv[ok]
             key = (cu << 32) | cv
             _, first = np.unique(key, return_index=True)
-            self._have = np.unique(key)  # host shadow of the packed count
+            self._have = SortedRunSet(key)  # host shadow of the packed count
             ranks = pos_all[first].astype(np.int32)
             cu = cu[first].astype(np.int32)
             cv = cv[first].astype(np.int32)
@@ -336,7 +353,7 @@ class ExactTriangleCount:
             self._pn = jnp.asarray(pnp)
             self._pr = jnp.asarray(prp)
             # future ranks must exceed every rebuilt rank
-            self._n_raw = max(self._n_raw, len(self._u))
+            self._n_raw = max(self._n_raw, len(u))
 
     # ------------------------------------------------------------------ #
     def _grow_packed(self, need: int) -> None:
@@ -368,8 +385,8 @@ class ExactTriangleCount:
             )
         if n_raw == 0:
             return []
-        self._u = np.concatenate([self._u, np.asarray(s, np.int32)])
-        self._v = np.concatenate([self._v, np.asarray(d, np.int32)])
+        self._u_chunks.append(np.asarray(s, np.int32))
+        self._v_chunks.append(np.asarray(d, np.int32))
         if vcap > len(self._deg):
             self._deg = np.concatenate(
                 [self._deg, np.zeros(vcap - len(self._deg), np.int64)]
@@ -392,15 +409,9 @@ class ExactTriangleCount:
         cu = np.minimum(s, d).astype(np.int64)
         cvv = np.maximum(s, d).astype(np.int64)
         okc = cu != cvv
-        new_key = np.unique((cu[okc] << 32) | cvv[okc])
-        if len(self._have) and len(new_key):
-            posk = np.searchsorted(self._have, new_key)
-            posk = np.minimum(posk, len(self._have) - 1)
-            new_key = new_key[self._have[posk] != new_key]
+        new_key = self._have.filter_new(np.unique((cu[okc] << 32) | cvv[okc]))
         n_new_distinct = len(new_key)
-        if n_new_distinct:
-            ins = np.searchsorted(self._have, new_key)
-            self._have = np.insert(self._have, ins, new_key)
+        self._have.add(new_key)
         self._grow_packed(self._n_packed + 2 * n_new_distinct)
         search_steps = max(4, int(self._pv.shape[0]).bit_length())
         (self._pv, self._pn, self._pr, row_ptr, qu, qv, qrank,

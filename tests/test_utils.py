@@ -156,3 +156,29 @@ def test_engine_config_ingest_knobs(tmp_path):
     stream2 = cfg2.open_stream(str(p))
     got = [c for c in stream2.aggregate(ConnectedComponents())][-1]
     assert sorted(got.component_sets()) == sorted(last.component_sets())
+
+
+def test_sorted_run_set_matches_naive():
+    """LSM sorted-run key set: same answers as a plain python set under a
+    randomized insert/probe workload, runs stay logarithmic."""
+    import numpy as np
+
+    from gelly_streaming_tpu.utils.keyruns import SortedRunSet
+
+    rng = np.random.default_rng(11)
+    s = SortedRunSet()
+    ref = set()
+    for _ in range(40):
+        batch = rng.integers(0, 500, rng.integers(1, 60))
+        keys = np.unique(batch.astype(np.int64))
+        new = s.filter_new(keys)
+        expect_new = sorted(set(keys.tolist()) - ref)
+        assert new.tolist() == expect_new
+        s.add(new)
+        ref |= set(keys.tolist())
+        assert len(s) == len(ref)
+        probe = rng.integers(0, 600, 32).astype(np.int64)
+        got = s.contains(probe)
+        assert got.tolist() == [int(p) in ref for p in probe]
+    assert len(s._runs) <= 12  # geometric merging keeps runs logarithmic
+    assert s.to_array().tolist() == sorted(ref)
