@@ -429,71 +429,84 @@ class Windower:
     def _chunk_time_windows(
         self, chunks, policy: EventTimeWindow, encoded: bool = False
     ):
-        if policy.timestamp_fn is None:
+        build = self._block_from_encoded if encoded else self._block_from_arrays
+        runs = iter_time_slot_runs(chunks, policy, val_dtype=self.val_dtype)
+        for index, (slot, src, dst, val) in enumerate(runs):
+            yield self._info(index, slot), build(src, dst, val)
+
+
+def iter_time_slot_runs(chunks, policy: "EventTimeWindow",
+                        val_dtype=np.float64):
+    """The ONE chunked event-time splitter: consume (src, dst[, val])
+    column chunks and yield ``(slot, src, dst, val|None)`` per completed
+    tumbling window (ascending timestamps; boundaries are runs of equal
+    ``ts // size``; the final partial window is included). Carried runs
+    accumulate as a LIST and concatenate once per flush — a window
+    spanning many chunks costs O(window), not a per-chunk re-copy of the
+    whole carry. Shared by the Windower's chunked path and the
+    device-encode ingest (``datasets._device_encoded_blocks``) so slot
+    semantics cannot diverge between them."""
+    if policy.timestamp_fn is None:
+        raise ValueError(
+            "EventTimeWindow requires timestamp_fn — without it the "
+            "edge value would silently be read as the event time"
+        )
+    slot: Optional[int] = None
+    pend: list = []
+
+    def flush():
+        if not pend:
+            return None
+        src = np.concatenate([p[0] for p in pend])
+        dst = np.concatenate([p[1] for p in pend])
+        if any(p[2] is not None for p in pend):
+            val = np.concatenate(
+                [
+                    np.zeros(len(p[0]), val_dtype) if p[2] is None
+                    else np.asarray(p[2], val_dtype)
+                    for p in pend
+                ]
+            )
+        else:
+            val = None
+        out = (slot, src, dst, val)
+        pend.clear()
+        return out
+
+    for cols in chunks:
+        src, dst = np.asarray(cols[0]), np.asarray(cols[1])
+        val = cols[2] if len(cols) > 2 else None
+        n = len(src)
+        if n == 0:
+            continue
+        ts = np.asarray(
+            policy.timestamp_fn(tuple(
+                np.asarray(c) if c is not None else None for c in cols
+            )),
+            np.float64,
+        )
+        if ts.shape != (n,):
             raise ValueError(
-                "EventTimeWindow requires timestamp_fn — without it the "
-                "edge value would silently be read as the event time"
+                "EventTimeWindow.timestamp_fn returned shape "
+                f"{ts.shape} on the chunked path; expected ({n},)"
             )
-        index = 0
-        slot: Optional[int] = None
-        pend: list[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]] = []
-
-        def flush():
-            nonlocal index, slot
-            if not pend:
-                return None
-            src = np.concatenate([p[0] for p in pend])
-            dst = np.concatenate([p[1] for p in pend])
-            if any(p[2] is not None for p in pend):
-                val = np.concatenate(
-                    [
-                        np.zeros(len(p[0]), self.val_dtype) if p[2] is None
-                        else np.asarray(p[2], self.val_dtype)
-                        for p in pend
-                    ]
-                )
-            else:
-                val = None
-            build = (
-                self._block_from_encoded if encoded else self._block_from_arrays
+        slots = (ts // policy.size).astype(np.int64)
+        bounds = np.nonzero(np.diff(slots))[0] + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [n]])
+        for a, b in zip(starts, ends):
+            run_slot = int(slots[a])
+            if slot is not None and run_slot != slot:
+                w = flush()
+                if w is not None:
+                    yield w
+            slot = run_slot
+            pend.append(
+                (src[a:b], dst[a:b], None if val is None else val[a:b])
             )
-            out = self._info(index, slot), build(src, dst, val)
-            index += 1
-            pend.clear()
-            return out
-
-        for cols in chunks:
-            src, dst = np.asarray(cols[0]), np.asarray(cols[1])
-            val = cols[2] if len(cols) > 2 else None
-            n = len(src)
-            if n == 0:
-                continue
-            ts = np.asarray(
-                policy.timestamp_fn(tuple(np.asarray(c) for c in cols)),
-                np.float64,
-            )
-            if ts.shape != (n,):
-                raise ValueError(
-                    "EventTimeWindow.timestamp_fn returned shape "
-                    f"{ts.shape} on the chunked path; expected ({n},)"
-                )
-            slots = (ts // policy.size).astype(np.int64)
-            bounds = np.nonzero(np.diff(slots))[0] + 1
-            starts = np.concatenate([[0], bounds])
-            ends = np.concatenate([bounds, [n]])
-            for a, b in zip(starts, ends):
-                run_slot = int(slots[a])
-                if slot is not None and run_slot != slot:
-                    w = flush()
-                    if w is not None:
-                        yield w
-                slot = run_slot
-                pend.append(
-                    (src[a:b], dst[a:b], None if val is None else val[a:b])
-                )
-        w = flush()
-        if w is not None:
-            yield w
+    w = flush()
+    if w is not None:
+        yield w
 
 
 def blocks_from_edges(

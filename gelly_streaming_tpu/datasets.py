@@ -30,7 +30,7 @@ import numpy as np
 from . import native
 from .core.stream import SimpleEdgeStream
 from .core.vertexdict import VertexDict
-from .core.window import CountWindow, WindowPolicy, Windower
+from .core.window import CountWindow, EventTimeWindow, WindowPolicy, Windower
 
 
 @dataclasses.dataclass(frozen=True)
@@ -338,10 +338,16 @@ def iter_binary_chunks(bin_path: str, chunk_edges: int = 1 << 21):
 # --------------------------------------------------------------------- #
 # File -> stream
 # --------------------------------------------------------------------- #
-def _device_encoded_blocks(path, is_binary, size, vdict, chunk_edges):
-    """CountWindow blocks whose vertex mapping runs ON DEVICE: host work
-    is slicing raw columns and device puts; the compaction is the carried
-    device hash table (``ops/device_dict.py``).
+def _device_encoded_blocks(path, is_binary, policy, vdict, chunk_edges,
+                           drop_values=False):
+    """Window blocks whose vertex mapping runs ON DEVICE: host work is
+    slicing raw columns and device puts; the compaction is the carried
+    device hash table (``ops/device_dict.py``). ``policy`` is a
+    CountWindow (fixed ``size`` slices) or an EventTimeWindow (ascending
+    timestamps from ``timestamp_fn`` over the column tuple — same
+    contract as the Windower's array fast path; window boundaries are
+    runs of equal time slot, so block capacities bucket by observed
+    window size).
 
     With a declared ``id_bound`` the table covers the id space and every
     window is one unconditional encode dispatch. WITHOUT a bound (general
@@ -374,7 +380,10 @@ def _device_encoded_blocks(path, is_binary, size, vdict, chunk_edges):
         if cap != n:
             si = jnp.pad(si, (0, cap - n))
             di = jnp.pad(di, (0, cap - n))
-        if v is None:
+        if v is None or drop_values:
+            # value-ignoring workloads (CC, degrees, triangles) on
+            # weighted corpora: skip the per-window float32 H2D entirely
+            # (ROADMAP #4); the cached zero column is one device constant
             val = _cached_zeros(cap, jnp.float32)
         else:
             vp = np.zeros(cap, np.float32)
@@ -393,13 +402,20 @@ def _device_encoded_blocks(path, is_binary, size, vdict, chunk_edges):
             si, di = vdict.encode_pair(s, d)
         return build(si, di, v, len(s))
 
+    read_chunk = (
+        policy.size if isinstance(policy, CountWindow) else chunk_edges
+    )
     src = (
-        iter_binary_chunks(path, size)
+        iter_binary_chunks(path, read_chunk)
         if is_binary
         else native.iter_edge_chunks_i32(
             path, chunk_edges, id_bound=getattr(vdict, "id_bound", 0)
         )
     )
+    if not isinstance(policy, CountWindow):
+        yield from _event_time_device_blocks(src, policy, vdict, growth, emit)
+        return
+    size = policy.size
     pend, have = [], 0
     for s, d, v in src:
         s, d = np.asarray(s), np.asarray(d)
@@ -449,6 +465,28 @@ def _device_encoded_blocks(path, is_binary, size, vdict, chunk_edges):
             yield emit(cs, cd, cv)
 
 
+def _event_time_device_blocks(src, policy, vdict, growth, emit):
+    """Event-time windowing for the device-encode path: the shared
+    chunked slot-run splitter (``core.window.iter_time_slot_runs`` — ONE
+    implementation of the boundary semantics with the host Windower),
+    with novelty tracking applied per raw chunk on the way in."""
+    from .core.window import iter_time_slot_runs
+
+    novelty = getattr(vdict, "_novelty", None)
+
+    def tracked(chunks):
+        for s, d, v in chunks:
+            s, d = np.asarray(s), np.asarray(d)
+            if growth:
+                vdict._novel_seen += novelty.novel2(s, d)
+            yield s, d, v
+
+    for _slot, s, d, v in iter_time_slot_runs(
+        tracked(src), policy, val_dtype=np.float32
+    ):
+        yield emit(s, d, v)
+
+
 def stream_file(
     path: str,
     window: Optional[WindowPolicy] = None,
@@ -459,6 +497,7 @@ def stream_file(
     min_vertex_capacity: int = 0,
     device_encode: bool = False,
     dense_ids: bool = True,
+    drop_values: bool = False,
 ) -> SimpleEdgeStream:
     """A :class:`SimpleEdgeStream` over an edge file, chunk-parsed natively.
 
@@ -480,14 +519,18 @@ def stream_file(
     table grows proactively from exact host-side novelty tracking (see
     :func:`_device_encoded_blocks`), and ``min_vertex_capacity`` is
     only a pre-sizing hint. Ids beyond int32 need the host ``VertexDict``.
+    ``drop_values=True`` skips the per-window value-column upload for
+    value-ignoring workloads on weighted corpora (device-encode only).
     """
     policy = window or CountWindow(1 << 20)
     is_binary = path.endswith(".gbin")
     if device_encode:
         # vertex compaction as device state: one encode dispatch per
-        # window, no host hash work (ROADMAP #1). CountWindow only.
-        if not isinstance(policy, CountWindow):
-            raise ValueError("device_encode supports CountWindow streams")
+        # window, no host hash work (ROADMAP #1)
+        if not isinstance(policy, (CountWindow, EventTimeWindow)):
+            raise ValueError(
+                "device_encode supports CountWindow / EventTimeWindow"
+            )
         if vertex_dict is not None:
             raise ValueError(
                 "device_encode builds its own DeviceVertexDict; a supplied "
@@ -502,7 +545,8 @@ def stream_file(
 
         def device_source():
             it = _device_encoded_blocks(
-                path, is_binary, policy.size, vd, chunk_edges
+                path, is_binary, policy, vd, chunk_edges,
+                drop_values=drop_values,
             )
             if prefetch_depth > 0:
                 from .core.pipeline import prefetch

@@ -63,15 +63,7 @@ class SortedRunSet:
         ):
             b = self._runs.pop()
             a = self._runs.pop()
-            merged = np.empty(len(a) + len(b), np.int64)
-            # disjoint sorted runs: classic two-way merge via searchsorted
-            pos = np.searchsorted(a, b)
-            idx_b = pos + np.arange(len(b))
-            mask = np.zeros(len(merged), bool)
-            mask[idx_b] = True
-            merged[mask] = b
-            merged[~mask] = a
-            self._runs.append(merged)
+            self._runs.append(_merge_disjoint(a, b))
 
     def to_array(self) -> np.ndarray:
         """All keys, sorted (checkpoint/debug surface)."""
@@ -79,12 +71,18 @@ class SortedRunSet:
             return np.zeros(0, np.int64)
         out = self._runs[0]
         for run in self._runs[1:]:
-            pos = np.searchsorted(out, run)
-            idx_b = pos + np.arange(len(run))
-            merged = np.empty(len(out) + len(run), np.int64)
-            mask = np.zeros(len(merged), bool)
-            mask[idx_b] = True
-            merged[mask] = run
-            merged[~mask] = out
-            out = merged
+            out = _merge_disjoint(out, run)
         return out
+
+
+def _merge_disjoint(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Two-way merge of DISJOINT sorted int64 runs (searchsorted placement
+    + boolean scatter — one O(n) pass, no re-sort)."""
+    pos = np.searchsorted(a, b)
+    idx_b = pos + np.arange(len(b))
+    merged = np.empty(len(a) + len(b), np.int64)
+    mask = np.zeros(len(merged), bool)
+    mask[idx_b] = True
+    merged[mask] = b
+    merged[~mask] = a
+    return merged

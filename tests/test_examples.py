@@ -221,3 +221,27 @@ def test_pagerank_corpus_mode(tmp_path, capsys):
     ex.main(["--corpus", str(p), "100"])
     out = capsys.readouterr().out
     assert "Runtime:" in out and out.count("(") >= 10
+
+
+def test_spanner_example_device_flag(tmp_path):
+    """--device routes through DeviceSpanner; the written edge set is a
+    valid k-spanner of the input."""
+    import numpy as np
+
+    from gelly_streaming_tpu.example import spanner as mod
+    from tests.test_device_spanner import assert_valid_spanner
+
+    rng = np.random.default_rng(6)
+    inp = str(tmp_path / "edges.txt")
+    pairs = rng.integers(0, 25, size=(80, 2))
+    with open(inp, "w") as f:
+        for a, b in pairs:
+            f.write(f"{a}\t{b}\n")
+    out = str(tmp_path / "out.txt")
+    mod.main([inp, "16", "2", out, "--device"])
+    got = set()
+    with open(out) as f:
+        for line in f:
+            u, v = map(int, line.split())
+            got.add((min(u, v), max(u, v)))
+    assert_valid_spanner([(int(a), int(b)) for a, b in pairs], got, 2)
