@@ -1008,6 +1008,26 @@ def _headline() -> tuple:
     # comparator is CPU-only, so it rides every headline run
     flink = bench_cc_flink_proxy(s64, d64)
     assert flink["components"] == base_bin["components"]
+    # enforce the documented bracket on EVERY run (BASELINE.md). Hard
+    # bounds use 1.5x slack: proxy and compiled baseline legitimately sit
+    # within each other's run-to-run noise (serialization adds only
+    # ~5-10%), so the tight comparison is a warning while a gross
+    # violation (proxy slower than interpreted Python, or markedly faster
+    # than the zero-overhead baseline) fails the run as a measurement bug.
+    py_eps = bench_cc_python_tier(s64, d64, sample=min(n_edges, 400_000))
+    assert py_eps <= flink["eps"], (
+        f"flink proxy {flink['eps']:.0f} eps below the interpreted tier "
+        f"{py_eps:.0f} — proxy measurement broken"
+    )
+    assert flink["eps"] <= base_bin["eps"] * 1.5, (
+        f"flink proxy {flink['eps']:.0f} eps far above the compiled "
+        f"baseline {base_bin['eps']:.0f} — proxy measurement broken"
+    )
+    if flink["eps"] > base_bin["eps"] * 1.05:
+        log(f"bench: WARNING flink proxy {flink['eps']:.0f} eps above the "
+            f"compiled baseline {base_bin['eps']:.0f} (within noise; the "
+            "proxy remains an upper bound on Flink either way)")
+    flink["python_unionfind_eps"] = round(py_eps, 1)
     headline = {
         "metric": "streaming_cc_e2e_edges_per_sec",
         "value": round(e2e["eps"], 1),
@@ -1108,9 +1128,9 @@ def _headline_guarded():
         )
         if out.returncode != 0:
             log(f"bench: headline worker failed rc={out.returncode}: "
-                f"{out.stderr[-800:]}")
+                f"{out.stderr[-2000:]}")
             return None
-        log(out.stderr[-2000:])
+        log(out.stderr)  # the full measurement log is the audit trail
         with open(sidecar) as f:
             return json.load(f)
     except subprocess.TimeoutExpired:
@@ -1190,18 +1210,8 @@ def main():
     if "--all" in sys.argv:
         import subprocess
 
-        from gelly_streaming_tpu import datasets
-
-        # the python tier samples 400k edges: one leading chunk suffices
-        # (the headline worker process owned the full parsed columns)
-        sample = min(n_edges, 400_000)
-        s64, d64, _ = next(datasets.iter_binary_chunks(binp, sample))
-        s64 = np.asarray(s64, np.int64)
-        d64 = np.asarray(d64, np.int64)
-        py_eps = bench_cc_python_tier(s64, d64, sample=sample)
-        if not (py_eps <= flink["eps"] <= base_bin["eps"] * 1.05):
-            log(f"bench: WARNING flink proxy {flink['eps']:.0f} eps outside "
-                f"bracket [{py_eps:.0f}, {base_bin['eps']:.0f}]")
+        # measured inside the headline worker alongside the bracket check
+        py_eps = flink["python_unionfind_eps"]
         detail = {
             "headline": headline,
             "e2e_device_encode": e2e,

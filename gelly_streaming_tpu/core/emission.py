@@ -115,6 +115,34 @@ class DeviceColumnBatch:
         )
 
 
+class LazyListBatch:
+    """Base for lazy list-like window emissions: subclasses set
+    ``self._items = None`` in ``__init__`` and implement ``_compute() ->
+    list``; the list-protocol surface (iterate / len / index / compare /
+    repr) and the materialize-once caching live here, so the change-only
+    batch types (triangles, degree histograms, ...) cannot drift apart."""
+
+    def _materialize(self) -> list:
+        if self._items is None:
+            self._items = self._compute()
+        return self._items
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __len__(self) -> int:
+        return len(self._materialize())
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __eq__(self, other):
+        return self._materialize() == other
+
+    def __repr__(self) -> str:
+        return repr(self._materialize())
+
+
 class LazyRecordBatch:
     """A :class:`RecordColumnBatch` whose columns come from a thunk run on
     first read — the typed-record analog of :class:`DeviceColumnBatch`.

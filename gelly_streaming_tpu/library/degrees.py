@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.edgeblock import bucket_capacity
+from ..core.emission import LazyListBatch
 from ..core.types import EventType
 from ..core.window import CountWindow, WindowPolicy, Windower
 from ..ops.segment import segmented_reduce_generic
@@ -76,14 +77,42 @@ class DegreeDistribution:
         self._windower = Windower(self.window, vertex_dict, val_dtype=np.int32)
         self._deg = None  # device int32[vcap]
         self._hist = None  # device int32[hcap]; index = degree, [0] unused
-        self._max_deg = 0
+        # host shadow for histogram-capacity growth (zero device reads in
+        # the producer loop): per window, no degree can rise by more than
+        # that window's max per-vertex event count (host bincount on the
+        # cached columns), so the running sum upper-bounds the max degree;
+        # materializing any emission tightens it to the downloaded truth.
+        self._max_deg_ub = 0
+        self._events_total = 0
+        self._emit_base = 0  # event watermark of the last materialized batch
+        self._emit_prev = None  # host hist at the last materialized batch
 
-    def run(self, events: Iterable[Tuple]) -> Iterator[List[Tuple[int, int]]]:
+    def run(self, events: Iterable[Tuple]) -> Iterator["HistogramBatch"]:
+        """Yields one lazy :class:`HistogramBatch` per window — list-like
+        ``(degree, count)`` change-only entries, downloaded on first read
+        (the round-3 version downloaded two full histograms per window).
+        Materializing batches in stream order reproduces per-window
+        change-only emission exactly; skipping windows folds their
+        changes into the next batch read."""
         windower = self._windower
         rows = ((s, d, _delta(c), *rest) for s, d, c, *rest in events)
         for block in windower.blocks(rows):
             vcap = block.n_vertices
-            n_events = int(np.asarray(block.mask).sum())
+            cache = getattr(block, "_host_cache", None)
+            if cache is not None:
+                s_h, d_h = cache[0], cache[1]
+            else:  # non-windower block (rare): one download
+                mask_h = np.asarray(block.mask)
+                s_h = np.asarray(block.src)[mask_h]
+                d_h = np.asarray(block.dst)[mask_h]
+            n_events = len(s_h)
+            if n_events:
+                # max per-vertex event count this window bounds how far
+                # any degree (hence the histogram support) can rise
+                both = np.concatenate([s_h, d_h])
+                self._max_deg_ub += int(
+                    np.unique(both, return_counts=True)[1].max()
+                )
             if self._deg is None:
                 self._deg = jnp.zeros(vcap, jnp.int32)
             elif vcap > self._deg.shape[0]:
@@ -91,9 +120,7 @@ class DegreeDistribution:
                     [self._deg,
                      jnp.zeros(vcap - self._deg.shape[0], jnp.int32)]
                 )
-            # histogram capacity: degrees this window cannot exceed
-            # old max + events in the window
-            hcap = bucket_capacity(self._max_deg + n_events + 1)
+            hcap = bucket_capacity(self._max_deg_ub + 1)
             if self._hist is None:
                 self._hist = jnp.zeros(hcap, jnp.int32)
             elif hcap > self._hist.shape[0]:
@@ -109,32 +136,39 @@ class DegreeDistribution:
             verts = jnp.stack([block.src, block.dst], axis=1).ravel()
             deltas = jnp.stack([block.val, block.val], axis=1).ravel()
             mask = jnp.stack([block.mask, block.mask], axis=1).ravel()
-            old_hist = self._hist
             self._deg, self._hist = _degree_step(
                 self._deg, self._hist, verts, deltas, mask, vcap
             )
-            self._max_deg = int(self._deg.max())
-            changed = np.nonzero(
-                np.asarray(self._hist) != np.asarray(old_hist)
-            )[0]
-            new_hist = np.asarray(self._hist)
-            yield [(int(d), int(new_hist[d])) for d in changed]
+            self._events_total += n_events
+            yield HistogramBatch(
+                self, self._hist, self._events_total, self._max_deg_ub
+            )
 
     def state_dict(self) -> dict:
         """Checkpoint surface (``aggregate/checkpoint.py:save_workload``);
         self-contained: includes the vertex dictionary so the compact-id
         space survives the resume."""
+        hist = None if self._hist is None else np.asarray(self._hist)
+        max_deg = (
+            0 if hist is None or not hist.any()
+            else int(np.nonzero(hist)[0][-1])
+        )
+        # checkpoint = a natural sync point: snap the shadow exactly
+        self._max_deg_ub = min(self._max_deg_ub, max_deg)
         return {
             "deg": None if self._deg is None else np.asarray(self._deg),
-            "hist": None if self._hist is None else np.asarray(self._hist),
-            "max_deg": self._max_deg,
+            "hist": hist,
+            "max_deg": max_deg,
             "vdict_raw": self._windower.vertex_dict.raw_ids(),
         }
 
     def load_state_dict(self, d: dict) -> None:
         self._deg = None if d["deg"] is None else jnp.asarray(d["deg"])
         self._hist = None if d["hist"] is None else jnp.asarray(d["hist"])
-        self._max_deg = int(d["max_deg"])
+        self._max_deg_ub = int(d["max_deg"])
+        self._events_total = 0
+        self._emit_base = 0
+        self._emit_prev = None if d["hist"] is None else np.asarray(d["hist"]).copy()
         vd = self._windower.vertex_dict
         if len(vd) == 0:
             vd.encode(d["vdict_raw"])
@@ -145,14 +179,65 @@ class DegreeDistribution:
             )
 
     def histogram(self) -> dict:
-        """Current (degree -> count) map, degree >= 1 entries only."""
+        """Current (degree -> count) map, degree >= 1 entries only.
+        A natural sync point: snaps the capacity shadow to the truth."""
         if self._hist is None:
             return {}
         h = np.asarray(self._hist)
-        return {int(d): int(h[d]) for d in np.nonzero(h)[0] if d > 0}
+        nz = np.nonzero(h)[0]
+        self._max_deg_ub = min(
+            self._max_deg_ub, int(nz[-1]) if len(nz) else 0
+        )
+        return {int(d): int(h[d]) for d in nz if d > 0}
 
     def degrees(self) -> np.ndarray:
         return np.zeros(0, np.int32) if self._deg is None else np.asarray(self._deg)
+
+
+class HistogramBatch(LazyListBatch):
+    """One window's change-only histogram emission, LAZY (the degree
+    analog of :class:`~gelly_streaming_tpu.library.triangles.TriangleBatch`):
+    the device histogram downloads on first read, changes are reported
+    against the histogram at the previous materialized batch, and the
+    workload's capacity shadow tightens from what the download reveals.
+    Materializing in stream order reproduces per-window change-only
+    emission exactly; an out-of-order read diffs against whatever was
+    materialized last WITHOUT regressing the workload's watermarks."""
+
+    __slots__ = ("_workload", "_hist", "_ev", "_ub", "_items")
+
+    def __init__(self, workload, hist, ev, ub):
+        self._workload = workload
+        self._hist = hist
+        self._ev = ev
+        self._ub = ub
+        self._items = None
+
+    def _compute(self) -> list:
+        w = self._workload
+        h = np.asarray(self._hist)
+        prev = w._emit_prev
+        if prev is None or len(prev) < len(h):
+            grown = np.zeros(len(h), h.dtype)
+            if prev is not None:
+                grown[: len(prev)] = prev
+            prev = grown
+        changed = np.nonzero(h != prev[: len(h)])[0]
+        items = [(int(d), int(h[d])) for d in changed]
+        if self._ev >= w._emit_base:
+            # newest materialization wins; an older batch read later must
+            # not clobber the diff base or the watermark
+            w._emit_prev = h
+            w._emit_base = self._ev
+        # capacity shadow: current ub <= true max AT THIS BATCH plus the
+        # increments added since — a valid bound under ANY read order, so
+        # take the min
+        nz = np.nonzero(h)[0]
+        true_max = int(nz[-1]) if len(nz) else 0
+        w._max_deg_ub = min(
+            w._max_deg_ub, true_max + (w._max_deg_ub - self._ub)
+        )
+        return items
 
 
 def _delta(change) -> int:

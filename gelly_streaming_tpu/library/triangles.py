@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.edgeblock import bucket_capacity
+from ..core.emission import LazyListBatch
 from ..core.window import CountWindow, WindowPolicy, Windower
 from ..utils.keyruns import SortedRunSet
 from ..ops.triangles import (
@@ -193,7 +194,7 @@ def _oriented_degree_bucket(
     return bucket_capacity(int(np.bincount(a, minlength=num_vertices).max()))
 
 
-class TriangleBatch:
+class TriangleBatch(LazyListBatch):
     """One window's change-only emission, LAZY: device arrays are held and
     the download happens on first read (iteration / indexing). Unconsumed
     windows cost zero device->host traffic, so the device pipeline never
@@ -203,21 +204,22 @@ class TriangleBatch:
     Changes are reported against the counts at the PREVIOUS materialized
     batch — materializing batches in stream order (the normal consumption
     pattern) reproduces per-window change-only emission exactly; skipping
-    windows folds their changes into the next one read.
+    windows folds their changes into the next one read, and reading an
+    old batch after a newer one diffs against the newer state without
+    regressing the workload's diff base.
     """
 
-    __slots__ = ("_workload", "_counts", "_total", "_vdict", "_items")
+    __slots__ = ("_workload", "_counts", "_total", "_vdict", "_seq", "_items")
 
-    def __init__(self, workload, counts, total, vdict):
+    def __init__(self, workload, counts, total, vdict, seq):
         self._workload = workload
         self._counts = counts
         self._total = total
         self._vdict = vdict
+        self._seq = seq
         self._items = None
 
-    def _materialize(self) -> list:
-        if self._items is not None:
-            return self._items
+    def _compute(self) -> list:
         w = self._workload
         counts, total = jax.device_get((self._counts, self._total))
         total = int(total)
@@ -232,25 +234,13 @@ class TriangleBatch:
         out = [(int(r), int(counts[c])) for r, c in zip(raw, changed)]
         if total != w._emit_prev_total:
             out.append((GLOBAL_KEY, total))
-        w._emit_prev = counts
-        w._emit_prev_total = total
-        self._items = out
+        if self._seq >= w._emit_seq_base:
+            # newest materialization wins; older batches read later must
+            # not clobber the diff base
+            w._emit_prev = counts
+            w._emit_prev_total = total
+            w._emit_seq_base = self._seq
         return out
-
-    def __iter__(self):
-        return iter(self._materialize())
-
-    def __len__(self) -> int:
-        return len(self._materialize())
-
-    def __getitem__(self, i):
-        return self._materialize()[i]
-
-    def __eq__(self, other):
-        return self._materialize() == other
-
-    def __repr__(self) -> str:
-        return repr(self._materialize())
 
 
 class ExactTriangleCount:
@@ -278,6 +268,8 @@ class ExactTriangleCount:
         self._n_raw = 0  # cumulative rank offset (padded block widths)
         self._emit_prev = None  # host counts at the last materialized batch
         self._emit_prev_total = 0
+        self._emit_seq = 0  # batches yielded (order watermark source)
+        self._emit_seq_base = 0  # seq of the last materialized batch
         # device carry: counts [Vcap] + PACKED sorted adjacency — columns
         # (vertex, nbr, rank) sorted by (vertex, nbr), both directions of
         # every canonical edge, +INT32_MAX vertex sentinel padding. O(E)
@@ -330,6 +322,8 @@ class ExactTriangleCount:
         self._total = jnp.int32(int(d["total"]))
         self._emit_prev = None if d["counts"] is None else np.asarray(d["counts"]).copy()
         self._emit_prev_total = int(d["total"])
+        self._emit_seq = 0
+        self._emit_seq_base = 0
         self._pv = self._pn = self._pr = None
         self._n_packed = 0
         self._have = SortedRunSet()
@@ -442,4 +436,6 @@ class ExactTriangleCount:
             )
         self._counts, delta = acc
         self._total = _accum_total(self._total, delta)
-        return TriangleBatch(self, self._counts, self._total, vdict)
+        self._emit_seq += 1
+        return TriangleBatch(self, self._counts, self._total, vdict,
+                             self._emit_seq)

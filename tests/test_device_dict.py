@@ -97,9 +97,13 @@ def test_stream_file_device_encode_guards(tmp_path):
             str(p), window=CountWindow(4), device_encode=True,
             vertex_dict=VertexDict(),
         )
-    with pytest.raises(ValueError, match="CountWindow"):
+    # EventTimeWindow is SUPPORTED on the device path since round 4
+    # (shared slot-run splitter); only other policies are rejected
+    from gelly_streaming_tpu.core.window import ProcessingTimeWindow
+
+    with pytest.raises(ValueError, match="CountWindow / EventTimeWindow"):
         datasets.stream_file(
-            str(p), window=EventTimeWindow(10, timestamp_fn=lambda e: e[2]),
+            str(p), window=ProcessingTimeWindow(seconds=1.0),
             device_encode=True,
         )
     # weighted streams carry their value column through the device path
