@@ -1173,6 +1173,39 @@ def main():
             "corpus": info["path"],
             "n_edges": info["n_edges"],
         }
+        # the TEXT-ingest e2e paths on the same CPU (round-3 verdict #2:
+        # the reference's native habitat) — each in a CPU-pinned
+        # subprocess, judged against baseline_compiled_text in this doc
+        import subprocess
+
+        path, bound, n_edges = info["path"], info["bound"], info["n_edges"]
+        for key, expr in [
+            ("e2e_text_identity",
+             f"bench.bench_cc_e2e({path!r}, "
+             f"lambda: datasets.IdentityDict({bound}), {n_edges})"),
+            ("e2e_dict_host",
+             "bench.bench_cc_e2e("
+             f"{path!r}, lambda: VertexDict(min_capacity={bound}), {n_edges})"),
+            ("e2e_dict_device",
+             f"bench.bench_cc_e2e_device_text({path!r}, {bound}, {n_edges})"),
+        ]:
+            log(f"cpu run: {key}...")
+            code = (
+                "import jax; jax.config.update('jax_platforms','cpu'); "
+                "import bench, json; "
+                "from gelly_streaming_tpu import datasets; "
+                "from gelly_streaming_tpu.core.vertexdict import VertexDict; "
+                f"print(json.dumps({expr}))"
+            )
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=900,
+            )
+            doc[key] = (
+                _parse_sub(out.stdout) if out.returncode == 0 else None
+            )
+            if out.returncode != 0:
+                log(out.stderr[-500:])
         with open("BENCH_CPU.json", "w") as f:
             json.dump(doc, f, indent=2)
         log(f"cpu run: {json.dumps(doc)}")
