@@ -166,7 +166,8 @@ def _id_bound(path: str, is_real: bool) -> int:
     return 1 << 23
 
 
-def bench_cc_e2e(path: str, vdict_factory, n_edges: int) -> dict:
+def bench_cc_e2e(path: str, vdict_factory, n_edges: int,
+                 window: int = WINDOW) -> dict:
     """file -> parse -> window -> vertex map -> device CC, warm + steady."""
     from gelly_streaming_tpu import datasets
     from gelly_streaming_tpu.core.window import CountWindow
@@ -174,7 +175,7 @@ def bench_cc_e2e(path: str, vdict_factory, n_edges: int) -> dict:
 
     def one_pass():
         stream = datasets.stream_file(
-            path, window=CountWindow(WINDOW), vertex_dict=vdict_factory(),
+            path, window=CountWindow(window), vertex_dict=vdict_factory(),
             prefetch_depth=2,
         )
         agg = ConnectedComponents()
@@ -1045,7 +1046,8 @@ def _headline() -> tuple:
     return info, s64, d64
 
 
-def run_northstar() -> dict:
+def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
+                  note: str = "", device_encode: bool = True) -> dict:
     """The BASELINE.md north-star shape (round-3 verdict #5): streaming CC
     at >=100M streamed edges — a scale-23 R-MAT surrogate ~2x the real
     LiveJournal (the real corpus is used instead when $GELLY_DATA provides
@@ -1070,25 +1072,45 @@ def run_northstar() -> dict:
     del chunks
     flink = bench_cc_flink_proxy(s64, d64)
     del s64, d64
+    if device_encode:
+        def run_e2e(w):
+            return bench_cc_e2e_device(binp, bound, n_edges, window=w)
+    else:
+        # identity mapping: the device-dict probe kernel is vectorized
+        # for TPU and pathologically slow on the XLA CPU backend at
+        # scale-23 capacity (>25 s/window measured); dense-id corpora
+        # need no compaction anyway
+        def run_e2e(w):
+            return bench_cc_e2e(
+                binp, lambda: datasets.IdentityDict(bound), n_edges, window=w
+            )
+
     log(f"northstar: {n_edges} edges; 1M-edge windows...")
-    e2e = bench_cc_e2e_device(binp, bound, n_edges)
+    e2e = run_e2e(WINDOW)
     assert e2e["components"] == base["components"], (
         e2e["components"], base["components"]
     )
     log("northstar: one 100M-edge window...")
-    mega = bench_cc_e2e_device(binp, bound, n_edges,
-                               window=max(n_edges, 100_000_000))
+    mega = run_e2e(max(n_edges, 100_000_000))
+    assert mega["components"] == base["components"], (
+        mega["components"], base["components"]
+    )
     out = {
+        "note": note or "default backend",
         "corpus": path,
         "n_edges": n_edges,
         "window_1m": e2e,
         "window_100m": mega,
         "baseline_compiled_binary": base,
         "flink_proxy": flink,
+        # BASELINE.md's north-star config IS the 100M-edge window; the
+        # 1M-window series is the latency-oriented configuration
         "vs_baseline": round(e2e["eps"] / base["eps"], 2),
         "vs_flink": round(e2e["eps"] / flink["eps"], 2),
+        "vs_baseline_100m": round(mega["eps"] / base["eps"], 2),
+        "vs_flink_100m": round(mega["eps"] / flink["eps"], 2),
     }
-    with open("BENCH_NORTHSTAR.json", "w") as f:
+    with open(artifact, "w") as f:
         json.dump(out, f, indent=2)
     log(f"northstar: {json.dumps(out)}")
     return out
@@ -1159,6 +1181,25 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        if "--northstar" in sys.argv:
+            out = run_northstar(
+                artifact="BENCH_NORTHSTAR_CPU.json",
+                note="XLA CPU backend vs compiled baselines on the same "
+                     "single-core host; no TPU tunnel involved; identity "
+                     "vertex mapping (the device-dict probe kernel is "
+                     "TPU-oriented and unrepresentative on CPU)",
+                device_encode=False,
+            )
+            print(json.dumps({
+                # the north-star config per BASELINE.md: 100M-edge window
+                "metric": "northstar_cc_100m_window_edges_per_sec",
+                "value": round(out["window_100m"]["eps"], 1),
+                "unit": "edges/sec",
+                "vs_baseline": out["vs_baseline_100m"],
+                "vs_flink": out["vs_flink_100m"],
+                "platform": "cpu-xla",
+            }))
+            return
         info, _s64, _d64 = _headline()
         headline = dict(info["headline"], platform="cpu-xla")
         doc = {
@@ -1220,10 +1261,12 @@ def main():
     if "--northstar" in sys.argv:
         out = run_northstar()
         print(json.dumps({
-            "metric": "northstar_cc_e2e_edges_per_sec",
-            "value": round(out["window_1m"]["eps"], 1),
+            # the north-star config per BASELINE.md: 100M-edge window
+            "metric": "northstar_cc_100m_window_edges_per_sec",
+            "value": round(out["window_100m"]["eps"], 1),
             "unit": "edges/sec",
-            "vs_baseline": out["vs_baseline"],
+            "vs_baseline": out["vs_baseline_100m"],
+            "vs_flink": out["vs_flink_100m"],
         }))
         return
 
