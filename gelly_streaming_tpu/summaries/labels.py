@@ -142,12 +142,18 @@ class Components:
             idx = np.nonzero(touched[: min(n, touched.shape[0])])[0]
             lab = labels[idx]
             raw = vdict.decode(idx)
-            order = np.argsort(lab, kind="stable")
-            _, starts = np.unique(lab[order], return_index=True)
+            # one (label, raw) lexsort: every component's member slice
+            # comes out ascending, so the root is its first element and
+            # no per-component python sort runs (a scale-23 giant
+            # component paid seconds in sorted() per materialization)
+            order = np.lexsort((raw, lab))
+            lab_s = lab[order]
+            raw_s = raw[order]
+            _, starts = np.unique(lab_s, return_index=True)
             self._components = {}
-            for members in np.split(raw[order], starts[1:]):
+            for members in np.split(raw_s, starts[1:]):
                 ms = members.tolist()
-                self._components[min(ms)] = sorted(ms)
+                self._components[ms[0]] = ms
         return self._components
 
     @staticmethod
