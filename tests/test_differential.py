@@ -1,0 +1,120 @@
+"""Randomized differential tests: device pipelines vs pure-python
+reference implementations over random streams, window sizes, and id
+spaces — the property-based complement to the golden-data suites
+(SURVEY.md §4; the reference's tests only pin fixed examples)."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow
+from gelly_streaming_tpu.library import ConnectedComponents
+
+
+def _rand_edges(rng, n, vmax, sparse_ids=False):
+    pairs = rng.integers(0, vmax, size=(n, 2))
+    k = 7 if sparse_ids else 1
+    return [(int(a) * k + 3, int(b) * k + 3, 0.0) for a, b in pairs]
+
+
+def _py_components(edges):
+    """Reference semantics: plain union-find over raw ids."""
+    parent = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for s, d, _ in edges:
+        rs, rd = find(s), find(d)
+        if rs != rd:
+            parent[rd] = rs
+    comps = {}
+    for v in parent:
+        comps.setdefault(find(v), set()).add(v)
+    return sorted(frozenset(m) for m in comps.values())
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_cc_matches_python_union_find(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(20, 300))
+    vmax = int(rng.integers(5, 60))
+    window = int(rng.integers(1, n + 1))
+    edges = _rand_edges(rng, n, vmax, sparse_ids=bool(seed % 2))
+    stream = SimpleEdgeStream(edges, window=CountWindow(window))
+    last = None
+    for last in stream.aggregate(ConnectedComponents()):
+        pass
+    got = sorted(last.component_sets())
+    assert got == _py_components(edges), (seed, n, vmax, window)
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_degree_stream_matches_python_counts(seed):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(30, 200))
+    vmax = int(rng.integers(5, 40))
+    window = int(rng.integers(1, 20))
+    edges = _rand_edges(rng, n, vmax)
+    stream = SimpleEdgeStream(edges, window=CountWindow(window))
+    final = {}
+    for v, deg in stream.get_degrees():
+        final[v] = deg  # change-only: last value per vertex is final
+    ref = {}
+    for s, d, _ in edges:
+        ref[s] = ref.get(s, 0) + 1
+        ref[d] = ref.get(d, 0) + 1
+    assert final == ref, (seed, n, vmax, window)
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_exact_triangles_matches_brute_force(seed):
+    from itertools import combinations
+
+    from gelly_streaming_tpu.library.triangles import ExactTriangleCount
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(50, 250))
+    vmax = int(rng.integers(8, 30))
+    window = int(rng.integers(1, 40))
+    edges = _rand_edges(rng, n, vmax)
+    etc = ExactTriangleCount()
+    for _ in etc.run(SimpleEdgeStream(edges, window=CountWindow(window))):
+        pass
+    total = int(etc._total)
+    eset = {(min(a, b), max(a, b)) for a, b, _ in edges if a != b}
+    adj = {}
+    for a, b in eset:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    brute = sum(
+        1
+        for x, y, z in combinations(sorted(adj), 3)
+        if y in adj[x] and z in adj[x] and z in adj[y]
+    )
+    assert total == brute, (seed, n, vmax, window)
+
+
+@pytest.mark.parametrize("seed", [9])
+def test_cc_invariant_under_stream_transforms(seed):
+    """distinct() and undirected() must not change the final components
+    (they only drop duplicates / mirror edges)."""
+    rng = np.random.default_rng(seed)
+    edges = _rand_edges(rng, 150, 25)
+    edges = edges + edges[:40]  # duplicates
+
+    def final(stream):
+        last = None
+        for last in stream.aggregate(ConnectedComponents()):
+            pass
+        return sorted(last.component_sets())
+
+    base = final(SimpleEdgeStream(edges, window=CountWindow(16)))
+    dis = final(SimpleEdgeStream(edges, window=CountWindow(16)).distinct())
+    und = final(SimpleEdgeStream(edges, window=CountWindow(16)).undirected())
+    assert dis == base
+    assert und == base
