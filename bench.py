@@ -49,10 +49,12 @@ def log(msg: str) -> None:
 # Per-try subprocess timeouts + sleeps before each try. First jit through
 # the tunnel can cost 20-40 s, so try 1 gets 180 s; a hard-down tunnel
 # hangs every try to its full timeout, so the worst-case stall before the
-# stale fallback fires is sum(both) = ~7.5 min — keep that bounded or the
-# driver's own timeout kills the process before the fallback can emit.
-PROBE_TIMEOUTS_S = (180, 90, 90)
-PROBE_BACKOFFS = (0, 30, 60)
+# stale fallback fires is sum(both) = 5 min — keep that bounded or the
+# driver's own timeout kills the process before the fallback can emit
+# (a tunnel that fails tries 1-2 over 5 minutes is hard-down, not flaky:
+# every observed outage lasted hours).
+PROBE_TIMEOUTS_S = (180, 90)
+PROBE_BACKOFFS = (0, 30)
 
 _PROBE_SRC = (
     "import jax, jax.numpy as jnp; "
