@@ -98,6 +98,7 @@ class SummaryAggregation(abc.ABC):
         self.mesh = mesh
         self._summary = None
         self._vcap = 0
+        self._sync_ref = None  # last dispatched window state (sync target)
 
     def step_cache_key(self):
         """Hashable identity of the compiled window step (see class doc)."""
@@ -237,11 +238,23 @@ class SummaryAggregation(abc.ABC):
                     self.initial_state(0), raw_s, raw_d, val, None
                 )
                 self._summary = self.combine(self._summary, partial)
+            self._sync_ref = self._summary
             yield self.transform(self._summary, vdict)
             if self.transient_state:
                 self._summary = (
                     self.initial_state(self._vcap) if self.device else self.initial_state(0)
                 )
+
+    def sync(self) -> None:
+        """Block until the carried summary's device work completes — the
+        end-of-stream barrier. The aggregate loop only DISPATCHES async
+        device steps; anyone timing throughput (bench.py does) must call
+        this inside the timed region, or they measure an enqueue rate.
+        Per-window emissions stay async/lazy either way. Also blocks the
+        last DISPATCHED window state: with ``transient_state`` the run
+        loop resets ``_summary`` to a fresh initial state after each
+        yield, which would otherwise make this a silent no-op barrier."""
+        jax.block_until_ready((self._summary, self._sync_ref))
 
     # ------------------------------------------------------------------ #
     # Checkpoint surface (ListCheckpointed analog)

@@ -243,6 +243,11 @@ class IncrementalPageRank:
             self._n_edges += n_new
             yield PageRankEmission(w, n_seen, iters, delta)
 
+    def sync(self) -> None:
+        """Block until the carried (edges, ranks) device state is complete
+        — the end-of-stream barrier for throughput timing."""
+        jax.block_until_ready(self._carry)
+
     # ------------------------------------------------------------------ #
     @property
     def _ranks(self):

@@ -539,6 +539,15 @@ class DeviceSpanner:
                 self._sq = jnp.asarray(sq)
                 self._cnt = jnp.int32(len(su))
 
+    def sync(self) -> None:
+        """Block until the carried device spanner state is complete (the
+        end-of-stream barrier for throughput timing), whichever carry —
+        k=2 packed adjacency or general-k edge columns — is live."""
+        jax.block_until_ready(
+            (self._pv, self._pn, self._pr) if self.k == 2
+            else (self._sp, self._sq, self._cnt)
+        )
+
     def state_dict(self) -> dict:
         """Checkpoint surface (``aggregate/checkpoint.py:save_workload``).
         One device download at checkpoint time (a natural sync point)."""
