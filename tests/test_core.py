@@ -109,3 +109,47 @@ def test_event_time_array_path_requires_timestamp_fn():
     w2 = Windower(EventTimeWindow(10, timestamp_fn=lambda e: e[2]))
     with pytest.raises(ValueError, match=r"\[N, 2\] or \[N, 3\]"):
         list(w2.blocks_with_info(np.zeros((4, 4))))
+
+
+def test_sync_barriers_and_lazy_range_contract(sample_edges):
+    """Public end-of-stream barriers (round-4 measurement-integrity fix)
+    exist and are safe on every flavor, including transient_state where
+    the run loop resets the summary after each yield; LazyCountRange
+    compares like a builtin range (False on non-iterables, hashable)."""
+    from gelly_streaming_tpu.core.emission import LazyCountRange
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+    from gelly_streaming_tpu.library.pagerank import IncrementalPageRank
+    from gelly_streaming_tpu.library.spanner import DeviceSpanner
+
+    agg = ConnectedComponents()
+    for _ in SimpleEdgeStream(
+        sample_edges, window=CountWindow(3)
+    ).aggregate(agg):
+        pass
+    agg.sync()
+
+    t_agg = ConnectedComponents(transient_state=True)
+    for _ in SimpleEdgeStream(
+        sample_edges, window=CountWindow(3)
+    ).aggregate(t_agg):
+        pass
+    t_agg.sync()  # must barrier the LAST DISPATCHED state, not the reset
+    assert t_agg._sync_ref is not None
+
+    for k in (2, 3):  # both carries: packed adjacency and edge columns
+        sp = DeviceSpanner(k=k)
+        for _ in sp.run(SimpleEdgeStream(sample_edges, window=CountWindow(3))):
+            pass
+        sp.sync()
+
+    pr = IncrementalPageRank(max_iter=5)
+    for _ in pr.run(SimpleEdgeStream(sample_edges, window=CountWindow(3))):
+        pass
+    pr.sync()
+
+    r = LazyCountRange(0, 3)
+    assert r == range(1, 4) and r == [1, 2, 3]
+    assert (r == 5) is False and (r != 5) is True  # no TypeError
+    assert len({r, LazyCountRange(0, 3)}) == 1  # hashable, value-equal
