@@ -794,9 +794,11 @@ ROOFLINE_REPS = 8  # number of DISTINCT input variants per roofline kernel
 
 def bench_spanner(
     n_vertices: int = 1 << 18, window: int = 1 << 18, n_win: int = 4,
+    k: int = 2,
 ) -> dict:
-    """Streaming k=2 spanner end-to-end: stream -> per-window class-
-    bounded common-neighbor rejection on the packed device adjacency."""
+    """Streaming k-spanner end-to-end. k=2: per-window class-bounded
+    common-neighbor rejection on the packed device adjacency; k>=3: the
+    bitplane-packed frontier BFS path."""
     from gelly_streaming_tpu.core.stream import SimpleEdgeStream
     from gelly_streaming_tpu.core.window import CountWindow
     from gelly_streaming_tpu.datasets import IdentityDict
@@ -809,7 +811,7 @@ def bench_spanner(
             (src, dst), window=CountWindow(window),
             vertex_dict=IdentityDict(n_vertices),
         )
-        sp = DeviceSpanner(k=2, expected_edges=window * n_win)
+        sp = DeviceSpanner(k=k, expected_edges=window * n_win)
         t0 = time.perf_counter()
         for _ in sp.run(stream):
             pass
@@ -1369,6 +1371,9 @@ def main():
              "import bench, json; print(json.dumps(bench.bench_exact_triangles()))"),
             ("spanner_eps",
              "import bench, json; print(json.dumps(bench.bench_spanner()))"),
+            ("spanner_k3_eps",
+             "import bench, json; "
+             "print(json.dumps(bench.bench_spanner(k=3)))"),
             ("pagerank_eps",
              "import bench, json; print(json.dumps(bench.bench_pagerank()))"),
             ("graphsage_eps",
