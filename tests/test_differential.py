@@ -118,3 +118,46 @@ def test_cc_invariant_under_stream_transforms(seed):
     und = final(SimpleEdgeStream(edges, window=CountWindow(16)).undirected())
     assert dis == base
     assert und == base
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_bipartiteness_matches_python_two_coloring(seed):
+    from gelly_streaming_tpu.library import BipartitenessCheck
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(20, 150))
+    vmax = int(rng.integers(4, 30))
+    window = int(rng.integers(1, 25))
+    if seed % 2:
+        # force bipartite: edges only across an even/odd split
+        pairs = rng.integers(0, vmax, size=(n, 2))
+        edges = [(int(a) * 2, int(b) * 2 + 1, 0.0) for a, b in pairs]
+    else:
+        edges = _rand_edges(rng, n, vmax)
+
+    def py_bipartite(edges):
+        color, adj = {}, {}
+        for s, d, _ in edges:
+            adj.setdefault(s, []).append(d)
+            adj.setdefault(d, []).append(s)
+        for start in adj:
+            if start in color:
+                continue
+            color[start] = 0
+            stack = [start]
+            while stack:
+                x = stack.pop()
+                for y in adj[x]:
+                    if y not in color:
+                        color[y] = color[x] ^ 1
+                        stack.append(y)
+                    elif color[y] == color[x] and y != x:
+                        return False
+        # self-loops are odd cycles
+        return all(s != d for s, d, _ in edges)
+
+    stream = SimpleEdgeStream(edges, window=CountWindow(window))
+    last = None
+    for last in stream.aggregate(BipartitenessCheck()):
+        pass
+    assert last.success == py_bipartite(edges), (seed, n, vmax, window)
