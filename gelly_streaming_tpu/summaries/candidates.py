@@ -90,6 +90,12 @@ class Candidates:
         self.success = success
         self.components = components
 
+    def __bool__(self) -> bool:
+        """Truthiness == the bipartiteness verdict (``success``): a
+        failed check printing ``(false,{})`` must not read as truthy
+        through Python's default object truthiness."""
+        return self.success
+
     @staticmethod
     def from_cover(state: Dict[str, jax.Array], vcap: int, vdict) -> "Candidates":
         labels = np.asarray(state["labels"])
