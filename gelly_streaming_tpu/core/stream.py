@@ -466,14 +466,19 @@ class SimpleEdgeStream(GraphStream):
                     yield RecordColumnBatch(lambda r: Vertex(int(r), None), raw)
                     continue
                 # device path: carry the seen mask on device from the host
-                # watermark so far; stays on device for the rest of the run
-                if seen_dev is None or seen_dev.shape[0] < b.n_vertices:
+                # watermark so far; stays on device for the rest of the run.
+                # Capacity growth happens ON device (concat with zeros) —
+                # np.asarray(seen_dev) here would be a blocking O(V) D2H in
+                # the producer loop at every bucket growth (round-4 advisor)
+                if seen_dev is None:
                     base = np.zeros(b.n_vertices, bool)
-                    if seen_dev is None:
-                        base[: seen.size] = seen
-                    else:
-                        base[: seen_dev.shape[0]] = np.asarray(seen_dev)
+                    base[: seen.size] = seen
                     seen_dev = jnp.asarray(base)
+                elif seen_dev.shape[0] < b.n_vertices:
+                    seen_dev = jnp.concatenate([
+                        seen_dev,
+                        jnp.zeros(b.n_vertices - seen_dev.shape[0], bool),
+                    ])
                 seen_dev, packed = _first_seen_update(
                     seen_dev, b.src, b.dst, b.mask
                 )

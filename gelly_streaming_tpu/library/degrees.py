@@ -83,6 +83,13 @@ class DegreeDistribution:
         # cached columns), so the running sum upper-bounds the max degree;
         # materializing any emission tightens it to the downloaded truth.
         self._max_deg_ub = 0
+        # monotone sum of all shadow increments ever applied (NEVER
+        # tightened): lazy batches record it at creation, so a stale
+        # read can reconstruct "increments since this batch" exactly —
+        # (shadow_now - batch_ub) is NOT that quantity once a newer read
+        # tightened and the shadow regrew (round-5 review repro)
+        self._inc_total = 0
+        self._lineage = 0  # bumped on restore; stale-lineage batches skip
         self._events_total = 0
         self._emit_base = 0  # event watermark of the last materialized batch
         self._emit_prev = None  # host hist at the last materialized batch
@@ -110,9 +117,9 @@ class DegreeDistribution:
                 # max per-vertex event count this window bounds how far
                 # any degree (hence the histogram support) can rise
                 both = np.concatenate([s_h, d_h])
-                self._max_deg_ub += int(
-                    np.unique(both, return_counts=True)[1].max()
-                )
+                inc = int(np.unique(both, return_counts=True)[1].max())
+                self._max_deg_ub += inc
+                self._inc_total += inc
             if self._deg is None:
                 self._deg = jnp.zeros(vcap, jnp.int32)
             elif vcap > self._deg.shape[0]:
@@ -141,7 +148,7 @@ class DegreeDistribution:
             )
             self._events_total += n_events
             yield HistogramBatch(
-                self, self._hist, self._events_total, self._max_deg_ub
+                self, self._hist, self._events_total, self._inc_total
             )
 
     def state_dict(self) -> dict:
@@ -166,6 +173,10 @@ class DegreeDistribution:
         self._deg = None if d["deg"] is None else jnp.asarray(d["deg"])
         self._hist = None if d["hist"] is None else jnp.asarray(d["hist"])
         self._max_deg_ub = int(d["max_deg"])
+        # fresh lineage: batches minted before the restore hold a counter
+        # from the old lineage and must not pass the _compute guard
+        self._inc_total = 0
+        self._lineage += 1
         self._events_total = 0
         self._emit_base = 0
         self._emit_prev = None if d["hist"] is None else np.asarray(d["hist"]).copy()
@@ -204,13 +215,14 @@ class HistogramBatch(LazyListBatch):
     emission exactly; an out-of-order read diffs against whatever was
     materialized last WITHOUT regressing the workload's watermarks."""
 
-    __slots__ = ("_workload", "_hist", "_ev", "_ub", "_items")
+    __slots__ = ("_workload", "_hist", "_ev", "_inc", "_lin", "_items")
 
-    def __init__(self, workload, hist, ev, ub):
+    def __init__(self, workload, hist, ev, inc):
         self._workload = workload
         self._hist = hist
         self._ev = ev
-        self._ub = ub
+        self._inc = inc  # workload._inc_total at batch creation
+        self._lin = workload._lineage
         self._items = None
 
     def _compute(self) -> list:
@@ -229,14 +241,21 @@ class HistogramBatch(LazyListBatch):
             # not clobber the diff base or the watermark
             w._emit_prev = h
             w._emit_base = self._ev
-        # capacity shadow: current ub <= true max AT THIS BATCH plus the
-        # increments added since — a valid bound under ANY read order, so
-        # take the min
-        nz = np.nonzero(h)[0]
-        true_max = int(nz[-1]) if len(nz) else 0
-        w._max_deg_ub = min(
-            w._max_deg_ub, true_max + (w._max_deg_ub - self._ub)
-        )
+        # capacity shadow: true max NOW <= true max AT THIS BATCH plus
+        # the increments applied since. "Increments since" is measured on
+        # the MONOTONE counter (w._inc_total - self._inc), never on the
+        # shadow itself — (shadow - batch_ub) understates the increments
+        # once a newer read tightened the shadow and it regrew, which
+        # dragged the shadow below the true max (round-5 review repro:
+        # degree-18 vertex clipped into bin 15). The monotone form is a
+        # sound bound under ANY read order; the guard only skips batches
+        # from a pre-restore lineage, whose counter is incomparable.
+        if self._lin == w._lineage and self._inc <= w._inc_total:
+            nz = np.nonzero(h)[0]
+            true_max = int(nz[-1]) if len(nz) else 0
+            w._max_deg_ub = min(
+                w._max_deg_ub, true_max + (w._inc_total - self._inc)
+            )
         return items
 
 

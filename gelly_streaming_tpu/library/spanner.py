@@ -227,15 +227,31 @@ class SpannerEdges:
     """One window's spanner edge set, LAZY: device references are held and
     the download happens on first read (iteration / membership / len /
     equality). Unconsumed snapshots cost zero device→host traffic, so the
-    device pipeline never stalls on the tunnel."""
+    device pipeline never stalls on the tunnel.
 
-    __slots__ = ("_kind", "_arrays", "_vdict", "_set")
+    Materializing also feeds the revealed TRUE accepted count back into
+    the workload's capacity bound (round-4 advisor finding): under the
+    normal run-loop + lazy-read consumption pattern (no checkpoint, so
+    ``_host_columns``'s reconcile never fires) the carried device columns
+    would otherwise grow with the stream's DISTINCT edges rather than the
+    spanner size. The feedback bound is true-count-at-snapshot plus the
+    entries offered SINCE, measured on the workload's monotone offer
+    counter — sound under any read order (measuring "since" on the
+    tightenable ``_cnt_ub`` itself is not: it understates the delta once
+    a newer read reconciled and the bound regrew)."""
 
-    def __init__(self, kind, arrays, vdict):
+    __slots__ = (
+        "_kind", "_arrays", "_vdict", "_set", "_workload", "_add", "_lin"
+    )
+
+    def __init__(self, kind, arrays, vdict, workload=None):
         self._kind = kind
         self._arrays = arrays
         self._vdict = vdict
         self._set = None
+        self._workload = workload
+        self._add = 0 if workload is None else workload._add_total
+        self._lin = 0 if workload is None else workload._lineage
 
     def _materialize(self) -> Set[Tuple[int, int]]:
         if self._set is not None:
@@ -250,6 +266,13 @@ class SpannerEdges:
         else:
             sp, sq, cnt = jax.device_get(self._arrays)
             cu, cv = sp[: int(cnt)], sq[: int(cnt)]
+        w = self._workload
+        if w is not None and self._lin == w._lineage:
+            true_entries = 2 * len(cu) if self._kind == "k2" else len(cu)
+            w._cnt_ub = min(
+                w._cnt_ub, true_entries + (w._add_total - self._add)
+            )
+        self._workload = None  # feedback fired; don't pin the workload
         ru = self._vdict.decode(cu)
         rv = self._vdict.decode(cv)
         self._set = {
@@ -321,6 +344,13 @@ class DeviceSpanner:
         self._seen = SortedRunSet()
         self._deg = np.zeros(0, np.int64)
         self._cnt_ub = 0  # upper bound on carried device entries
+        # monotone sum of candidate entries ever offered to the device
+        # (NEVER tightened): snapshots record it so a stale lazy read can
+        # reconstruct "entries offered since this snapshot" exactly —
+        # (cnt_ub_now - snapshot_ub) understates that once a newer read
+        # reconciled and the bound regrew (round-5 review)
+        self._add_total = 0
+        self._lineage = 0  # bumped on restore; stale-lineage reads skip
         # k=2 packed-adjacency carry (device)
         self._pv = None
         self._pn = None
@@ -381,6 +411,7 @@ class DeviceSpanner:
         class-bounded common-neighbor tests on the packed spanner
         adjacency, then a masked on-device accept-merge."""
         self._cnt_ub += 2 * len(u)
+        self._add_total += 2 * len(u)
         self._grow_packed(max(self._cnt_ub, 2 * self.expected_edges, 1))
         row_ptr = _span_row_ptr(self._pv, vcap)
         n_q = len(u)
@@ -413,6 +444,7 @@ class DeviceSpanner:
         window-start spanner (batches cannot reject each other — the same
         windowing relaxation as k=2), then on-device appends."""
         self._cnt_ub += len(u)
+        self._add_total += len(u)
         self._sp, self._sq = _grow_cols(
             self._sp, self._sq, max(self._cnt_ub, self.expected_edges)
         )
@@ -440,9 +472,9 @@ class DeviceSpanner:
     def _snapshot(self) -> SpannerEdges:
         if self.k == 2:
             arrays = None if self._pv is None else (self._pv, self._pn)
-            return SpannerEdges("k2", arrays, self._vdict)
+            return SpannerEdges("k2", arrays, self._vdict, self)
         arrays = None if self._sp is None else (self._sp, self._sq, self._cnt)
-        return SpannerEdges("gen", arrays, self._vdict)
+        return SpannerEdges("gen", arrays, self._vdict, self)
 
     def _grow_packed(self, need: int) -> None:
         self._pv, self._pn, self._pr = grow_packed_columns(
@@ -521,6 +553,7 @@ class DeviceSpanner:
             np.add.at(self._deg, sv, 1)
         if self.k == 2:
             self._cnt_ub = 2 * len(su)
+            self._add_total = 2 * len(su)
             if len(su):
                 from ..ops.triangles import build_sorted_directed
 
@@ -530,6 +563,7 @@ class DeviceSpanner:
                 self._pr = jnp.asarray(prp)
         else:
             self._cnt_ub = len(su)
+            self._add_total = len(su)
             if len(su):
                 self._sp, self._sq = _grow_cols(None, None, len(su))
                 sp = np.zeros(self._sp.shape[0], np.int32)
@@ -561,6 +595,8 @@ class DeviceSpanner:
         self._seen = SortedRunSet()
         self._deg = np.zeros(0, np.int64)
         self._cnt_ub = 0
+        self._add_total = 0
+        self._lineage += 1  # snapshots minted pre-restore must not feed back
         self._pv = self._pn = self._pr = None
         self._sp = self._sq = None
         self._cnt = jnp.int32(0)

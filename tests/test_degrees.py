@@ -158,3 +158,67 @@ def test_out_of_order_batch_materialization_safe():
     for b in ref.run(events):
         list(b)
     assert dd.histogram() == ref.histogram()
+
+
+def test_windows_after_out_of_order_read_stay_correct():
+    """Round-4 advisor finding: an old batch materialized AFTER a newer
+    one already tightened the capacity shadow must not drag the shadow
+    below the true max degree — otherwise every later window computes
+    hcap too small and silently folds high-degree counts into the top
+    bin. Build a stream whose upper bound grows much faster than its
+    true degrees (same pair toggled), trigger the out-of-order read,
+    then RAISE real degrees in a second phase and compare against an
+    in-order reference over the concatenated stream."""
+    from gelly_streaming_tpu.library.degrees import DegreeDistribution
+
+    # phase 1: one pair toggled — per-window ub grows by ~6, true deg <= 1
+    phase1 = [(0, 1, "+" if i % 2 == 0 else "-") for i in range(24)]
+    dd = DegreeDistribution(CountWindow(6))
+    batches = list(dd.run(phase1))
+    list(batches[-1])  # newest first: shadow tightens to the true max (~1)
+    list(batches[0])   # stale batch: its recorded ub exceeds the shadow
+    assert dd._max_deg_ub >= 0
+    # the shadow must still bound the true max degree (here <= 1)
+    hist_now = dd.histogram()
+    true_max_now = max((d for d, c in hist_now.items() if c), default=0)
+    assert dd._max_deg_ub >= true_max_now
+    # phase 2: star around vertex 0 pushes real degrees to 12
+    phase2 = [(0, 100 + i, "+") for i in range(12)]
+    for b in dd.run(phase2):
+        list(b)
+    ref = DegreeDistribution(CountWindow(6))
+    for b in ref.run(phase1 + phase2):
+        list(b)
+    assert dd.histogram() == ref.histogram()
+    assert dd.histogram()[12] == 1  # degree 12 not clipped into a low bin
+
+
+def test_stale_read_after_shadow_regrowth_stays_sound():
+    """The harder ordering (round-5 review repro): tighten the shadow via
+    a newest read, REGROW it past a stale batch's recorded bound with new
+    real degrees, then materialize the stale batch. Measuring "increments
+    since the stale batch" on the shadow itself understates the delta
+    here and dragged the shadow to 6 < true max 12, clipping a later
+    degree-18 vertex into bin 15; the monotone offer counter keeps the
+    bound sound."""
+    from gelly_streaming_tpu.library.degrees import DegreeDistribution
+
+    phase1 = [(0, 1, "+" if i % 2 == 0 else "-") for i in range(12)]
+    dd = DegreeDistribution(CountWindow(6))
+    b1 = list(dd.run(phase1))          # ub inflates ~12, true max ~1
+    list(b1[-1])                        # newest read: shadow tightens hard
+    phase2 = [(0, 100 + i, "+") for i in range(12)]
+    for b in dd.run(phase2):            # shadow regrows with REAL degree 12
+        list(b)
+    list(b1[0])                         # stale batch: must not drag below 12
+    hist_now = dd.histogram()
+    true_max = max((d for d, c in hist_now.items() if c), default=0)
+    assert dd._max_deg_ub >= true_max
+    phase3 = [(0, 200 + i, "+") for i in range(6)]  # degree 12 -> 18
+    for b in dd.run(phase3):
+        list(b)
+    ref = DegreeDistribution(CountWindow(6))
+    for b in ref.run(phase1 + phase2 + phase3):
+        list(b)
+    assert dd.histogram() == ref.histogram()
+    assert dd.histogram()[18] == 1  # not clipped into a lower bin
