@@ -213,6 +213,19 @@ class SummaryAggregation(abc.ABC):
     def _is_tree(self) -> bool:
         return False
 
+    def _device_block(self, block: EdgeBlock, mesh) -> None:
+        """Grow + fold one block into the carried summary (the device
+        branch of :meth:`run`, extracted so subclasses with a custom run
+        loop — e.g. the forest-carry CC — can fall back to it)."""
+        vcap = block.n_vertices
+        if self._summary is None:
+            self._vcap = vcap
+            self._summary = self.initial_state(vcap)
+        elif vcap > self._vcap:
+            self._summary = self.grow_state(self._summary, self._vcap, vcap)
+            self._vcap = vcap
+        self._summary = self._window_step(self._summary, block, vcap, mesh)
+
     def run(self, stream) -> Iterator[Any]:
         """Drive the aggregation over the stream's windows
         (``SummaryAggregation.run`` / ``SummaryBulkAggregation.java:68-90``)."""
@@ -220,14 +233,7 @@ class SummaryAggregation(abc.ABC):
         vdict = stream.vertex_dict
         for block in stream.blocks():
             if self.device:
-                vcap = block.n_vertices
-                if self._summary is None:
-                    self._vcap = vcap
-                    self._summary = self.initial_state(vcap)
-                elif vcap > self._vcap:
-                    self._summary = self.grow_state(self._summary, self._vcap, vcap)
-                    self._vcap = vcap
-                self._summary = self._window_step(self._summary, block, vcap, mesh)
+                self._device_block(block, mesh)
             else:
                 src, dst, val = block.to_host()
                 raw_s = vdict.decode(src)

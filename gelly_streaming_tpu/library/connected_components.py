@@ -2,13 +2,38 @@
 
 TPU-native re-design of ``library/ConnectedComponents.java:41-126``: the
 reference folds each edge into a per-partition ``DisjointSet`` (``UpdateCC``)
-and merges partials smaller-into-larger (``CombineCC``). Here the summary is
-a dense label table (``summaries/labels.py``): the per-shard update is a
-min-label fixpoint over the shard's edge block, the cross-shard combine is a
-label merge riding the engine's collectives, and the carried Merger state is
-the running global label table. Emission converts labels to a
+and merges partials smaller-into-larger (``CombineCC``).
+
+Three carries implement that contract here (``carry=`` constructor
+option, default ``"auto"``):
+
+- **Forest carry** (auto default with an accelerator attached): a pointer
+  forest ``canon[vcap]`` updated by window-local kernels — host-computed
+  touched set, root chase, T-sized local fixpoint, one masked scatter
+  (``summaries/forest.py``). Per-window cost scales with the WINDOW, the
+  reference's cost shape (``SummaryBulkAggregation.java:76-80``), not
+  with the vertex capacity; chains canonicalize lazily at emission or
+  checkpoint. This is the round-5 answer to the measured V-bound of the
+  dense path (BENCH_CPU r4: 0.45x the compiled baseline at 1M windows).
+- **Host carry** (auto default on a CPU backend): the native incremental
+  union-find (``native/ingest.cpp: cuf_*``) folds each window beside the
+  parser and the device keeps a pointer-forest MIRROR updated by one
+  O(touched) scatter. Union-find is control flow, not math — the P6
+  "centralized sequential" placement (SURVEY.md §2.5), same rationale as
+  the matching/spanner host paths. Emission/checkpoint are identical to
+  the forest carry (the mirror IS a forest).
+- **Dense labels** (``summaries/labels.py``): full-table min-label
+  fixpoint + pointer-graph combine. Used under a sharded mesh (the
+  shard_map window fold + collective combine) and for device-transformed
+  streams whose compact columns never exist on host (the windowed
+  carries' touched set is host-computed). A stream can downgrade to
+  dense mid-run (either carry canonicalizes to flat labels); it never
+  needs to upgrade back.
+
+Emission converts either carry to a
 :class:`~gelly_streaming_tpu.summaries.labels.Components` view (the
-``DisjointSet`` stand-in).
+``DisjointSet`` stand-in); checkpoints always store canonical flat labels
++ touched, so the two carries share one checkpoint format.
 
 Usage parity with the reference::
 
@@ -18,7 +43,22 @@ Usage parity with the reference::
 
 from __future__ import annotations
 
+from typing import Any, Iterator, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
 from ..aggregate.summary import SummaryBulkAggregation, SummaryTreeReduce
+from ..summaries.forest import (
+    TouchLog,
+    WindowPrep,
+    forest_window,
+    grow_forest,
+    init_forest,
+    mirror_update,
+    resolve_flat,
+    resolve_flat_host,
+)
 from ..summaries.labels import (
     Components,
     cc_fold,
@@ -28,7 +68,48 @@ from ..summaries.labels import (
 )
 
 
+def _auto_carry() -> str:
+    """Pick the windowed-ingest carry for this process.
+
+    ``host`` — the native incremental union-find beside the parser with a
+    device pointer-forest mirror (one O(touched) scatter per window).
+    Union-find is the one graph kernel that is control flow, not math: on
+    a CPU backend the XLA path would re-do scalar pointer chasing as
+    vector passes, so the P6 "centralized sequential" placement
+    (SURVEY.md §2.5, same rationale as matching/spanner host paths) wins
+    outright — measured 2.1x the compiled hash-map baseline where the
+    dense device path was 0.45x.
+
+    ``forest`` — the window-local device kernels; the default whenever an
+    accelerator is attached (its HBM absorbs the table passes, and host
+    cycles belong to the parser).
+    """
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return "forest"
+    try:
+        from .. import native
+
+        native.CompactUnionFind()
+        return "host"
+    except Exception:
+        return "forest"
+
+
 class _CCMixin:
+    def __init__(self, *args, carry: str = "auto", **kwargs):
+        super().__init__(*args, **kwargs)
+        if carry not in ("auto", "forest", "host", "dense"):
+            raise ValueError(f"carry must be auto/forest/host/dense, got {carry!r}")
+        self.carry = carry
+        self._cc_mode = None  # None | "forest" | "host" | "dense"
+        self._canon = None    # device pointer forest (forest/host carries)
+        self._log = None      # host TouchLog
+        self._uf = None       # native CompactUnionFind (host carry)
+        self._prep = None     # WindowPrep scratch (forest carry)
+
+    # ---- dense-engine hooks (mesh / device-transformed fallback) ---- #
     def initial_state(self, vcap: int):
         return init_labels(max(1, vcap))
 
@@ -44,6 +125,130 @@ class _CCMixin:
     def transform(self, state, vdict) -> Components:
         return Components.from_labels(state, vdict)
 
+    # ---- windowed-carry run loop ---- #
+    def run(self, stream) -> Iterator[Components]:
+        mesh = self._resolve_mesh(stream)
+        vdict = stream.vertex_dict
+        for block in stream.blocks():
+            cache = getattr(block, "_host_cache", None)
+            if (
+                mesh is not None
+                or cache is None
+                or self.carry == "dense"
+                or self._cc_mode == "dense"
+            ):
+                if self._cc_mode in ("forest", "host"):
+                    self._to_dense()
+                self._cc_mode = "dense"
+                self._device_block(block, mesh)
+                self._sync_ref = self._summary
+                yield self.transform(self._summary, vdict)
+            else:
+                if self._cc_mode is None:
+                    self._cc_mode = (
+                        self.carry if self.carry != "auto" else _auto_carry()
+                    )
+                self._ensure_windowed(block.n_vertices)
+                src_h, dst_h = cache[0], cache[1]
+                if self._cc_mode == "host":
+                    tids, roots, changed, chroots = self._uf.fold(
+                        src_h, dst_h, self._vcap
+                    )
+                    self._canon = mirror_update(
+                        self._canon,
+                        np.concatenate([tids, changed]),
+                        np.concatenate([roots, chroots]),
+                        self._vcap,
+                    )
+                else:
+                    self._canon, tids = forest_window(
+                        self._canon, src_h, dst_h, self._vcap, self._prep
+                    )
+                self._log.add(tids)
+                # sync()/bench barriers block on _summary; keep it aimed
+                # at the live carry
+                self._summary = {"labels": self._canon}
+                self._sync_ref = self._canon
+                yield Components.from_forest(self._canon, self._log, vdict)
+            if self.transient_state:
+                self._reset_transient()
+
+    def _ensure_windowed(self, vcap: int) -> None:
+        if self._canon is None:
+            if self._summary is not None and "touched" in self._summary:
+                # restored (or converted) dense state: flat labels ARE a
+                # valid forest; rebuild the host touched log from the mask
+                self._canon = self._summary["labels"]
+                self._log = TouchLog.from_touched_bool(
+                    np.asarray(self._summary["touched"])
+                )
+                self._vcap = self._canon.shape[0]
+            else:
+                self._vcap = vcap
+                self._canon = init_forest(vcap)
+                self._log = TouchLog(vcap)
+            if self._cc_mode == "host":
+                from .. import native
+
+                self._uf = native.CompactUnionFind()
+                self._uf.load(np.asarray(self._canon))
+            else:
+                self._prep = WindowPrep()
+        if vcap > self._vcap:
+            self._canon = grow_forest(self._canon, vcap)
+            self._vcap = vcap
+        self._log.grow(self._vcap)
+
+    def _to_dense(self) -> None:
+        """Downgrade to the dense engine; the dense path owns growth from
+        here. The host carry flattens exactly on host; the forest carry
+        canonicalizes in one device fixpoint."""
+        if self._cc_mode == "host":
+            flat = jnp.asarray(self._uf.flatten(self._vcap))
+        else:
+            flat = resolve_flat(self._canon)
+        touched = jnp.asarray(self._log.touched_bool(self._vcap))
+        self._summary = {"labels": flat, "touched": touched}
+        self._canon = None
+        self._log = None
+        self._uf = None
+        self._prep = None
+
+    def _reset_transient(self) -> None:
+        if self._cc_mode in ("forest", "host"):
+            self._canon = init_forest(self._vcap)
+            self._log = TouchLog(self._vcap)
+            self._summary = {"labels": self._canon}
+            if self._cc_mode == "host":
+                self._uf.load(np.arange(self._vcap, dtype=np.int32))
+        else:
+            self._summary = self.initial_state(self._vcap)
+
+    # ---- checkpoint surface: one canonical format for all carries ---- #
+    def snapshot_state(self) -> Any:
+        if self._cc_mode == "host":
+            return {
+                "labels": self._uf.flatten(self._vcap),
+                "touched": self._log.touched_bool(self._vcap),
+            }
+        if self._cc_mode == "forest":
+            lab = resolve_flat_host(np.asarray(self._canon))
+            return {
+                "labels": lab,
+                "touched": self._log.touched_bool(self._vcap),
+            }
+        return super().snapshot_state()
+
+    def restore_state(self, state: Any, vcap: Optional[int] = None) -> None:
+        super().restore_state(state, vcap)
+        # undecided until the first block reveals the stream's shape; the
+        # restored flat labels work as any carry
+        self._cc_mode = None
+        self._canon = None
+        self._log = None
+        self._uf = None
+        self._prep = None
+
 
 class ConnectedComponents(_CCMixin, SummaryBulkAggregation):
     """Flat-combine streaming CC (``library/ConnectedComponents.java``)."""
@@ -51,4 +256,6 @@ class ConnectedComponents(_CCMixin, SummaryBulkAggregation):
 
 class ConnectedComponentsTree(_CCMixin, SummaryTreeReduce):
     """Tree-combine variant (``library/ConnectedComponentsTree.java:26-36``):
-    same update/combine on the butterfly engine."""
+    same UDFs on the butterfly engine. The tree/bulk split only matters
+    under a sharded mesh, which is exactly where the dense engine runs;
+    the single-device forest carry is shared."""

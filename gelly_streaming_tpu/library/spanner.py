@@ -258,6 +258,7 @@ class SpannerEdges:
             return self._set
         if self._arrays is None or self._vdict is None:
             self._set = set()
+            self._workload = None  # nothing to feed back; don't pin it
             return self._set
         if self._kind == "k2":
             pv, pn = jax.device_get(self._arrays)

@@ -153,6 +153,16 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.vbitmap_novel2.argtypes = [
                 ctypes.c_void_p, pi32a, pi32a, i64,
             ]
+            lib.cuf_create.restype = ctypes.c_void_p
+            lib.cuf_destroy.argtypes = [ctypes.c_void_p]
+            lib.cuf_fold_window.restype = i64
+            lib.cuf_fold_window.argtypes = [
+                ctypes.c_void_p, pi32a, pi32a, i64, i64,
+                pi32a, pi32a, pi32a, pi32a, ctypes.POINTER(i64),
+            ]
+            lib.cuf_flatten.argtypes = [ctypes.c_void_p, pi32a, i64]
+            lib.cuf_load.restype = i64
+            lib.cuf_load.argtypes = [ctypes.c_void_p, pi32a, i64]
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -513,6 +523,75 @@ class NoveltyBitmap:
         h = getattr(self, "_h", None)
         if lib is not None and h:
             lib.vbitmap_destroy(h)
+
+
+class CompactUnionFind:
+    """Incremental union-find over compact int32 ids — the host CC carry
+    (``ingest.cpp: cuf_*``; placement rationale in
+    ``library/connected_components.py``).
+
+    ``fold(src, dst, vcap)`` unions one window and returns
+    ``(touched, roots, changed, changed_roots)``: the window's distinct
+    endpoints with their post-window roots, plus every root demoted by
+    this window with its post-window root — exactly the scatter a device
+    pointer-forest mirror needs to stay resolvable.
+
+    Raises ``RuntimeError`` at construction when the native toolchain is
+    unavailable; callers fall back to the device forest carry.
+    """
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native toolchain unavailable")
+        self._lib = lib
+        self._h = lib.cuf_create()
+        if not self._h:
+            raise RuntimeError("cuf_create failed")
+        self._tbuf = np.zeros(1024, np.int32)
+        self._rbuf = np.zeros(1024, np.int32)
+        self._cbuf = np.zeros(1024, np.int32)
+        self._crbuf = np.zeros(1024, np.int32)
+
+    def fold(self, src: np.ndarray, dst: np.ndarray, vcap: int):
+        src = np.ascontiguousarray(src, np.int32)
+        dst = np.ascontiguousarray(dst, np.int32)
+        n = src.size
+        if self._tbuf.size < 2 * n:
+            self._tbuf = np.zeros(2 * n, np.int32)
+            self._rbuf = np.zeros(2 * n, np.int32)
+        if self._cbuf.size < max(n, 1):
+            self._cbuf = np.zeros(n, np.int32)
+            self._crbuf = np.zeros(n, np.int32)
+        nc = ctypes.c_int64(0)
+        nt = self._lib.cuf_fold_window(
+            self._h, src, dst, n, int(vcap),
+            self._tbuf, self._rbuf, self._cbuf, self._crbuf,
+            ctypes.byref(nc),
+        )
+        if nt < 0:
+            raise ValueError("edge ids out of range for vcap")
+        nc = nc.value
+        return (
+            self._tbuf[:nt].copy(), self._rbuf[:nt].copy(),
+            self._cbuf[:nc].copy(), self._crbuf[:nc].copy(),
+        )
+
+    def flatten(self, vcap: int) -> np.ndarray:
+        out = np.zeros(vcap, np.int32)
+        self._lib.cuf_flatten(self._h, out, vcap)
+        return out
+
+    def load(self, labels: np.ndarray) -> None:
+        labels = np.ascontiguousarray(labels, np.int32)
+        if self._lib.cuf_load(self._h, labels, labels.size) != 0:
+            raise ValueError("labels are not a min-rooted forest")
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.cuf_destroy(h)
 
 
 class NativeEncoder:

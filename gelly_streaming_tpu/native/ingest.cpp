@@ -1151,3 +1151,119 @@ int64_t flink_proxy_run(const int64_t* src, const int64_t* dst, int64_t n,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Compact-id incremental union-find: the host CC carry (round 5).
+//
+// The streaming-CC merge is control-flow-heavy pointer chasing — the one
+// graph kernel that maps better onto a scalar core beside the parser than
+// onto dense vector passes (the reference's own fold is a CPU hashmap,
+// library/ConnectedComponents.java:83-126). This carry runs union-find
+// with path-halving over COMPACT int32 ids (the vertex dictionary already
+// made the id space dense, so no hash keys are needed — cf. the keyed
+// UnionFind above used by the baselines), and per window reports exactly
+// what the device mirror needs to stay a resolvable pointer forest:
+//
+//   * the window's touched ids + their post-window roots (epoch-stamped
+//     first-touch detection, no per-window clears), and
+//   * every root DEMOTED this window + its post-window root — a vertex
+//     never touched again still resolves on the device mirror because
+//     each pointer target was once a root and every demotion is mirrored.
+//
+// Union is by MIN ROOT (parent[max_root] = min_root), preserving the
+// invariant the device carries share: a component's canonical root is its
+// minimum compact id.
+// ===========================================================================
+
+struct CompactUF {
+    std::vector<int32_t> parent;
+    std::vector<uint32_t> stamp;   // epoch of last touch
+    uint32_t epoch = 0;
+
+    void ensure(int64_t vcap) {
+        int64_t old = (int64_t)parent.size();
+        if (vcap <= old) return;
+        parent.resize((size_t)vcap);
+        stamp.resize((size_t)vcap, 0);
+        for (int64_t v = old; v < vcap; ++v) parent[(size_t)v] = (int32_t)v;
+    }
+
+    int32_t find(int32_t x) {
+        while (parent[(size_t)x] != x) {
+            int32_t p = parent[(size_t)x];
+            int32_t g = parent[(size_t)p];
+            parent[(size_t)x] = g;  // path halving
+            x = g;
+        }
+        return x;
+    }
+};
+
+extern "C" {
+
+void* cuf_create() { return new (std::nothrow) CompactUF(); }
+
+void cuf_destroy(void* h) { delete (CompactUF*)h; }
+
+// Fold one window of compact edges. touched_out/roots_out need capacity
+// 2n; changed_out/changed_roots_out need capacity n. Returns the touched
+// count (>= 0) and writes the demoted-root count to *n_changed_out.
+int64_t cuf_fold_window(void* h, const int32_t* src, const int32_t* dst,
+                        int64_t n, int64_t vcap,
+                        int32_t* touched_out, int32_t* roots_out,
+                        int32_t* changed_out, int32_t* changed_roots_out,
+                        int64_t* n_changed_out) {
+    CompactUF& uf = *(CompactUF*)h;
+    uf.ensure(vcap);
+    uf.epoch++;
+    int64_t nt = 0, nc = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t a = src[i], b = dst[i];
+        if (a < 0 || b < 0 || a >= vcap || b >= vcap) return -1;
+        if (uf.stamp[(size_t)a] != uf.epoch) {
+            uf.stamp[(size_t)a] = uf.epoch;
+            touched_out[nt++] = a;
+        }
+        if (uf.stamp[(size_t)b] != uf.epoch) {
+            uf.stamp[(size_t)b] = uf.epoch;
+            touched_out[nt++] = b;
+        }
+        int32_t ra = uf.find(a), rb = uf.find(b);
+        if (ra == rb) continue;
+        int32_t lo = ra < rb ? ra : rb;
+        int32_t hi = ra < rb ? rb : ra;
+        uf.parent[(size_t)hi] = lo;   // union by min root
+        changed_out[nc++] = hi;       // hi was a root until now: unique
+    }
+    for (int64_t i = 0; i < nt; ++i)
+        roots_out[i] = uf.find(touched_out[i]);
+    for (int64_t i = 0; i < nc; ++i)
+        changed_roots_out[i] = uf.find(changed_out[i]);
+    *n_changed_out = nc;
+    return nt;
+}
+
+// Canonical flat labels for [0, vcap) (checkpoint sync point).
+void cuf_flatten(void* h, int32_t* out, int64_t vcap) {
+    CompactUF& uf = *(CompactUF*)h;
+    uf.ensure(vcap);
+    for (int64_t v = 0; v < vcap; ++v)
+        out[v] = uf.find((int32_t)v);
+}
+
+// Restore from flat labels (a valid forest; roots must be component
+// minima, which cuf_flatten and the device carries both guarantee).
+int64_t cuf_load(void* h, const int32_t* labels, int64_t vcap) {
+    CompactUF& uf = *(CompactUF*)h;
+    uf.parent.assign((size_t)vcap, 0);
+    uf.stamp.assign((size_t)vcap, 0);
+    uf.epoch = 0;
+    for (int64_t v = 0; v < vcap; ++v) {
+        int32_t l = labels[v];
+        if (l < 0 || l > v) return -1;  // not a min-rooted forest
+        uf.parent[(size_t)v] = l;
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -119,9 +119,13 @@ class Components:
     """
 
     def __init__(self, components: Optional[Dict[int, List[int]]] = None, *,
-                 _lazy=None):
+                 _lazy=None, _lazy_forest=None):
         self._components = components
         self._lazy = _lazy  # (labels_dev, touched_dev, n, vdict)
+        # (canon_dev, touch_log, count, vdict): forest-carry emission —
+        # canon chains resolve on host at materialization; the touched
+        # set is the first `count` entries of the append-only host log
+        self._lazy_forest = _lazy_forest
 
     @property
     def components(self) -> Dict[int, List[int]]:
@@ -129,17 +133,25 @@ class Components:
         grouping happen on first access, so un-inspected per-window
         emissions cost nothing (windows pipeline on device)."""
         if self._components is None:
-            labels_dev, touched_dev, n, vdict = self._lazy
-            labels = np.asarray(labels_dev)
-            touched = np.asarray(touched_dev)
-            if n is None:
-                # deferred dict-size read (device dicts: len() syncs the
-                # pipeline, so it must happen at materialization, not at
-                # emission). Safe because `touched` was snapshotted with
-                # the labels: vertices first seen after this window are
-                # False there, so a larger n admits nothing extra.
-                n = len(vdict)
-            idx = np.nonzero(touched[: min(n, touched.shape[0])])[0]
+            if self._lazy_forest is not None:
+                from .forest import resolve_flat_host
+
+                canon_dev, log, count, vdict = self._lazy_forest
+                labels = resolve_flat_host(np.asarray(canon_dev))
+                idx = np.sort(log.ids[:count])
+            else:
+                labels_dev, touched_dev, n, vdict = self._lazy
+                labels = np.asarray(labels_dev)
+                touched = np.asarray(touched_dev)
+                if n is None:
+                    # deferred dict-size read (device dicts: len() syncs
+                    # the pipeline, so it must happen at materialization,
+                    # not at emission). Safe because `touched` was
+                    # snapshotted with the labels: vertices first seen
+                    # after this window are False there, so a larger n
+                    # admits nothing extra.
+                    n = len(vdict)
+                idx = np.nonzero(touched[: min(n, touched.shape[0])])[0]
             lab = labels[idx]
             raw = vdict.decode(idx)
             # one (label, raw) lexsort: every component's member slice
@@ -165,6 +177,13 @@ class Components:
         return Components(
             _lazy=(state["labels"], state["touched"], None, vdict)
         )
+
+    @staticmethod
+    def from_forest(canon, log, vdict) -> "Components":
+        """Lazy view over a forest carry (``summaries/forest.py``): the
+        canon snapshot is this window's immutable device buffer; the
+        touched set snapshots as a COUNT into the append-only host log."""
+        return Components(_lazy_forest=(canon, log, log.count, vdict))
 
     def num_components(self) -> int:
         return len(self.components)

@@ -1,0 +1,205 @@
+"""Windowed CC carries (summaries/forest.py + native CompactUnionFind):
+differential equivalence with the dense engine, lazy-canonicalization
+correctness, snapshot isolation, and adversarial chain growth. Every
+test runs against BOTH windowed carries — the device forest kernels and
+the native host union-find with its device mirror."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow
+from gelly_streaming_tpu.library import ConnectedComponents
+
+
+def _stream(edges, window):
+    return SimpleEdgeStream(edges, window=CountWindow(window))
+
+
+@pytest.fixture(params=["forest", "host"])
+def carry(request):
+    if request.param == "host":
+        from gelly_streaming_tpu import native
+
+        try:
+            native.CompactUnionFind()
+        except Exception:
+            pytest.skip("native toolchain unavailable")
+    return request.param
+
+
+def _dense_cc():
+    """A CC instance pinned to the dense engine (the mesh / device-
+    transformed fallback), for differential comparison."""
+    return ConnectedComponents(carry="dense")
+
+
+def _union_find_components(edges):
+    parent = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b, *_ in edges:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    comps = {}
+    for v in parent:
+        comps.setdefault(find(v), set()).add(v)
+    return sorted(frozenset(m) for m in comps.values())
+
+
+@pytest.mark.parametrize("window", [1, 3, 16, 64])
+def test_carry_matches_dense_and_truth(window, carry):
+    rng = np.random.default_rng(17)
+    edges = [
+        (int(a), int(b), 0.0)
+        for a, b in rng.integers(0, 40, size=(120, 2))
+    ]
+    carry_out = [
+        str(c)
+        for c in _stream(edges, window).aggregate(
+            ConnectedComponents(carry=carry)
+        )
+    ]
+    dense_out = [
+        str(c) for c in _stream(edges, window).aggregate(_dense_cc())
+    ]
+    assert carry_out == dense_out
+    last = None
+    for last in _stream(edges, window).aggregate(
+        ConnectedComponents(carry=carry)
+    ):
+        pass
+    assert sorted(last.component_sets()) == _union_find_components(edges)
+
+
+def test_auto_carry_engages_a_windowed_path():
+    edges = [(i, i + 1, 0.0) for i in range(20)]
+    agg = ConnectedComponents()
+    for _ in _stream(edges, 4).aggregate(agg):
+        pass
+    assert agg._cc_mode in ("forest", "host")
+    assert agg._canon is not None
+
+
+def test_emission_snapshot_isolation(carry):
+    """Materializing an early emission AFTER later windows must reflect
+    the state at ITS window (canon buffer + touched-count watermark),
+    exactly like the dense path's immutable label tables."""
+    edges = [(0, 1, 0.0), (2, 3, 0.0), (1, 2, 0.0), (4, 5, 0.0)]
+    agg = ConnectedComponents(carry=carry)
+    emissions = list(_stream(edges, 1).aggregate(agg))
+    # read LAST first, then the early ones (worst-case ordering)
+    assert sorted(emissions[-1].component_sets()) == sorted(
+        [frozenset({0, 1, 2, 3}), frozenset({4, 5})]
+    )
+    assert sorted(emissions[0].component_sets()) == [frozenset({0, 1})]
+    assert sorted(emissions[1].component_sets()) == sorted(
+        [frozenset({0, 1}), frozenset({2, 3})]
+    )
+    assert sorted(emissions[2].component_sets()) == [frozenset({0, 1, 2, 3})]
+
+
+def test_adversarial_rerooting_chains(carry):
+    """Each window joins a new SMALLER vertex to the running component,
+    re-rooting it every time — the worst case for pointer chains. The
+    lazy canonicalization must still produce the right components, both
+    at the end and at a mid-stream emission."""
+    n = 60
+    # vertices n, n-1, ..., 1, 0 join one component in decreasing order
+    edges = [(n - i, n - i - 1, 0.0) for i in range(n)]
+    agg = ConnectedComponents(carry=carry)
+    emissions = list(_stream(edges, 1).aggregate(agg))
+    assert sorted(emissions[-1].component_sets()) == [
+        frozenset(range(n + 1))
+    ]
+    mid = emissions[n // 2]  # after n//2 + 1 edges
+    (comp,) = mid.component_sets()
+    assert comp == frozenset(range(n - (n // 2) - 1, n + 1))
+    # root is always the min raw id
+    assert list(emissions[-1].components.keys()) == [0]
+
+
+def test_growth_across_capacity_buckets(carry):
+    """Vertex ids climbing across pow2 capacity buckets grow the forest
+    and the touch log without losing earlier merges."""
+    edges = [(i, i + 1, 0.0) for i in range(300)]  # one long path
+    agg = ConnectedComponents(carry=carry)
+    last = None
+    for last in _stream(edges, 7).aggregate(agg):
+        pass
+    assert sorted(last.component_sets()) == [frozenset(range(301))]
+
+
+def test_checkpoint_roundtrip_continues(carry, tmp_path):
+    from gelly_streaming_tpu.aggregate import checkpoint
+    from gelly_streaming_tpu.core.window import Windower
+
+    rng = np.random.default_rng(23)
+    edges = [
+        (int(a), int(b), 0.0)
+        for a, b in rng.integers(0, 30, size=(80, 2))
+    ]
+    stream = _stream(edges, 10)
+    agg = ConnectedComponents(carry=carry)
+    it = stream.aggregate(agg)
+    for _ in range(4):
+        next(it)
+    assert agg._cc_mode == carry
+    path = str(tmp_path / "ck")
+    checkpoint.save_aggregation(path, agg, stream.vertex_dict)
+
+    # restore into the OTHER windowed carry: the checkpoint format is
+    # carry-independent (canonical flat labels + touched)
+    other = "host" if carry == "forest" else "forest"
+    agg2 = ConnectedComponents(carry=other)
+    vdict = checkpoint.restore_aggregation(path, agg2)
+    wi = Windower(CountWindow(10), vdict)
+    cont = SimpleEdgeStream(
+        _blocks=lambda: wi.blocks(iter(edges[40:])), _vdict=vdict
+    )
+    last = None
+    for last in agg2.run(cont):
+        pass
+    assert sorted(last.component_sets()) == _union_find_components(edges)
+
+
+def test_transient_state_is_per_window(carry):
+    edges = [(0, 1, 0.0), (1, 2, 0.0), (3, 4, 0.0), (0, 4, 0.0)]
+    agg = ConnectedComponents(transient_state=True, carry=carry)
+    out = [e.component_sets() for e in _stream(edges, 1).aggregate(agg)]
+    assert out[0] == [frozenset({0, 1})]
+    assert out[1] == [frozenset({1, 2})]   # no memory of window 0
+    assert out[2] == [frozenset({3, 4})]
+    assert out[3] == [frozenset({0, 4})]
+
+
+def test_downgrade_to_dense_midstream(carry):
+    """A restored windowed carry hitting a cache-less (device-
+    transformed) stream downgrades to the dense engine without losing
+    merges."""
+    edges1 = [(0, 1, 0.0), (2, 3, 0.0)]
+    edges2 = [(1, 2, 0.0), (4, 5, 0.0)]
+    agg = ConnectedComponents(carry=carry)
+    s1 = _stream(edges1, 1)
+    for _ in agg.run(s1):
+        pass
+    assert agg._cc_mode == carry
+    # a device-transformed continuation (no host cache on its blocks),
+    # sharing the vertex dictionary
+    s2 = SimpleEdgeStream(
+        edges2, window=CountWindow(1), vertex_dict=s1.vertex_dict
+    ).map_edges(lambda s, d, v: v)
+    last = None
+    for last in agg.run(s2):
+        pass
+    assert agg._cc_mode == "dense"
+    assert sorted(last.component_sets()) == sorted(
+        [frozenset({0, 1, 2, 3}), frozenset({4, 5})]
+    )
