@@ -186,7 +186,8 @@ class SummaryAggregation(abc.ABC):
                         part = self.update(init, src, dst, val, mask)
                         if tree:
                             return comm.tree_all_reduce(
-                                part, EDGE_AXIS, self.combine, p
+                                part, EDGE_AXIS, self.combine, p,
+                                degree=getattr(self, "degree", 2),
                             )
                         return jax.tree.map(lambda x: x[None], part)
 
@@ -297,24 +298,23 @@ class SummaryBulkAggregation(SummaryAggregation):
 
 class SummaryTreeReduce(SummaryAggregation):
     """Tree-combine engine (``SummaryTreeReduce.java:47-160``): the shard
-    partials merge through a log2(p) ppermute butterfly
+    partials merge through a ``log_degree(p)``-round ppermute butterfly
     (:func:`gelly_streaming_tpu.parallel.comm.tree_all_reduce`), the ICI
-    equivalent of ``enhance()``'s recursive parallelism-halving. ``degree``
-    is accepted for API parity; the butterfly's fan-in is fixed at 2, which
-    is what ``enhance()`` degenerates to as well (key = partition/2,
-    ``SummaryTreeReduce.java:95-123``)."""
+    equivalent of ``enhance()``'s recursive parallelism reduction
+    (``SummaryTreeReduce.java:95-123``). ``degree`` is the tree fan-in:
+    higher degrees run fewer collective rounds with more combines per
+    round; the mesh edge-axis size must be a power of ``degree`` (the
+    default 2 fits every power-of-two mesh). The combine must be
+    commutative as well as associative — all engine workloads'
+    join-semilattice merges are."""
+
+    #: degree changes the compiled collective program
+    config_fields: tuple = ("degree",)
 
     def __init__(self, transient_state: bool = False, mesh=None, degree: int = 2):
         super().__init__(transient_state=transient_state, mesh=mesh)
-        if degree != 2:
-            import warnings
-
-            warnings.warn(
-                f"SummaryTreeReduce degree={degree} is accepted for API "
-                "parity only: the ppermute butterfly's fan-in is fixed at "
-                "2 (which the reference's enhance() also degenerates to, "
-                "SummaryTreeReduce.java:95-123); the value has no effect"
-            )
+        if degree < 2:
+            raise ValueError(f"degree must be >= 2, got {degree}")
         self.degree = degree
 
     def _is_tree(self) -> bool:

@@ -151,6 +151,24 @@ def segmented_fold(
     to raw vertex ids (int32 lookup tables) so UDFs observe the same ids the
     reference would.
 
+    .. warning:: **Cost model — prefer tiers 1-2 at scale.** Arrival-order
+       semantics with an arbitrary (possibly non-associative) ``fold_fn``
+       force a SEQUENTIAL ``lax.scan`` over the whole window: per-window
+       depth is the edge count, so throughput is per-edge scan-step rate
+       (~1-5M eps, measured in ``BENCH_DETAIL.json: segmented_fold_eps``)
+       regardless of window size — three orders below the scatter tiers.
+       Use it only when the fold is genuinely order-dependent and
+       non-associative, exactly like the reference's sequential
+       ``EdgesFold``. Otherwise:
+
+       * tier 1 — ``reduce_on_edges("sum"|"min"|"max")``: one XLA
+         scatter-reduce, no sort;
+       * tier 2 — ``reduce_on_edges(callable)``: any ASSOCIATIVE combine
+         via segmented associative scan (log-depth);
+       * order-dependent but associative-after-keying folds can usually
+         be re-expressed as a tier-2 reduce over (timestamp, value)
+         pairs.
+
     Returns ``(per_segment_accum, nonempty_mask)``.
     """
     sorted_ids, sorted_mask, sorted_nbr, sorted_vals = sort_by_segment(

@@ -82,31 +82,62 @@ def tree_all_reduce(
     axis_name: str,
     combine: Callable[[Any, Any], Any],
     n_shards: int,
+    degree: int = 2,
 ) -> Any:
-    """Recursive-halving/doubling all-reduce with an arbitrary combine fn.
+    """Butterfly all-reduce with an arbitrary combine fn and fan-in
+    ``degree``.
 
-    The reference's ``SummaryTreeReduce.enhance()`` repeatedly halves
-    parallelism (key = partition/2) and pairwise-combines partials
+    The reference's ``SummaryTreeReduce.enhance()`` repeatedly reduces
+    parallelism by its tree degree and combines partials
     (``SummaryTreeReduce.java:95-123``). The ICI-native equivalent is a
-    butterfly: at round r every shard exchanges its partial with the shard
-    whose index differs in bit r (``ppermute``), then combines — log2(p)
-    rounds, after which *every* shard holds the global combine.
+    degree-d butterfly: at round r the shards split into groups of
+    ``degree`` (stride ``degree**r``); every shard ppermute-receives the
+    other ``degree - 1`` members' partials and folds them in — after
+    ``log_degree(p)`` rounds *every* shard holds the global combine.
+    ``degree=2`` is the classic recursive-doubling exchange; higher
+    degrees trade fewer rounds (less latency-bound collective setup) for
+    more sequential combines per round.
 
-    ``combine`` may be any associative pytree merge (not just an elementwise
-    monoid), which is what distinguishes this from plain psum/pmin.
-    ``n_shards`` must be a power of two (mesh axis size).
+    ``combine`` may be any associative+commutative pytree merge (not just
+    an elementwise monoid) — commutativity is required because each shard
+    folds partials in its own arrival order (the degree-2 case already
+    relied on this: shard i computes combine(x_i, x_j) while shard j
+    computes combine(x_j, x_i)).
+
+    ``n_shards`` must be a power of ``degree`` (the mesh axis size).
     """
-    if n_shards & (n_shards - 1):
-        raise ValueError("tree_all_reduce requires a power-of-two axis size")
-    me = lax.axis_index(axis_name)
-    step = 1
-    while step < n_shards:
-        # Pair shards whose indices differ in the current bit: i <-> i XOR step.
-        perm = [(i, i ^ step) for i in range(n_shards)]
-        partner = jax.tree.map(lambda leaf: lax.ppermute(leaf, axis_name, perm), x)
-        x = combine(x, partner)
-        step *= 2
-    del me
+    if degree < 2:
+        raise ValueError(f"tree_all_reduce degree must be >= 2, got {degree}")
+    total = 1
+    while total < n_shards:
+        total *= degree
+    if total != n_shards:
+        raise ValueError(
+            f"tree_all_reduce requires the axis size ({n_shards}) to be a "
+            f"power of the tree degree ({degree}); use degree=2 for "
+            "power-of-two meshes"
+        )
+    group = 1
+    while group < n_shards:
+        span = group * degree
+        # permute the ROUND-START partial each exchange: accumulating
+        # into the permute source would ship partially-combined values
+        # on the second and later exchanges of a round
+        x0 = x
+        for j in range(1, degree):
+            # shard i = hi*span + pos*group + lo receives the partial of
+            # the group member at position (pos - j) mod degree
+            perm = []
+            for i in range(n_shards):
+                hi, rem = divmod(i, span)
+                pos, lo = divmod(rem, group)
+                dst = hi * span + ((pos + j) % degree) * group + lo
+                perm.append((i, dst))
+            partner = jax.tree.map(
+                lambda leaf: lax.ppermute(leaf, axis_name, perm), x0
+            )
+            x = combine(x, partner)
+        group = span
     return x
 
 

@@ -9,13 +9,23 @@ and DCN across slices, placed by XLA from the same ``shard_map`` programs
 used single-host (nothing else in the framework changes).
 
 Ingest contract (the keyBy analog across hosts): every host windows ITS
-OWN shard of the edge stream with a deterministic VertexDict — compaction
-is deterministic given identical id streams, so hosts must either (a)
-share the raw->compact mapping by exchanging dictionaries per window, or
-(b) pre-partition the raw id space (e.g. ``hash(v) % n_hosts``) and use
-:func:`global_edge_block` to assemble the global sharded arrays from
-per-host blocks. This module provides the wiring; the windowing/kernel
-stack is host-count agnostic.
+OWN shard of the edge stream, and the per-host raw->compact mappings must
+agree globally. Two implemented contracts:
+
+(a) **dict exchange** (:func:`dict_exchange_encode`): per window, hosts
+    allgather their windows' first-occurrence raw ids and every host
+    feeds the union into its VertexDict in (process rank, arrival) order
+    — compaction is deterministic given identical id streams, so all
+    dictionaries stay byte-identical with no coordinator. For sparse /
+    arbitrary raw id spaces.
+(b) **pre-partition** (:func:`global_edge_block` /
+    :func:`globalize_stream`): dense or pre-hashed id spaces need no
+    exchange at all — every host uses the same deterministic mapping
+    (e.g. ``IdentityDict``) and the global sharded arrays assemble
+    directly from per-host blocks.
+
+Both feed the same sharded aggregation stack; the windowing/kernel code
+is host-count agnostic.
 """
 
 from __future__ import annotations
@@ -111,6 +121,53 @@ def globalize_stream(stream, mesh):
         _blocks=lambda: (global_block(mesh, b) for b in stream.blocks()),
         _vdict=stream.vertex_dict,
     )
+
+
+def dict_exchange_encode(
+    mesh, vdict, src_raw: np.ndarray, dst_raw: np.ndarray
+):
+    """Encode one window's raw columns under a GLOBALLY-AGREED dictionary
+    (ingest contract (a), module docstring).
+
+    Each host proposes its window's raw ids in first-occurrence order;
+    two allgathers (counts, then bucket-padded id arrays) give every host
+    the same proposal matrix, and each host folds the union into its own
+    ``vdict`` in (process rank, arrival order) — a deterministic sequence,
+    so dictionaries that started identical remain identical without any
+    coordinator. Returns the compact ``(src, dst)`` columns. Proposal
+    arrays are padded to shared pow2 buckets so the allgather shapes (and
+    their compiled programs) stay stable across windows. ``mesh`` is
+    accepted for call-site symmetry with the pre-partition helpers; the
+    exchange itself spans the global process set.
+    """
+    from jax.experimental import multihost_utils
+
+    from ..core.edgeblock import bucket_capacity
+
+    ids = np.concatenate(
+        [src_raw.astype(np.int64), dst_raw.astype(np.int64)]
+    )
+    # first-occurrence order, matching single-host VertexDict semantics
+    _, first = np.unique(ids, return_index=True)
+    proposal = ids[np.sort(first)]
+    n = np.int32(len(proposal))
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.array([n], np.int32))
+    ).reshape(-1)
+    cap = bucket_capacity(int(counts.max()) if counts.size else 1, minimum=8)
+    # ship int64 raw ids as two int32 planes: the gather rides device
+    # arrays, and default-jax (x64 disabled) silently truncates int64 —
+    # 40-bit ids came back negative before this split
+    padded = np.zeros((2, cap), np.int32)
+    padded[0, : len(proposal)] = (proposal >> 32).astype(np.int32)
+    padded[1, : len(proposal)] = (proposal & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(-1, 2, cap)
+    for p in range(gathered.shape[0]):
+        hi = gathered[p, 0, : int(counts[p])].astype(np.int64)
+        lo = gathered[p, 1, : int(counts[p])].view(np.uint32).astype(np.int64)
+        vdict.encode((hi << 32) | lo)
+    return vdict.encode(src_raw), vdict.encode(dst_raw)
 
 
 def is_coordinator() -> bool:

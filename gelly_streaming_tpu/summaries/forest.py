@@ -29,10 +29,12 @@ Per window, every kernel is sized by the window, not the vertex space:
 4. One masked scatter re-roots the old roots (and the touched vertices,
    for path compression) to the merged component's min root.
 
-The only vcap-sized cost left is the functional scatter's buffer copy —
-a single HBM memcpy instead of the dense path's ~10-20 full-table
-passes — which is also what keeps per-window emissions valid snapshots
-(the pre-scatter buffer stays alive for any lazy emission holding it).
+The remaining vcap-sized costs are bandwidth-only: the functional
+scatter's buffer copy (which is also what keeps per-window emissions
+valid snapshots — the pre-scatter buffer stays alive for any lazy
+emission holding it) and the step-2 scratch memset — two linear HBM
+passes per window instead of the dense path's ~10-20 full-table
+gather/scatter fixpoint passes.
 
 Reference parity: this is the ``UpdateCC``/``CombineCC`` pair of
 ``library/ConnectedComponents.java:83-126`` with the DisjointSet's

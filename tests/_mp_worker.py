@@ -117,4 +117,86 @@ for last in agg.run(gstream):
     pass
 sets = sorted(last.component_sets())
 assert sets == [frozenset({0, 1, 2, 3, 4}), frozenset({6})], sets
-print(f"MP_OK {labels.tolist()}", flush=True)
+
+# ---- pre-partition ingest contract, STREAMING (round-4 verdict #8):
+# a 64-edge random graph pre-partitioned across the two hosts, four
+# windows per host, the engine's sharded window step per global window;
+# the final components must equal a single-process union-find ----------
+
+
+def _uf_components(s, d):
+    parent = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in zip(s.tolist(), d.tolist()):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    comps = {}
+    for v in parent:
+        comps.setdefault(find(v), set()).add(v)
+    return sorted(frozenset(m) for m in comps.values())
+
+
+rng = np.random.default_rng(77)  # identical global stream on both hosts
+gsrc64 = rng.integers(0, 40, 64).astype(np.int64)
+gdst64 = rng.integers(0, 40, 64).astype(np.int64)
+# pre-partition: interleaved rows (the hash(edge) % n_hosts analog)
+mine_s = gsrc64[proc_id::2]
+mine_d = gdst64[proc_id::2]
+w2 = Windower(CountWindow(8), IdentityDict(64))
+local2 = SimpleEdgeStream(
+    _blocks=lambda: (
+        b for _, b in w2.blocks_from_chunks([(mine_s, mine_d)])
+    ),
+    _vdict=w2.vertex_dict,
+    context=StreamContext(mesh=mesh),
+)
+g2 = multihost.globalize_stream(local2, mesh)
+agg2 = ConnectedComponents(mesh=mesh)
+n_windows = 0
+final = None
+for final in agg2.run(g2):
+    n_windows += 1
+assert n_windows == 4, n_windows
+stream_sets = sorted(final.component_sets())
+assert stream_sets == _uf_components(gsrc64, gdst64), stream_sets
+
+# ---- dict-exchange ingest contract (a): sparse 40-bit raw ids, each
+# host seeing a DIFFERENT shard; per-window allgather keeps the
+# dictionaries byte-identical with no coordinator --------------------------
+from gelly_streaming_tpu.core.vertexdict import VertexDict  # noqa: E402
+
+pool = rng.integers(1 << 40, 1 << 41, size=48).astype(np.int64)
+sp_src = pool[rng.integers(0, 48, 32)]
+sp_dst = pool[rng.integers(0, 48, 32)]
+my_src = sp_src[proc_id::2]
+my_dst = sp_dst[proc_id::2]
+vd = VertexDict()
+enc = []
+for k in range(4):  # four exchanged windows
+    sl = slice(k * 4, (k + 1) * 4)
+    sc, dc = multihost.dict_exchange_encode(
+        mesh, vd, my_src[sl], my_dst[sl]
+    )
+    enc.append((sc, dc))
+# the dictionary must be identical across hosts (the parent compares the
+# printed line between processes) and must round-trip every id
+assert len(vd) == len(np.unique(np.concatenate([sp_src, sp_dst]))), len(vd)
+for (sc, dc), k in zip(enc, range(4)):
+    sl = slice(k * 4, (k + 1) * 4)
+    assert vd.decode(sc).tolist() == my_src[sl].tolist()
+    assert vd.decode(dc).tolist() == my_dst[sl].tolist()
+dict_sig = vd.raw_ids().tolist()
+
+print(
+    f"MP_OK {labels.tolist()} | {sorted(map(sorted, stream_sets))} | "
+    f"{dict_sig}",
+    flush=True,
+)

@@ -172,19 +172,22 @@ def test_matching_movielens_mode(tmp_path, monkeypatch, capsys):
     assert "Matching weight:" in out and "Runtime:" in out
 
 
-def test_tree_reduce_degree_warns():
-    import warnings
+def test_tree_reduce_degree_is_real():
+    """degree is a real fan-in since round 5 (the warning-only era is
+    over): construction validates it, the step-cache key includes it,
+    and an invalid mesh/degree combination raises at run time (the
+    equality-across-degrees behavior is covered in
+    ``tests/test_distributed.py::test_tree_reduce_degree_fanin``)."""
+    import pytest
 
     from gelly_streaming_tpu.library import ConnectedComponentsTree
 
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        ConnectedComponentsTree(degree=4)
-    assert any("fan-in" in str(x.message) for x in w)
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        ConnectedComponentsTree()
-    assert not w
+    a = ConnectedComponentsTree(degree=4)
+    b = ConnectedComponentsTree()
+    assert a.degree == 4 and b.degree == 2
+    assert a.step_cache_key() != b.step_cache_key()
+    with pytest.raises(ValueError):
+        ConnectedComponentsTree(degree=0)
 
 
 def test_cc_corpus_mode(tmp_path, capsys):
