@@ -124,24 +124,11 @@ assert sets == [frozenset({0, 1, 2, 3, 4}), frozenset({6})], sets
 # the final components must equal a single-process union-find ----------
 
 
+from _uf import union_find_components  # noqa: E402
+
+
 def _uf_components(s, d):
-    parent = {}
-
-    def find(x):
-        parent.setdefault(x, x)
-        while parent[x] != x:
-            parent[x] = parent[parent[x]]
-            x = parent[x]
-        return x
-
-    for a, b in zip(s.tolist(), d.tolist()):
-        ra, rb = find(a), find(b)
-        if ra != rb:
-            parent[max(ra, rb)] = min(ra, rb)
-    comps = {}
-    for v in parent:
-        comps.setdefault(find(v), set()).add(v)
-    return sorted(frozenset(m) for m in comps.values())
+    return union_find_components(zip(s.tolist(), d.tolist()))
 
 
 rng = np.random.default_rng(77)  # identical global stream on both hosts

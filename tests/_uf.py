@@ -1,0 +1,28 @@
+"""Shared plain-Python union-find oracle for the differential suites.
+
+One implementation instead of per-file copies (round-5 review): the
+oracle the CC carries, the multi-process worker, and the randomized
+differential tests are all judged against.
+"""
+
+
+def union_find_components(edges):
+    """``edges``: iterable of (src, dst, *rest) -> sorted list of
+    frozenset components over the touched vertices."""
+    parent = {}
+
+    def find(x):
+        parent.setdefault(x, x)
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b, *_ in edges:
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    comps = {}
+    for v in parent:
+        comps.setdefault(find(v), set()).add(v)
+    return sorted(frozenset(m) for m in comps.values())

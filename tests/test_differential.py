@@ -17,25 +17,7 @@ def _rand_edges(rng, n, vmax, sparse_ids=False):
     return [(int(a) * k + 3, int(b) * k + 3, 0.0) for a, b in pairs]
 
 
-def _py_components(edges):
-    """Reference semantics: plain union-find over raw ids."""
-    parent = {}
-
-    def find(x):
-        parent.setdefault(x, x)
-        while parent[x] != x:
-            parent[x] = parent[parent[x]]
-            x = parent[x]
-        return x
-
-    for s, d, _ in edges:
-        rs, rd = find(s), find(d)
-        if rs != rd:
-            parent[rd] = rs
-    comps = {}
-    for v in parent:
-        comps.setdefault(find(v), set()).add(v)
-    return sorted(frozenset(m) for m in comps.values())
+from _uf import union_find_components as _py_components  # noqa: E402
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])

@@ -11,6 +11,8 @@ from gelly_streaming_tpu.core.stream import SimpleEdgeStream
 from gelly_streaming_tpu.core.window import CountWindow
 from gelly_streaming_tpu.library import ConnectedComponents
 
+from _uf import union_find_components as _union_find_components
+
 
 def _stream(edges, window):
     return SimpleEdgeStream(edges, window=CountWindow(window))
@@ -32,26 +34,6 @@ def _dense_cc():
     """A CC instance pinned to the dense engine (the mesh / device-
     transformed fallback), for differential comparison."""
     return ConnectedComponents(carry="dense")
-
-
-def _union_find_components(edges):
-    parent = {}
-
-    def find(x):
-        parent.setdefault(x, x)
-        while parent[x] != x:
-            parent[x] = parent[parent[x]]
-            x = parent[x]
-        return x
-
-    for a, b, *_ in edges:
-        ra, rb = find(a), find(b)
-        if ra != rb:
-            parent[max(ra, rb)] = min(ra, rb)
-    comps = {}
-    for v in parent:
-        comps.setdefault(find(v), set()).add(v)
-    return sorted(frozenset(m) for m in comps.values())
 
 
 @pytest.mark.parametrize("window", [1, 3, 16, 64])
