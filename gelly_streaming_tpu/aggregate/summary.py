@@ -159,24 +159,6 @@ class SummaryAggregation(abc.ABC):
             p = mesh.shape[EDGE_AXIS] if mesh is not None else 1
             tree = self._is_tree()
 
-            def stacked_reduce(stacked, n):
-                while n > 1:
-                    half = n // 2
-                    lo = jax.tree.map(lambda x: x[:half], stacked)
-                    hi = jax.tree.map(lambda x: x[half : 2 * half], stacked)
-                    merged = jax.vmap(self.combine)(lo, hi)
-                    if n % 2:
-                        stacked = jax.tree.map(
-                            lambda m, x: jnp.concatenate([m, x[2 * half : n]]),
-                            merged,
-                            stacked,
-                        )
-                        n = half + 1
-                    else:
-                        stacked = merged
-                        n = half
-                return jax.tree.map(lambda x: x[0], stacked)
-
             def step(summary, src, dst, val, mask):
                 init = self.initial_state(vcap)
                 if mesh is None:
@@ -202,7 +184,10 @@ class SummaryAggregation(abc.ABC):
                     )
                     # bulk: stacked shard partials -> log-depth reduction
                     # (the timeWindowAll gather analog)
-                    partial = out if tree else stacked_reduce(out, p)
+                    partial = (
+                        out if tree
+                        else comm.stacked_reduce(out, p, self.combine)
+                    )
                 return self.combine(summary, partial)
 
             step_fn = jax.jit(step)

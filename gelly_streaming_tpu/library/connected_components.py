@@ -15,6 +15,10 @@ option, default ``"auto"``):
   with the vertex capacity; chains canonicalize lazily at emission or
   checkpoint. This is the round-5 answer to the measured V-bound of the
   dense path (BENCH_CPU r4: 0.45x the compiled baseline at 1M windows).
+  Under a sharded mesh the T-sized local fixpoint runs as the engine's
+  fold+combine shape — per-shard folds over the edge columns, label
+  tables merged by the bulk stack or the degree-d butterfly — so the
+  vcap-sized carry never crosses the mesh.
 - **Host carry** (auto default on a CPU backend): the native incremental
   union-find (``native/ingest.cpp: cuf_*``) folds each window beside the
   parser and the device keeps a pointer-forest MIRROR updated by one
@@ -23,12 +27,11 @@ option, default ``"auto"``):
   the matching/spanner host paths. Emission/checkpoint are identical to
   the forest carry (the mirror IS a forest).
 - **Dense labels** (``summaries/labels.py``): full-table min-label
-  fixpoint + pointer-graph combine. Used under a sharded mesh (the
-  shard_map window fold + collective combine) and for device-transformed
-  streams whose compact columns never exist on host (the windowed
-  carries' touched set is host-computed). A stream can downgrade to
-  dense mid-run (either carry canonicalizes to flat labels); it never
-  needs to upgrade back.
+  fixpoint + pointer-graph combine. Used for device-transformed streams
+  whose compact columns never exist on host (the windowed carries'
+  touched set is host-computed) and on explicit ``carry="dense"``. A
+  stream can downgrade to dense mid-run (either carry canonicalizes to
+  flat labels); it never needs to upgrade back.
 
 Emission converts either carry to a
 :class:`~gelly_streaming_tpu.summaries.labels.Components` view (the
@@ -128,12 +131,22 @@ class _CCMixin:
     # ---- windowed-carry run loop ---- #
     def run(self, stream) -> Iterator[Components]:
         mesh = self._resolve_mesh(stream)
+        if mesh is not None and self._is_tree():
+            # validate the tree degree against the mesh EAGERLY: the host
+            # carry never runs the butterfly, so without this check a
+            # misconfigured degree would pass silently (or raise midway
+            # through the stream after a downgrade to dense)
+            from ..parallel import comm
+            from ..parallel.mesh import EDGE_AXIS
+
+            comm.validate_tree_degree(
+                mesh.shape[EDGE_AXIS], getattr(self, "degree", 2)
+            )
         vdict = stream.vertex_dict
         for block in stream.blocks():
             cache = getattr(block, "_host_cache", None)
             if (
-                mesh is not None
-                or cache is None
+                cache is None
                 or self.carry == "dense"
                 or self._cc_mode == "dense"
             ):
@@ -151,6 +164,8 @@ class _CCMixin:
                 self._ensure_windowed(block.n_vertices)
                 src_h, dst_h = cache[0], cache[1]
                 if self._cc_mode == "host":
+                    # the host union-find computes the merge exactly; a
+                    # mesh adds nothing (the mirror is one scatter)
                     tids, roots, changed, chroots = self._uf.fold(
                         src_h, dst_h, self._vcap
                     )
@@ -162,7 +177,9 @@ class _CCMixin:
                     )
                 else:
                     self._canon, tids = forest_window(
-                        self._canon, src_h, dst_h, self._vcap, self._prep
+                        self._canon, src_h, dst_h, self._vcap, self._prep,
+                        mesh=mesh, tree=self._is_tree(),
+                        degree=getattr(self, "degree", 2),
                     )
                 self._log.add(tids)
                 # sync()/bench barriers block on _summary; keep it aimed

@@ -77,6 +77,46 @@ def axis_size(axis_name: str) -> int:
 # --------------------------------------------------------------------------- #
 # Tree reduction (P4): ppermute butterfly, parity with SummaryTreeReduce
 # --------------------------------------------------------------------------- #
+def stacked_reduce(stacked: Any, n: int, combine: Callable[[Any, Any], Any]) -> Any:
+    """Log-depth fold of ``n`` stacked partials (leading axis) with an
+    arbitrary pytree ``combine`` — the bulk engine's cross-shard merge
+    (``SummaryBulkAggregation``'s timeWindowAll-gather analog). Handles
+    odd counts by carrying the tail partial into the next level."""
+    while n > 1:
+        half = n // 2
+        lo = jax.tree.map(lambda x: x[:half], stacked)
+        hi = jax.tree.map(lambda x: x[half: 2 * half], stacked)
+        merged = jax.vmap(combine)(lo, hi)
+        if n % 2:
+            stacked = jax.tree.map(
+                lambda m, x: jnp.concatenate([m, x[2 * half: n]]),
+                merged,
+                stacked,
+            )
+            n = half + 1
+        else:
+            stacked = merged
+            n = half
+    return jax.tree.map(lambda x: x[0], stacked)
+
+
+def validate_tree_degree(n_shards: int, degree: int) -> None:
+    """The degree-d butterfly needs the axis size to be a power of the
+    degree; callable eagerly (stream setup) so a misconfiguration fails
+    before any window runs, whichever carry ends up executing."""
+    if degree < 2:
+        raise ValueError(f"tree_all_reduce degree must be >= 2, got {degree}")
+    total = 1
+    while total < n_shards:
+        total *= degree
+    if total != n_shards:
+        raise ValueError(
+            f"tree_all_reduce requires the axis size ({n_shards}) to be a "
+            f"power of the tree degree ({degree}); use degree=2 for "
+            "power-of-two meshes"
+        )
+
+
 def tree_all_reduce(
     x: Any,
     axis_name: str,
@@ -106,17 +146,7 @@ def tree_all_reduce(
 
     ``n_shards`` must be a power of ``degree`` (the mesh axis size).
     """
-    if degree < 2:
-        raise ValueError(f"tree_all_reduce degree must be >= 2, got {degree}")
-    total = 1
-    while total < n_shards:
-        total *= degree
-    if total != n_shards:
-        raise ValueError(
-            f"tree_all_reduce requires the axis size ({n_shards}) to be a "
-            f"power of the tree degree ({degree}); use degree=2 for "
-            "power-of-two meshes"
-        )
+    validate_tree_degree(n_shards, degree)
     group = 1
     while group < n_shards:
         span = group * degree

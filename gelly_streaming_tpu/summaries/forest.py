@@ -56,17 +56,44 @@ from .labels import _propagate
 
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
-#: jitted per-(Tcap, Wcap, vcap) window steps; bounded FIFO like the
-#: engine's step cache (each signature costs seconds on a remote TPU).
+#: jitted per-(Tcap, Wcap, vcap, mesh, tree, degree) window steps;
+#: bounded FIFO like the engine's step cache (each signature costs
+#: seconds on a remote TPU).
 _FOREST_STEP_CACHE: dict = {}
 _FOREST_STEP_CACHE_MAX = 32
 
 
-def _forest_step_fn(tcap: int, wcap: int, vcap: int):
-    key = (tcap, wcap, vcap)
+def _table_combine(tcap: int):
+    """Merge two local label tables over the same touched set: the
+    union's constraints are exactly the pointer edges of both tables
+    (``labels.label_combine`` on plain arrays)."""
+    iota = jnp.arange(tcap, dtype=jnp.int32)
+
+    def combine(a, b):
+        u = jnp.concatenate([iota, iota])
+        w = jnp.concatenate([a, b])
+        return _propagate(
+            jnp.minimum(a, b), u, w, jnp.ones(2 * tcap, bool)
+        )
+
+    return combine
+
+
+def _forest_step_fn(tcap: int, wcap: int, vcap: int, mesh=None,
+                    tree: bool = False, degree: int = 2):
+    key = (tcap, wcap, vcap, mesh, tree, degree)
     fn = _FOREST_STEP_CACHE.get(key)
     if fn is not None:
         return fn
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import comm
+        from ..parallel.mesh import EDGE_AXIS
+
+        p = mesh.shape[EDGE_AXIS]
+        combine = _table_combine(tcap)
 
     def step(canon, tid, tmask, lu, lv):
         # 1. chase touched pointers to their current roots. Read-only on
@@ -92,10 +119,33 @@ def _forest_step_fn(tcap: int, wcap: int, vcap: int):
         rep = scratch[jnp.where(tmask, r, 0)]
         v2 = jnp.where(tmask, rep, iota)
         # 3. local min-label fixpoint on the T-sized table (window edges
-        # + group edges; lu/lv pads are (0,0) self-loops, no mask needed)
-        u = jnp.concatenate([lu, iota])
-        w = jnp.concatenate([lv, v2])
-        local = _propagate(iota, u, w, jnp.ones(u.shape[0], bool))
+        # + group edges; lu/lv pads are (0,0) self-loops, no mask
+        # needed). Under a mesh this is the engine's per-shard-fold +
+        # cross-shard-combine shape on WINDOW-SIZED tables: each shard
+        # folds its slice of the edge columns (the T-sized group edges
+        # replicate — same constraints everywhere), then the T-sized
+        # label tables merge through the bulk stack or the ppermute
+        # butterfly. The vcap-sized carry never crosses the mesh.
+        if mesh is None:
+            u = jnp.concatenate([lu, iota])
+            w = jnp.concatenate([lv, v2])
+            local = _propagate(iota, u, w, jnp.ones(u.shape[0], bool))
+        else:
+            def shard_fn(lu_s, lv_s):
+                u = jnp.concatenate([lu_s, iota])
+                w = jnp.concatenate([lv_s, v2])
+                lab = _propagate(iota, u, w, jnp.ones(u.shape[0], bool))
+                if tree:
+                    return comm.tree_all_reduce(
+                        lab, EDGE_AXIS, combine, p, degree=degree
+                    )
+                return lab[None]
+
+            out = comm.shard_map(
+                shard_fn, mesh, (P(EDGE_AXIS), P(EDGE_AXIS)),
+                P() if tree else P(EDGE_AXIS),
+            )(lu, lv)
+            local = out if tree else comm.stacked_reduce(out, p, combine)
         # 4. merged component's new root = min of its members' old roots
         # (each old root is the min id of its old component, so the min
         # over merged roots is the min id of the merged component)
@@ -161,6 +211,9 @@ def forest_window(
     dst_h: np.ndarray,
     vcap: int,
     prep: Optional[WindowPrep] = None,
+    mesh=None,
+    tree: bool = False,
+    degree: int = 2,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Fold one window (host compact-id columns) into the forest.
 
@@ -175,7 +228,15 @@ def forest_window(
     tids, lu_r, lv_r = (prep or WindowPrep()).prep(src_h, dst_h, vcap)
     t = len(tids)
     tcap = bucket_capacity(t, minimum=8)
-    wcap = bucket_capacity(n, minimum=8)
+    wmin = 8
+    if mesh is not None:
+        from ..parallel.mesh import EDGE_AXIS
+
+        # the sharded columns must divide by the axis size; passing it as
+        # the bucket minimum keeps every bucket divisible for ANY axis
+        # width (the edgeblock.py convention), not just powers of two
+        wmin = max(wmin, mesh.shape[EDGE_AXIS])
+    wcap = bucket_capacity(n, minimum=wmin)
     tid = np.zeros(tcap, np.int32)
     tid[:t] = tids
     tmask = np.zeros(tcap, bool)
@@ -184,7 +245,7 @@ def forest_window(
     lv = np.zeros(wcap, np.int32)
     lu[:n] = lu_r
     lv[:n] = lv_r
-    step = _forest_step_fn(tcap, wcap, vcap)
+    step = _forest_step_fn(tcap, wcap, vcap, mesh, tree, degree)
     canon = step(
         canon,
         jnp.asarray(tid),

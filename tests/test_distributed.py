@@ -242,15 +242,51 @@ def test_tree_reduce_degree_fanin():
     edges = _random_stream(11)
     base = _run(ConnectedComponentsTree, edges, 8)
 
-    def run_degree(d):
+    def run_degree(d, carry="dense"):
+        # pinned off the auto(host) carry: the butterfly runs in the
+        # dense tree engine and in the forest carry's table combine
         ctx = StreamContext(mesh=make_mesh(8))
         stream = SimpleEdgeStream(edges, window=CountWindow(16), context=ctx)
         return [str(e) for e in stream.aggregate(
-            ConnectedComponentsTree(degree=d)
+            ConnectedComponentsTree(degree=d, carry=carry)
         )]
 
     assert run_degree(8) == base
+    assert run_degree(8, carry="forest") == base
     with pytest.raises(ValueError, match="power of the tree degree"):
         run_degree(3)
+    with pytest.raises(ValueError, match="power of the tree degree"):
+        run_degree(3, carry="forest")
+    # eager validation: even the auto(host) carry — which never runs the
+    # butterfly — must reject a degree that cannot fit the mesh, before
+    # any window is processed (round-5 review)
+    with pytest.raises(ValueError, match="power of the tree degree"):
+        run_degree(3, carry="auto")
     with pytest.raises(ValueError, match="degree must be >= 2"):
         ConnectedComponentsTree(degree=1)
+
+
+@pytest.mark.parametrize("tree", [False, True])
+def test_forest_carry_identical_across_shard_widths(tree):
+    """The window-local forest carry now runs UNDER the mesh (round 5):
+    per-shard T-table folds + cross-shard table combine must equal the
+    1-shard result at every width, for both combine engines."""
+    cls = ConnectedComponentsTree if tree else ConnectedComponents
+    edges = _random_stream(13)
+
+    def run(p):
+        ctx = StreamContext(mesh=make_mesh(p) if p > 1 else None)
+        stream = SimpleEdgeStream(edges, window=CountWindow(16), context=ctx)
+        agg = cls(carry="forest")
+        out = [str(e) for e in stream.aggregate(agg)]
+        assert agg._cc_mode == "forest"  # the mesh no longer forces dense
+        return out
+
+    base = run(1)
+    for p in SHARD_WIDTHS[1:]:
+        assert run(p) == base, f"{cls.__name__} forest @ {p} shards"
+    # and forest-under-mesh equals the dense engine on the same mesh
+    ctx = StreamContext(mesh=make_mesh(8))
+    stream = SimpleEdgeStream(edges, window=CountWindow(16), context=ctx)
+    dense = [str(e) for e in stream.aggregate(cls(carry="dense"))]
+    assert base == dense
