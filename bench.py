@@ -1251,11 +1251,29 @@ def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
                 binp, lambda: datasets.IdentityDict(bound), n_edges, window=w
             )
 
+    doc = {
+        "note": note or "default backend",
+        "corpus": path,
+        "n_edges": n_edges,
+        "baseline_compiled_binary": base,
+        "flink_proxy": flink,
+    }
+
+    def _flush():
+        # partial artifact after every expensive phase: a runner timeout
+        # mid-northstar must still leave committed evidence
+        with open(artifact, "w") as f:
+            json.dump(dict(doc, partial=True), f, indent=2)
+
     log(f"northstar: {n_edges} edges; 1M-edge windows...")
     e2e = run_e2e(WINDOW)
     assert e2e["components"] == base["components"], (
         e2e["components"], base["components"]
     )
+    doc["window_1m"] = e2e
+    doc["vs_baseline"] = round(e2e["eps"] / base["eps"], 2)
+    doc["vs_flink"] = round(e2e["eps"] / flink["eps"], 2)
+    _flush()
     e2e_ident = None
     if device_encode:
         # the identity-mapping variant keeps compact columns host-visible,
@@ -1272,31 +1290,23 @@ def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
         assert e2e_ident["components"] == base["components"], (
             e2e_ident["components"], base["components"]
         )
+        doc["window_1m_identity"] = e2e_ident
+        _flush()
     log("northstar: one 100M-edge window...")
     mega = run_e2e(max(n_edges, 100_000_000))
     assert mega["components"] == base["components"], (
         mega["components"], base["components"]
     )
-    out = {
-        "note": note or "default backend",
-        "corpus": path,
-        "n_edges": n_edges,
-        "window_1m": e2e,
-        "window_1m_identity": e2e_ident,
-        "window_100m": mega,
-        "baseline_compiled_binary": base,
-        "flink_proxy": flink,
-        # BASELINE.md's north-star config IS the 100M-edge window; the
-        # 1M-window series is the latency-oriented configuration
-        "vs_baseline": round(e2e["eps"] / base["eps"], 2),
-        "vs_flink": round(e2e["eps"] / flink["eps"], 2),
-        "vs_baseline_100m": round(mega["eps"] / base["eps"], 2),
-        "vs_flink_100m": round(mega["eps"] / flink["eps"], 2),
-    }
+    doc["window_1m_identity"] = e2e_ident
+    doc["window_100m"] = mega
+    # BASELINE.md's north-star config IS the 100M-edge window; the
+    # 1M-window series is the latency-oriented configuration
+    doc["vs_baseline_100m"] = round(mega["eps"] / base["eps"], 2)
+    doc["vs_flink_100m"] = round(mega["eps"] / flink["eps"], 2)
     with open(artifact, "w") as f:
-        json.dump(out, f, indent=2)
-    log(f"northstar: {json.dumps(out)}")
-    return out
+        json.dump(doc, f, indent=2)
+    log(f"northstar: {json.dumps(doc)}")
+    return doc
 
 
 def _parse_sub(out_text: str):
@@ -1451,6 +1461,26 @@ def main():
             )
             if out.returncode != 0:
                 log(out.stderr[-500:])
+        # latency/throughput window-size curve on the CPU backend (the
+        # windowed carries made small windows viable here too; the curve
+        # records which carry each point ran)
+        binp = info["binp"]
+        curve = []
+        for wexp in (10, 12, 14, 16, 18, 20):
+            log(f"cpu run: latency_curve window=2^{wexp}...")
+            out = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.config.update('jax_platforms','cpu'); "
+                 "import bench, json; "
+                 f"print(json.dumps(bench.bench_latency_window({binp!r}, "
+                 f"{bound}, {1 << wexp})))"],
+                capture_output=True, text=True, timeout=1800,
+            )
+            if out.returncode == 0:
+                curve.append(_parse_sub(out.stdout))
+            else:
+                log(out.stderr[-500:])
+        doc["latency_curve"] = curve
         with open("BENCH_CPU.json", "w") as f:
             json.dump(doc, f, indent=2)
         log(f"cpu run: {json.dumps(doc)}")
@@ -1504,6 +1534,15 @@ def main():
             "flink_proxy": flink,
             "corpus": path,
         }
+        def _flush():
+            # written INCREMENTALLY: the on-up runner caps --all at 3 h,
+            # and a tunnel that slows mid-run must still leave a partial
+            # committed artifact instead of nothing (round-5 hardening)
+            detail["partial"] = True
+            with open("BENCH_DETAIL.json", "w") as f:
+                json.dump(detail, f, indent=2)
+
+        _flush()
         n_vertices = 1 << 18
         window = 1 << 18
         n_e = window * 8
@@ -1581,6 +1620,7 @@ def main():
             else:
                 detail[key] = None
                 log(out.stderr[-500:])
+            _flush()
         # latency/throughput curve: window size sweep, one subprocess per
         # point (same discipline); quantifies the micro-batch trade
         curve = []
@@ -1597,7 +1637,8 @@ def main():
                 curve.append(_parse_sub(out.stdout))
             else:
                 log(out.stderr[-500:])
-        detail["latency_curve"] = curve
+            detail["latency_curve"] = curve
+            _flush()
         # roofline: ONE KERNEL PER SUBPROCESS (the same in-process
         # degradation discipline as the configs above)
         roof = {}
@@ -1614,7 +1655,9 @@ def main():
                 roof.update(json.loads(out.stdout.strip().splitlines()[-1]))
             else:
                 log(out.stderr[-500:])
-        detail["roofline"] = roof
+            detail["roofline"] = roof
+            _flush()
+        detail.pop("partial", None)
         with open("BENCH_DETAIL.json", "w") as f:
             json.dump(detail, f, indent=2)
         log(f"detail: {json.dumps(detail)}")

@@ -101,8 +101,11 @@ class IterativeConnectedComponents:
         self._mode = None  # None | "incremental" | "diff"
 
     # ------------------------------------------------------------------ #
-    def _try_incremental(self) -> bool:
-        if self._mesh is not None:
+    def _try_incremental(self, stream) -> bool:
+        # a mesh from EITHER the constructor or the stream context means
+        # sharded execution was requested — the host path would silently
+        # bypass it (and recreate per-host the carry the mesh avoids)
+        if self._agg._resolve_mesh(stream) is not None:
             return False
         try:
             from .. import native
@@ -237,7 +240,7 @@ class IterativeConnectedComponents:
                 if self._mode is None:
                     self._mode = (
                         "incremental"
-                        if cache is not None and self._try_incremental()
+                        if cache is not None and self._try_incremental(stream)
                         else "diff"
                     )
                     if self._mode == "diff":
@@ -261,7 +264,10 @@ class IterativeConnectedComponents:
         rest = (
             chain([pending], blocks) if pending is not None else blocks
         )
-        shim = SimpleEdgeStream(_blocks=lambda: rest, _vdict=vdict)
+        shim = SimpleEdgeStream(
+            _blocks=lambda: rest, _vdict=vdict,
+            context=stream.get_context(),
+        )
         for comps in self._agg.run(shim):
             new_labels: Dict[int, int] = {}
             for root, members in comps.components.items():

@@ -194,3 +194,26 @@ def test_incremental_downgrades_midstream_and_negative_ids():
     # carried label 10 — no correction for it)
     assert out2 == [[(12, 10), (13, 10)]]
     assert icc2.labels() == {10: 10, 11: 10, 12: 10, 13: 10}
+
+
+def test_context_mesh_routes_to_sharded_diff_path():
+    """A mesh supplied via StreamContext (the repo's standard sharding
+    pattern) must route iterative CC to the sharded summary-diff engine,
+    not the single-host incremental path (round-5 review finding)."""
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream, StreamContext
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.parallel.mesh import make_mesh
+
+    edges = [(1, 2, 0.0), (2, 3, 0.0), (8, 9, 0.0)]
+    ctx = StreamContext(mesh=make_mesh(4))
+    icc = IterativeConnectedComponents()
+    out = [list(ch) for ch in icc.run(
+        SimpleEdgeStream(edges, window=CountWindow(1), context=ctx)
+    )]
+    assert icc._mode == "diff"
+    icc2 = IterativeConnectedComponents()
+    out2 = [list(ch) for ch in icc2.run(
+        SimpleEdgeStream(edges, window=CountWindow(1))
+    )]
+    assert icc2._mode == "incremental"
+    assert out == out2
