@@ -233,3 +233,52 @@ def test_property_streams_on_device_transformed_blocks(sample_edges):
     assert all(
         b._range is None for b in cbatches if isinstance(b, LazyCountRange)
     )
+
+
+def test_vertex_aggregate_map_case():
+    """The reference's second aggregate overload
+    (``SimpleEdgeStream.java:489-494``): edge flatMap -> keyed vertex
+    records -> per-record map. Map case (one record per edge): emit the
+    source vertex with its edge value doubled."""
+    import jax.numpy as jnp
+
+    edges = [(1, 2, 10.0), (3, 4, 20.0), (1, 4, 30.0)]
+    stream = SimpleEdgeStream(edges, window=CountWindow(2))
+
+    def edge_mapper(s, d, v):
+        return (s, v), jnp.bool_(True)
+
+    def vertex_mapper(key, val):
+        return (key, val * 2.0)
+
+    out = [
+        (int(k), float(v))
+        for k, v in stream.vertex_aggregate(edge_mapper, vertex_mapper)
+    ]
+    assert out == [(1, 20.0), (3, 40.0), (1, 60.0)]
+
+
+def test_vertex_aggregate_flatmap_case():
+    """0..n emission per edge (the Flink edgeMapper is a FlatMapFunction):
+    emit BOTH endpoints for edges above a value threshold, neither below."""
+    import jax.numpy as jnp
+
+    edges = [(1, 2, 5.0), (3, 4, 50.0), (5, 6, 7.0), (7, 8, 70.0)]
+    stream = SimpleEdgeStream(edges, window=CountWindow(4))
+
+    def edge_mapper(s, d, v):
+        keys = jnp.stack([s, d])
+        vals = jnp.stack([v, v])
+        emit = jnp.stack([v > 10.0, v > 10.0])
+        return (keys, vals), emit
+
+    def vertex_mapper(key, val):
+        return (key, val)
+
+    out = [
+        (int(k), float(v))
+        for k, v in stream.vertex_aggregate(
+            edge_mapper, vertex_mapper, max_out=2
+        )
+    ]
+    assert out == [(3, 50.0), (4, 50.0), (7, 70.0), (8, 70.0)]
