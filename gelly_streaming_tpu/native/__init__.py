@@ -163,6 +163,12 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.cuf_flatten.argtypes = [ctypes.c_void_p, pi32a, i64]
             lib.cuf_load.restype = i64
             lib.cuf_load.argtypes = [ctypes.c_void_p, pi32a, i64]
+            lib.wprep_create.restype = ctypes.c_void_p
+            lib.wprep_destroy.argtypes = [ctypes.c_void_p]
+            lib.wprep_run.restype = i64
+            lib.wprep_run.argtypes = [
+                ctypes.c_void_p, pi32a, pi32a, i64, i64, pi32a, pi32a, pi32a,
+            ]
             _lib = lib
         except Exception:
             _lib_failed = True
@@ -592,6 +598,50 @@ class CompactUnionFind:
         h = getattr(self, "_h", None)
         if lib is not None and h:
             lib.cuf_destroy(h)
+
+
+class NativeWindowPrep:
+    """Single-pass touched-set + local-renumbering for the forest CC
+    carry (``ingest.cpp: wprep_*``): epoch-stamped, no clearing, cost
+    scales with the window alone. ``run(src, dst, vcap)`` returns
+    ``(tids, lu, lv)`` with touched ids in ARRIVAL order. Raises
+    ``RuntimeError`` at construction when the toolchain is unavailable
+    (callers keep the numpy bitmap+LUT path)."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native toolchain unavailable")
+        self._lib = lib
+        self._h = lib.wprep_create()
+        if not self._h:
+            raise RuntimeError("wprep_create failed")
+        self._tbuf = np.zeros(1024, np.int32)
+        self._lu = np.zeros(512, np.int32)
+        self._lv = np.zeros(512, np.int32)
+
+    def run(self, src: np.ndarray, dst: np.ndarray, vcap: int):
+        src = np.ascontiguousarray(src, np.int32)
+        dst = np.ascontiguousarray(dst, np.int32)
+        n = src.size
+        if self._tbuf.size < 2 * n:
+            self._tbuf = np.zeros(max(2 * n, 1024), np.int32)
+        if self._lu.size < max(n, 1):
+            self._lu = np.zeros(n, np.int32)
+            self._lv = np.zeros(n, np.int32)
+        t = self._lib.wprep_run(
+            self._h, src, dst, n, int(vcap),
+            self._tbuf, self._lu, self._lv,
+        )
+        if t < 0:
+            raise ValueError("edge ids out of range for vcap")
+        return self._tbuf[:t].copy(), self._lu[:n].copy(), self._lv[:n].copy()
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.wprep_destroy(h)
 
 
 class NativeEncoder:

@@ -240,3 +240,37 @@ def test_novelty_bitmap_native_matches_fallback():
     s = np.array([0, 1, 2, 3, 0, 1], np.int32)
     d = np.array([4, 5, 6, 7, 4, 5], np.int32)
     assert nat.novel2(s, d) == fb.novel2(s, d)
+
+
+def test_native_window_prep_matches_numpy_fallback():
+    """NativeWindowPrep (single-pass epoch-stamped touched set) must
+    produce the same touched SET and a consistent local renumbering as
+    the numpy bitmap+LUT fallback; order may differ (arrival vs sorted),
+    which the forest kernels are insensitive to."""
+    import numpy as np
+    import pytest
+
+    from gelly_streaming_tpu import native
+
+    try:
+        prep = native.NativeWindowPrep()
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(3)
+    for trial in range(3):
+        V = int(rng.integers(16, 500))
+        n = int(rng.integers(1, 400))
+        src = rng.integers(0, V, n).astype(np.int32)
+        dst = rng.integers(0, V, n).astype(np.int32)
+        tids, lu, lv = prep.run(src, dst, V)
+        # renumbering consistency: tids[local] round-trips the columns
+        assert np.array_equal(tids[lu], src)
+        assert np.array_equal(tids[lv], dst)
+        # touched set equality with the bitmap truth
+        bm = np.zeros(V, bool)
+        bm[src] = True
+        bm[dst] = True
+        assert np.array_equal(np.sort(tids), np.nonzero(bm)[0])
+        # ids out of range raise
+        with pytest.raises(ValueError):
+            prep.run(np.array([V], np.int32), np.array([0], np.int32), V)
