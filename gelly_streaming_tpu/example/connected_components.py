@@ -87,11 +87,14 @@ def run_corpus(
     window_size: int = 1 << 20,
     device_encode: bool = False,
     id_bound: int = 0,
+    carry: str = "auto",
 ):
     """Stream a BASELINE corpus (by registry name or file path) through
     the flagship workload — the measured end-to-end path of bench.py as a
     runnable CLI. ``device_encode`` moves the vertex mapping onto the
-    accelerator (dense-id corpora; pass the id bound)."""
+    accelerator (dense-id corpora; pass the id bound); ``carry`` pins the
+    CC carry strategy (auto/forest/host/dense —
+    ``library/connected_components.py``)."""
     from .. import datasets
 
     if name_or_path in datasets.CORPORA:
@@ -105,21 +108,34 @@ def run_corpus(
     stream = datasets.stream_file(
         path, window=CountWindow(window_size), **kw
     )
-    last = _drain(stream)
+    import time
+
+    agg = ConnectedComponents(carry=carry)
+    last = None
+    t0 = time.perf_counter()
+    for comps in stream.aggregate(agg):
+        last = comps
+    runtime_ms = (time.perf_counter() - t0) * 1000
+    _emit(last, None, runtime_ms)
     if last is not None:
-        print(f"components: {len(last.components)}")
+        print(f"components: {len(last.components)} (carry: {agg._cc_mode})")
     return last
 
 
 def main(args: List[str]) -> None:
     if args and args[0] == "--corpus":
-        # connected_components --corpus livejournal [window] [--device-encode id_bound]
+        # connected_components --corpus livejournal [window]
+        #   [--device-encode id_bound] [--carry auto|forest|host|dense]
         rest = args[1:]
         name = rest[0] if rest else "livejournal"
         window = int(rest[1]) if len(rest) > 1 and rest[1].isdigit() else 1 << 20
         dev = "--device-encode" in rest
         bound = int(rest[rest.index("--device-encode") + 1]) if dev else 0
-        run_corpus(name, window, device_encode=dev, id_bound=bound)
+        carry = (
+            rest[rest.index("--carry") + 1] if "--carry" in rest else "auto"
+        )
+        run_corpus(name, window, device_encode=dev, id_bound=bound,
+                   carry=carry)
         return
     if args:
         usage_line = (

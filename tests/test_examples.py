@@ -248,3 +248,21 @@ def test_spanner_example_device_flag(tmp_path):
             u, v = map(int, line.split())
             got.add((min(u, v), max(u, v)))
     assert_valid_spanner([(int(a), int(b)) for a, b in pairs], got, 2)
+
+
+def test_cc_corpus_carry_flag(tmp_path, capsys):
+    """--carry pins the CC carry strategy from the CLI; every carry
+    produces the same components on the same corpus."""
+    from gelly_streaming_tpu.example import connected_components as ex
+
+    p = tmp_path / "e.txt"
+    p.write_text("1 2\n2 3\n8 9\n")
+    outs = {}
+    for carry in ("forest", "host", "dense"):
+        ex.main(["--corpus", str(p), "2", "--carry", carry])
+        got = capsys.readouterr().out
+        assert f"(carry: {carry})" in got
+        outs[carry] = [
+            ln for ln in got.splitlines() if "=" in ln and "[" in ln
+        ]
+    assert outs["forest"] == outs["host"] == outs["dense"]
