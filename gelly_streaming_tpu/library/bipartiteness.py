@@ -3,23 +3,64 @@
 Replaces ``library/BipartitenessCheck.java:39-133`` + its ``Candidates``
 merge machinery with CC over the signed double cover (see
 ``summaries/candidates.py``): bipartite iff no vertex's (+) and (-) cover
-nodes share a component. The update/combine are the same dense label kernels
-as CC, over a 2*vcap table; emission reproduces the reference's
-``(true,{...})`` / ``(false,{})`` output format.
+nodes share a component.
+
+Two carries (``carry=`` option, default ``auto``):
+
+- **Cover forest** (auto default on the single-device ingest path): the
+  round-5 window-local treatment — a pointer forest over the 2*vcap
+  cover ids updated by window-sized kernels, with the odd-cycle latch
+  computed in-step from the touched lanes' sibling roots and carried on
+  device (zero mid-stream D2H; the cover component containing a
+  conflict is sign-symmetric, so touched lanes alone witness every new
+  conflict). Per-window cost scales with the window, not the vertex
+  space — the same redesign that took CC from 0.45x to 2.4x the
+  compiled baseline on the CPU bracket.
+- **Dense cover labels**: the full-table fixpoint + pointer-graph
+  combine, used under a sharded mesh and for device-transformed streams
+  (the forest's touched set is host-computed). Downgrade is one
+  canonicalization; checkpoints share one format (flat cover labels +
+  touched), so the carries are cross-restorable.
+
+Emission reproduces the reference's ``(true,{...})`` / ``(false,{})``
+output format in both carries.
 """
 
 from __future__ import annotations
 
+from typing import Any, Iterator, Optional
+
 import jax.numpy as jnp
+import numpy as np
 
 from ..aggregate.summary import SummaryBulkAggregation
-from ..summaries.candidates import Candidates, cover_fold, cover_grow, init_cover
+from ..summaries.candidates import (
+    Candidates,
+    cover_fold,
+    cover_forest_window,
+    cover_grow,
+    cover_grow_forest,
+    init_cover,
+)
+from ..summaries.forest import TouchLog, WindowPrep, resolve_flat, resolve_flat_host
 from ..summaries.labels import label_combine
 
 
 class BipartitenessCheck(SummaryBulkAggregation):
     """Single-pass bipartiteness (``library/BipartitenessCheck.java``)."""
 
+    def __init__(self, *args, carry: str = "auto", **kwargs):
+        super().__init__(*args, **kwargs)
+        if carry not in ("auto", "forest", "dense"):
+            raise ValueError(f"carry must be auto/forest/dense, got {carry!r}")
+        self.carry = carry
+        self._bp_mode = None  # None | "forest" | "dense"
+        self._canon = None    # cover forest int32[2*vcap]
+        self._failed = None   # device bool latch
+        self._log = None      # host TouchLog over COVER ids
+        self._prep = None
+
+    # ---- dense-engine hooks (mesh / device-transformed fallback) ---- #
     def initial_state(self, vcap: int):
         return init_cover(max(1, vcap))
 
@@ -39,3 +80,107 @@ class BipartitenessCheck(SummaryBulkAggregation):
 
     def transform(self, state, vdict) -> Candidates:
         return Candidates.from_cover(state, self.infer_vcap(state), vdict)
+
+    # ---- cover-forest run loop (round 5) ---- #
+    def run(self, stream) -> Iterator[Candidates]:
+        mesh = self._resolve_mesh(stream)
+        vdict = stream.vertex_dict
+        for block in stream.blocks():
+            cache = getattr(block, "_host_cache", None)
+            if (
+                mesh is not None
+                or cache is None
+                or self.carry == "dense"
+                or self._bp_mode == "dense"
+            ):
+                if self._bp_mode == "forest":
+                    self._to_dense()
+                self._bp_mode = "dense"
+                self._device_block(block, mesh)
+                self._sync_ref = self._summary
+                yield self.transform(self._summary, vdict)
+            else:
+                self._bp_mode = "forest"
+                self._ensure_forest(block.n_vertices)
+                self._canon, self._failed, tids = cover_forest_window(
+                    self._canon, self._failed, cache[0], cache[1],
+                    self._vcap, self._prep,
+                )
+                # the log tracks BASE ids only; the negative cover half
+                # derives as base + vcap at emission/checkpoint time, so
+                # growth never needs a log rebuild and held emissions
+                # cannot leak grown ids into the negative half
+                self._log.add(tids)
+                self._summary = {"labels": self._canon}
+                self._sync_ref = (self._canon, self._failed)
+                yield Candidates.from_forest(
+                    self._canon, self._failed, self._log, self._log.count,
+                    self._vcap, vdict,
+                )
+            if self.transient_state:
+                self._reset_transient()
+
+    def _ensure_forest(self, vcap: int) -> None:
+        if self._canon is None:
+            if self._summary is not None and "touched" in self._summary:
+                # restored (or converted) dense state: flat cover labels
+                # ARE a valid forest; the latch recomputes from the truth
+                lab = np.asarray(self._summary["labels"])
+                tch = np.asarray(self._summary["touched"])
+                self._vcap = len(lab) // 2
+                self._canon = jnp.asarray(lab.astype(np.int32))
+                self._log = TouchLog(self._vcap)
+                base = np.nonzero(tch[: self._vcap])[0].astype(np.int32)
+                self._log.add(base)
+                flat = resolve_flat_host(lab.astype(np.int32))
+                self._failed = jnp.bool_(
+                    bool(np.any(flat[base] == flat[base + self._vcap]))
+                    if len(base) else False
+                )
+            else:
+                self._vcap = vcap
+                self._canon = jnp.arange(2 * vcap, dtype=jnp.int32)
+                self._failed = jnp.bool_(False)
+                self._log = TouchLog(vcap)
+            self._prep = WindowPrep()
+        if vcap > self._vcap:
+            self._canon = cover_grow_forest(self._canon, self._vcap, vcap)
+            # base-only log: base ids never shift on growth
+            self._vcap = vcap
+        self._log.grow(self._vcap)
+
+    def _to_dense(self) -> None:
+        flat = resolve_flat(self._canon)
+        touched2 = np.zeros(2 * self._vcap, bool)
+        touched2[: self._vcap] = self._log.touched_bool(self._vcap)
+        self._summary = {"labels": flat, "touched": jnp.asarray(touched2)}
+        self._canon = None
+        self._failed = None
+        self._log = None
+        self._prep = None
+
+    def _reset_transient(self) -> None:
+        if self._bp_mode == "forest":
+            self._canon = jnp.arange(2 * self._vcap, dtype=jnp.int32)
+            self._failed = jnp.bool_(False)
+            self._log = TouchLog(self._vcap)
+            self._summary = {"labels": self._canon}
+        else:
+            self._summary = self.initial_state(self._vcap)
+
+    # ---- checkpoint surface: one format for both carries ---- #
+    def snapshot_state(self) -> Any:
+        if self._bp_mode == "forest":
+            lab = resolve_flat_host(np.asarray(self._canon))
+            touched2 = np.zeros(2 * self._vcap, bool)
+            touched2[: self._vcap] = self._log.touched_bool(self._vcap)
+            return {"labels": lab, "touched": touched2}
+        return super().snapshot_state()
+
+    def restore_state(self, state: Any, vcap: Optional[int] = None) -> None:
+        super().restore_state(state, vcap)
+        self._bp_mode = None
+        self._canon = None
+        self._failed = None
+        self._log = None
+        self._prep = None

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .forest import chase_and_group, commit_roots
 from .labels import _propagate, init_labels
 
 
@@ -56,24 +57,133 @@ def cover_fold(
     return {"labels": labels, "touched": touched}
 
 
-def cover_grow(state: Dict[str, jax.Array], old_vcap: int, new_vcap: int) -> Dict[str, jax.Array]:
-    """Re-index the cover when the vertex capacity bucket grows.
-
-    Cover node (v,-) moves from v+old_vcap to v+new_vcap, and label *values*
-    pointing into the negative half must shift by the same amount.
-    """
-    if new_vcap <= old_vcap:
-        return state
-    lab = np.asarray(state["labels"])
-    tch = np.asarray(state["touched"])
+def _shift_cover_labels(lab: np.ndarray, old_vcap: int, new_vcap: int) -> np.ndarray:
+    """The cover re-indexing rule, shared by BOTH carries (divergence here
+    would break their cross-restorable checkpoints): cover node (v,-)
+    moves from v+old to v+new, and label/pointer VALUES into the negative
+    half shift by the same amount."""
     new_lab = np.arange(2 * new_vcap, dtype=np.int32)
-    new_tch = np.zeros(2 * new_vcap, dtype=bool)
     shifted = np.where(lab >= old_vcap, lab - old_vcap + new_vcap, lab)
     new_lab[:old_vcap] = shifted[:old_vcap]
     new_lab[new_vcap : new_vcap + old_vcap] = shifted[old_vcap:]
+    return new_lab
+
+
+def cover_grow(state: Dict[str, jax.Array], old_vcap: int, new_vcap: int) -> Dict[str, jax.Array]:
+    """Re-index the cover when the vertex capacity bucket grows
+    (see :func:`_shift_cover_labels`)."""
+    if new_vcap <= old_vcap:
+        return state
+    tch = np.asarray(state["touched"])
+    new_lab = _shift_cover_labels(np.asarray(state["labels"]), old_vcap, new_vcap)
+    new_tch = np.zeros(2 * new_vcap, dtype=bool)
     new_tch[:old_vcap] = tch[:old_vcap]
     new_tch[new_vcap : new_vcap + old_vcap] = tch[old_vcap:]
     return {"labels": jnp.asarray(new_lab), "touched": jnp.asarray(new_tch)}
+
+
+#: jitted cover window steps, keyed (tcap, wcap, vcap); bounded FIFO
+_COVER_STEP_CACHE: dict = {}
+_COVER_STEP_CACHE_MAX = 32
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+def _cover_step_fn(tcap: int, wcap: int, vcap: int):
+    """Window-local signed-cover step (round 5): the forest CC step
+    (``summaries/forest.py``) over the 2*vcap cover id space, plus the
+    bipartiteness conflict latch.
+
+    Layout: the touched bucket holds the window's base touched set twice
+    — lane i is cover node (t_i, +) = t_i and lane i + tcap is
+    (t_i, -) = t_i + vcap — so a lane's sibling is at a fixed offset.
+    CONFLICT COMPLETENESS: a new odd cycle means some vertex's two cover
+    nodes connect THIS window; the merged cover component is then
+    sign-symmetric, so every touched member's sibling lies in the same
+    component — checking ``final_root[i] == final_root[i + tcap]`` over
+    the touched lanes alone misses nothing. The latch carries on device
+    (monotone OR), so the producer loop stays zero-D2H.
+    """
+    key = (tcap, wcap, vcap)
+    fn = _COVER_STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    tcap2, vcap2 = 2 * tcap, 2 * vcap
+
+    def step(canon, failed, tid, tmask, lu, lv, emask):
+        # cover touched bucket + cover edges, derived in-graph from the
+        # base prep (no extra host pass): (u,+)~(v,-) and (u,-)~(v,+).
+        # UNLIKE the plain CC forest step, pad rows need a real mask: a
+        # pad (0,0) is a harmless self-loop in base space but maps to
+        # (0,+)~(0,-) in the cover — a fabricated odd cycle.
+        tid2 = jnp.concatenate([tid, tid + vcap])
+        tmask2 = jnp.concatenate([tmask, tmask])
+        lu2 = jnp.concatenate([lu, lu + tcap])
+        lv2 = jnp.concatenate([lv + tcap, lv])
+        emask2 = jnp.concatenate([emask, emask])
+        r, v2, key_, iota = chase_and_group(canon, tid2, tmask2, tcap2, vcap2)
+        u = jnp.concatenate([lu2, iota])
+        w = jnp.concatenate([lv2, v2])
+        m = jnp.concatenate([emask2, jnp.ones(tcap2, bool)])
+        local = _propagate(iota, u, w, m)
+        canon, nr = commit_roots(
+            canon, local, key_, r, tid2, tmask2, tcap2, vcap2
+        )
+        # sibling conflict over the touched lanes (see docstring)
+        conflict = jnp.any(
+            tmask & (nr[:tcap] == nr[tcap:])
+        )
+        return canon, failed | conflict
+
+    fn = jax.jit(step)
+    if len(_COVER_STEP_CACHE) >= _COVER_STEP_CACHE_MAX:
+        _COVER_STEP_CACHE.pop(next(iter(_COVER_STEP_CACHE)))
+    _COVER_STEP_CACHE[key] = fn
+    return fn
+
+
+def cover_forest_window(canon, failed, src_h, dst_h, vcap: int, prep):
+    """Fold one window (host base columns) into the cover forest.
+    Returns ``(canon, failed, base_touched_ids)``."""
+    from ..core.edgeblock import bucket_capacity
+
+    n = len(src_h)
+    if n == 0:
+        return canon, failed, np.zeros(0, np.int32)
+    tids, lu_r, lv_r = prep.prep(src_h, dst_h, vcap)
+    t = len(tids)
+    tcap = bucket_capacity(t, minimum=8)
+    wcap = bucket_capacity(n, minimum=8)
+    tid = np.zeros(tcap, np.int32)
+    tid[:t] = tids
+    tmask = np.zeros(tcap, bool)
+    tmask[:t] = True
+    lu = np.zeros(wcap, np.int32)
+    lv = np.zeros(wcap, np.int32)
+    emask = np.zeros(wcap, bool)
+    lu[:n] = lu_r
+    lv[:n] = lv_r
+    emask[:n] = True
+    step = _cover_step_fn(tcap, wcap, vcap)
+    canon, failed = step(
+        canon, failed,
+        jnp.asarray(tid), jnp.asarray(tmask),
+        jnp.asarray(lu), jnp.asarray(lv), jnp.asarray(emask),
+    )
+    return canon, failed, tids
+
+
+def cover_grow_forest(canon, old_vcap: int, new_vcap: int):
+    """Re-index the cover forest when the vertex capacity bucket grows
+    (one host rebuild per pow2 growth event, same cost shape and SAME
+    rule as the dense ``cover_grow`` — see :func:`_shift_cover_labels`;
+    a pointer forest re-indexes exactly like flat labels)."""
+    if new_vcap <= old_vcap:
+        return canon
+    return jnp.asarray(
+        _shift_cover_labels(np.asarray(canon), old_vcap, new_vcap)
+    )
 
 
 class Candidates:
@@ -86,9 +196,51 @@ class Candidates:
     vertex's sign = (same cover side as the root).
     """
 
-    def __init__(self, success: bool, components: Dict[int, Dict[int, bool]]):
-        self.success = success
-        self.components = components
+    def __init__(self, success=None, components=None, *, _lazy=None):
+        self._success = success
+        self._components = components
+        # (canon_dev, failed_dev, touch_log, count, vcap, vdict): forest-
+        # carry emission — one device read + host canonicalization on
+        # first access, so unread windows cost nothing
+        self._lazy = _lazy
+
+    def _mat(self) -> None:
+        if self._lazy is None:
+            return
+        from .forest import resolve_flat_host
+
+        canon, failed, log, count, vcap, vdict = self._lazy
+        lab_np, failed_np = jax.device_get((canon, failed))
+        self._lazy = None
+        if bool(failed_np):
+            self._success, self._components = False, {}
+            return
+        lab = resolve_flat_host(np.asarray(lab_np))
+        # the log holds BASE ids only (< vcap at snapshot time); the
+        # negative cover half derives as base + vcap, and from_cover only
+        # reads the base half of the mask — so a dict that grew past the
+        # snapshot's vcap cannot push ids into the negative half (a held
+        # emission stays a valid snapshot)
+        touched = np.zeros(2 * vcap, bool)
+        touched[np.asarray(log.ids[:count])] = True
+        c = Candidates.from_cover(
+            {"labels": lab, "touched": touched}, vcap, vdict
+        )
+        self._success, self._components = c.success, c.components
+
+    @property
+    def success(self) -> bool:
+        self._mat()
+        return self._success
+
+    @property
+    def components(self) -> Dict[int, Dict[int, bool]]:
+        self._mat()
+        return self._components
+
+    @staticmethod
+    def from_forest(canon, failed, log, count, vcap, vdict) -> "Candidates":
+        return Candidates(_lazy=(canon, failed, log, count, vcap, vdict))
 
     def __bool__(self) -> bool:
         """Truthiness == the bipartiteness verdict (``success``): a

@@ -79,6 +79,56 @@ def _table_combine(tcap: int):
     return combine
 
 
+def chase_and_group(canon, tid, tmask, tcap: int, vcap: int):
+    """Shared forest-step front half (CC + signed-cover carries).
+
+    1. Chase touched pointers to their current roots. Read-only on
+       canon, so chains are static during the chase; roots satisfy
+       canon[r] == r and chains strictly decrease (min-root invariant)
+       so the loop terminates. Padding lanes chase from 0, which is
+       always self-rooted (canon[0] <= 0).
+    2. "Same current root" constraints WITHOUT a sort (argsort over the
+       touched bucket measured 375 ms on the CPU backend): scatter each
+       lane's local index into a vcap scratch keyed by root, so every
+       lane learns its group's representative lane — one bandwidth-bound
+       memset+scatter+gather instead of a comparison sort. Edge
+       (i, rep_i) unifies the group; pads self-loop.
+
+    Returns ``(r, v2, key_, iota)``: current roots per lane, the group-
+    edge targets, the root-value keys (+inf on pads), and the lane iota.
+    """
+    r0 = jnp.where(tmask, canon[tid], 0)
+    r = lax.while_loop(
+        lambda r: jnp.any(canon[r] != r), lambda r: canon[r], r0
+    )
+    iota = jnp.arange(tcap, dtype=jnp.int32)
+    sid_r = jnp.where(tmask, r, vcap)
+    scratch = jnp.full(vcap, _I32_MAX, jnp.int32).at[sid_r].min(
+        jnp.where(tmask, iota, _I32_MAX), mode="drop"
+    )
+    rep = scratch[jnp.where(tmask, r, 0)]
+    v2 = jnp.where(tmask, rep, iota)
+    key_ = jnp.where(tmask, r, _I32_MAX)
+    return r, v2, key_, iota
+
+
+def commit_roots(canon, local, key_, r, tid, tmask, tcap: int, vcap: int):
+    """Shared forest-step back half: the merged component's new root is
+    the min of its members' old roots (each old root is the min id of
+    its old component, so the min over merged roots is the min id of the
+    merged component); re-root the old roots and path-compress the
+    touched lanes (pads dropped). Returns ``(canon, nr)`` — ``nr`` is
+    each lane's final root value (the cover carry's conflict latch reads
+    it)."""
+    minr = jnp.full(tcap, _I32_MAX, jnp.int32).at[local].min(key_)
+    nr = minr[local]
+    sid_r = jnp.where(tmask, r, vcap)
+    canon = canon.at[sid_r].set(nr, mode="drop")
+    tid_s = jnp.where(tmask, tid, vcap)
+    canon = canon.at[tid_s].set(nr, mode="drop")
+    return canon, nr
+
+
 def _forest_step_fn(tcap: int, wcap: int, vcap: int, mesh=None,
                     tree: bool = False, degree: int = 2):
     key = (tcap, wcap, vcap, mesh, tree, degree)
@@ -96,36 +146,15 @@ def _forest_step_fn(tcap: int, wcap: int, vcap: int, mesh=None,
         combine = _table_combine(tcap)
 
     def step(canon, tid, tmask, lu, lv):
-        # 1. chase touched pointers to their current roots. Read-only on
-        # canon, so chains are static during the chase; roots satisfy
-        # canon[r] == r and chains strictly decrease (min-root invariant)
-        # so the loop terminates. Padding lanes chase from 0, which is
-        # always self-rooted (canon[0] <= 0).
-        r0 = jnp.where(tmask, canon[tid], 0)
-        r = lax.while_loop(
-            lambda r: jnp.any(canon[r] != r), lambda r: canon[r], r0
-        )
-        # 2. "same current root" constraints WITHOUT a sort (argsort over
-        # the touched bucket measured 375 ms on the CPU backend): scatter
-        # each lane's local index into a vcap scratch keyed by root, so
-        # every lane learns its group's representative lane — one
-        # bandwidth-bound memset+scatter+gather instead of a comparison
-        # sort. Edge (i, rep_i) unifies the group; pads self-loop.
-        iota = jnp.arange(tcap, dtype=jnp.int32)
-        sid_r = jnp.where(tmask, r, vcap)
-        scratch = jnp.full(vcap, _I32_MAX, jnp.int32).at[sid_r].min(
-            jnp.where(tmask, iota, _I32_MAX), mode="drop"
-        )
-        rep = scratch[jnp.where(tmask, r, 0)]
-        v2 = jnp.where(tmask, rep, iota)
-        # 3. local min-label fixpoint on the T-sized table (window edges
-        # + group edges; lu/lv pads are (0,0) self-loops, no mask
-        # needed). Under a mesh this is the engine's per-shard-fold +
-        # cross-shard-combine shape on WINDOW-SIZED tables: each shard
-        # folds its slice of the edge columns (the T-sized group edges
-        # replicate — same constraints everywhere), then the T-sized
-        # label tables merge through the bulk stack or the ppermute
-        # butterfly. The vcap-sized carry never crosses the mesh.
+        r, v2, key_, iota = chase_and_group(canon, tid, tmask, tcap, vcap)
+        # local min-label fixpoint on the T-sized table (window edges +
+        # group edges; lu/lv pads are (0,0) self-loops, no mask needed).
+        # Under a mesh this is the engine's per-shard-fold + cross-shard-
+        # combine shape on WINDOW-SIZED tables: each shard folds its
+        # slice of the edge columns (the T-sized group edges replicate —
+        # same constraints everywhere), then the T-sized label tables
+        # merge through the bulk stack or the ppermute butterfly. The
+        # vcap-sized carry never crosses the mesh.
         if mesh is None:
             u = jnp.concatenate([lu, iota])
             w = jnp.concatenate([lv, v2])
@@ -146,16 +175,7 @@ def _forest_step_fn(tcap: int, wcap: int, vcap: int, mesh=None,
                 P() if tree else P(EDGE_AXIS),
             )(lu, lv)
             local = out if tree else comm.stacked_reduce(out, p, combine)
-        # 4. merged component's new root = min of its members' old roots
-        # (each old root is the min id of its old component, so the min
-        # over merged roots is the min id of the merged component)
-        key_ = jnp.where(tmask, r, _I32_MAX)
-        minr = jnp.full(tcap, _I32_MAX, jnp.int32).at[local].min(key_)
-        nr = minr[local]
-        # 5. re-root old roots + path-compress touched (pads dropped)
-        canon = canon.at[sid_r].set(nr, mode="drop")
-        tid_s = jnp.where(tmask, tid, vcap)
-        canon = canon.at[tid_s].set(nr, mode="drop")
+        canon, _nr = commit_roots(canon, local, key_, r, tid, tmask, tcap, vcap)
         return canon
 
     fn = jax.jit(step)

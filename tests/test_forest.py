@@ -185,3 +185,163 @@ def test_downgrade_to_dense_midstream(carry):
     assert sorted(last.component_sets()) == sorted(
         [frozenset({0, 1, 2, 3}), frozenset({4, 5})]
     )
+
+
+# --------------------------------------------------------------------- #
+# Cover-forest bipartiteness (round 5)
+# --------------------------------------------------------------------- #
+def _bp(edges, window, carry):
+    from gelly_streaming_tpu.library import BipartitenessCheck
+
+    out = None
+    agg = BipartitenessCheck(carry=carry)
+    for out in _stream(edges, window).aggregate(agg):
+        pass
+    return out, agg
+
+
+def _py_bipartite(edges):
+    color = {}
+
+    def bfs(s):
+        from collections import deque
+
+        color[s] = 0
+        q = deque([s])
+        while q:
+            x = q.popleft()
+            for y in adj.get(x, ()):
+                if y not in color:
+                    color[y] = color[x] ^ 1
+                    q.append(y)
+                elif color[y] == color[x]:
+                    return False
+        return True
+
+    adj = {}
+    for a, b, *_ in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set()).add(a)
+    return all(bfs(v) for v in list(adj) if v not in color)
+
+
+@pytest.mark.parametrize("window", [1, 3, 16, 64])
+@pytest.mark.parametrize("seed", [1, 2, 5])
+def test_cover_forest_matches_dense_and_truth(window, seed):
+    rng = np.random.default_rng(seed)
+    edges = [
+        (int(a), int(b), 0.0)
+        for a, b in rng.integers(0, 24, size=(60, 2))
+        if a != b
+    ]
+    f_out, f_agg = _bp(edges, window, "forest")
+    d_out, d_agg = _bp(edges, window, "dense")
+    assert f_agg._bp_mode == "forest" and d_agg._bp_mode == "dense"
+    assert str(f_out) == str(d_out)
+    assert f_out.success == _py_bipartite(edges)
+
+
+def test_cover_forest_bipartite_star_and_odd_cycle():
+    star = [(0, i, 0.0) for i in range(1, 40)]
+    out, agg = _bp(star, 7, "forest")
+    assert out.success and agg._bp_mode == "forest"
+    # odd cycle arriving across several windows latches failure forever
+    cyc = star + [(1, 2, 0.0), (2, 3, 0.0), (3, 1, 0.0), (50, 51, 0.0)]
+    emissions = list(
+        _stream(cyc, 2).aggregate(
+            __import__(
+                "gelly_streaming_tpu.library", fromlist=["BipartitenessCheck"]
+            ).BipartitenessCheck(carry="forest")
+        )
+    )
+    assert emissions[-1].success is False
+    assert str(emissions[-1]) == "(false,{})"
+
+
+def test_cover_forest_growth_across_buckets():
+    """Vertex growth re-indexes the negative cover half (ids AND pointer
+    values shift) without corrupting components or the verdict."""
+    edges = [(i, i + 1, 0.0) for i in range(300)]  # even path: bipartite
+    out, agg = _bp(edges, 7, "forest")
+    assert out.success
+    assert agg._bp_mode == "forest"
+    # and a late odd cycle after several growth events still trips it
+    edges2 = edges + [(0, 299, 0.0)]  # 300-cycle: even -> still bipartite
+    out2, _ = _bp(edges2, 7, "forest")
+    assert out2.success
+    edges3 = edges + [(0, 298, 0.0)]  # odd cycle
+    out3, _ = _bp(edges3, 7, "forest")
+    assert not out3.success
+
+
+def test_cover_forest_checkpoint_cross_carry(tmp_path):
+    from gelly_streaming_tpu.aggregate import checkpoint
+    from gelly_streaming_tpu.core.window import Windower
+    from gelly_streaming_tpu.library import BipartitenessCheck
+
+    rng = np.random.default_rng(9)
+    edges = [
+        (int(a), int(b), 0.0)
+        for a, b in rng.integers(0, 20, size=(40, 2))
+        if a != b
+    ]
+    stream = _stream(edges, 5)
+    agg = BipartitenessCheck(carry="forest")
+    it = stream.aggregate(agg)
+    for _ in range(4):
+        next(it)
+    assert agg._bp_mode == "forest"
+    path = str(tmp_path / "bp")
+    checkpoint.save_aggregation(path, agg, stream.vertex_dict)
+
+    agg2 = BipartitenessCheck(carry="dense")
+    vdict = checkpoint.restore_aggregation(path, agg2)
+    wi = Windower(CountWindow(5), vdict)
+    cont = SimpleEdgeStream(
+        _blocks=lambda: wi.blocks(iter(edges[20:])), _vdict=vdict
+    )
+    last = None
+    for last in agg2.run(cont):
+        pass
+    assert last.success == _py_bipartite(edges)
+
+    # and forest restored FROM a dense checkpoint: the odd-cycle latch
+    # recomputes from the restored cover labels
+    agg3 = BipartitenessCheck(carry="dense")
+    it3 = _stream(edges, 5).aggregate(agg3)
+    for _ in range(4):
+        next(it3)
+    path2 = str(tmp_path / "bp2")
+    checkpoint.save_aggregation(path2, agg3, None)
+    agg4 = BipartitenessCheck(carry="forest")
+    agg4.restore_state(checkpoint.load_pytree(
+        path2, agg4.initial_state(agg3._vcap))[0])
+    from gelly_streaming_tpu.summaries.forest import resolve_flat_host
+
+    lab = np.asarray(agg3._summary["labels"])
+    flat = resolve_flat_host(lab)
+    vcap = len(lab) // 2
+    agg4._ensure_forest(vcap)
+    tch = np.asarray(agg3._summary["touched"])[:vcap]
+    base = np.nonzero(tch)[0]
+    expect_failed = bool(np.any(flat[base] == flat[base + vcap]))
+    assert bool(np.asarray(agg4._failed)) == expect_failed
+
+
+def test_cover_forest_held_emission_survives_dict_growth():
+    """Round-5 review crash repro: hold an early window's Candidates
+    emission, stream until the vertex dict grows past the snapshot's
+    vcap, then read it — the snapshot must materialize with its OWN
+    vcap/touched (base-only log), not the live dict size."""
+    from gelly_streaming_tpu.library import BipartitenessCheck
+
+    edges = [(i, i + 1, 0.0) for i in range(60)]  # path; grows buckets
+    agg = BipartitenessCheck(carry="forest")
+    emissions = list(_stream(edges, 2).aggregate(agg))
+    first = emissions[0]
+    # read LAST first (newest state), then the held EARLY emission
+    assert emissions[-1].success
+    assert first.success
+    assert str(first).startswith("(true,")
+    # the early snapshot reflects ITS window: only vertices 0..2 touched
+    assert set(first.components) == {0, 2} or set(first.components) == {0}
