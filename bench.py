@@ -673,6 +673,40 @@ def bench_weighted_e2e(binp: str, bound: int, n_edges: int) -> dict:
     }
 
 
+def bench_bipartiteness_e2e(binp: str, bound: int, n_edges: int,
+                            carry: str = "auto") -> dict:
+    """Streaming bipartiteness over the corpus (round-5 cover-forest
+    carry vs the dense cover engine — pass carry= to pin). Binary corpus
+    + identity mapping; syncs the carried cover state inside dt."""
+    import jax
+
+    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import BipartitenessCheck
+
+    def one_pass():
+        stream = datasets.stream_file(
+            binp, window=CountWindow(WINDOW),
+            vertex_dict=datasets.IdentityDict(bound), prefetch_depth=2,
+        )
+        agg = BipartitenessCheck(carry=carry)
+        t0 = time.perf_counter()
+        last = None
+        for last in agg.run(stream):
+            pass
+        jax.block_until_ready(agg._sync_ref)
+        dt = time.perf_counter() - t0
+        return {
+            "eps": n_edges / dt,
+            "bipartite": bool(last.success),
+            "carry": agg._bp_mode,
+        }
+
+    out, eps_all = median_steady(one_pass)
+    out["eps_all"] = eps_all
+    return out
+
+
 def bench_degrees(src, dst, n_vertices: int, window: int) -> dict:
     """Median-of-N; the carried ``deg`` makes every dispatch distinct
     (no memoization hazard), but each rep still times a disjoint span."""
@@ -1584,6 +1618,12 @@ def main():
             ("weighted_e2e",
              "import bench, json; "
              f"print(json.dumps(bench.bench_weighted_e2e({binp!r}, {bound}, {n_edges})))"),
+            ("bipartiteness_forest",
+             "import bench, json; "
+             f"print(json.dumps(bench.bench_bipartiteness_e2e({binp!r}, {bound}, {n_edges}, carry='forest')))"),
+            ("bipartiteness_dense",
+             "import bench, json; "
+             f"print(json.dumps(bench.bench_bipartiteness_e2e({binp!r}, {bound}, {n_edges}, carry='dense')))"),
             ("segmented_fold_eps",
              "import bench, json; "
              "print(json.dumps(bench.bench_segmented_fold()))"),
