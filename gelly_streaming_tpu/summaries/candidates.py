@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .forest import chase_and_group, commit_roots
+from .forest import chase_and_group, commit_roots, pad_window
 from .labels import _propagate, init_labels
 
 
@@ -146,24 +146,13 @@ def _cover_step_fn(tcap: int, wcap: int, vcap: int):
 def cover_forest_window(canon, failed, src_h, dst_h, vcap: int, prep):
     """Fold one window (host base columns) into the cover forest.
     Returns ``(canon, failed, base_touched_ids)``."""
-    from ..core.edgeblock import bucket_capacity
-
     n = len(src_h)
     if n == 0:
         return canon, failed, np.zeros(0, np.int32)
-    tids, lu_r, lv_r = prep.prep(src_h, dst_h, vcap)
-    t = len(tids)
-    tcap = bucket_capacity(t, minimum=8)
-    wcap = bucket_capacity(n, minimum=8)
-    tid = np.zeros(tcap, np.int32)
-    tid[:t] = tids
-    tmask = np.zeros(tcap, bool)
-    tmask[:t] = True
-    lu = np.zeros(wcap, np.int32)
-    lv = np.zeros(wcap, np.int32)
+    tids, tcap, wcap, tid, tmask, lu, lv = pad_window(
+        prep, src_h, dst_h, vcap
+    )
     emask = np.zeros(wcap, bool)
-    lu[:n] = lu_r
-    lv[:n] = lv_r
     emask[:n] = True
     step = _cover_step_fn(tcap, wcap, vcap)
     canon, failed = step(

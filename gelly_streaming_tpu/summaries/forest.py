@@ -237,6 +237,28 @@ class WindowPrep:
         return tids, self.lut[src_h], self.lut[dst_h]
 
 
+def pad_window(prep, src_h, dst_h, vcap: int, wmin: int = 8):
+    """Shared host prep + pow2 bucket padding for the window-local steps
+    (CC forest + signed-cover): returns ``(tids, tcap, wcap, tid, tmask,
+    lu, lv)`` with the touched bucket masked and the edge columns
+    zero-padded (pad rows are (0,0) self-loops; carries whose space
+    makes those meaningful — the cover — add their own edge mask)."""
+    n = len(src_h)
+    tids, lu_r, lv_r = prep.prep(src_h, dst_h, vcap)
+    t = len(tids)
+    tcap = bucket_capacity(t, minimum=8)
+    wcap = bucket_capacity(n, minimum=wmin)
+    tid = np.zeros(tcap, np.int32)
+    tid[:t] = tids
+    tmask = np.zeros(tcap, bool)
+    tmask[:t] = True
+    lu = np.zeros(wcap, np.int32)
+    lv = np.zeros(wcap, np.int32)
+    lu[:n] = lu_r
+    lv[:n] = lv_r
+    return tids, tcap, wcap, tid, tmask, lu, lv
+
+
 def forest_window(
     canon: jax.Array,
     src_h: np.ndarray,
@@ -259,9 +281,6 @@ def forest_window(
     n = len(src_h)
     if n == 0:
         return canon, np.zeros(0, np.int32)
-    tids, lu_r, lv_r = (prep or WindowPrep()).prep(src_h, dst_h, vcap)
-    t = len(tids)
-    tcap = bucket_capacity(t, minimum=8)
     wmin = 8
     if mesh is not None:
         from ..parallel.mesh import EDGE_AXIS
@@ -270,15 +289,9 @@ def forest_window(
         # the bucket minimum keeps every bucket divisible for ANY axis
         # width (the edgeblock.py convention), not just powers of two
         wmin = max(wmin, mesh.shape[EDGE_AXIS])
-    wcap = bucket_capacity(n, minimum=wmin)
-    tid = np.zeros(tcap, np.int32)
-    tid[:t] = tids
-    tmask = np.zeros(tcap, bool)
-    tmask[:t] = True
-    lu = np.zeros(wcap, np.int32)
-    lv = np.zeros(wcap, np.int32)
-    lu[:n] = lu_r
-    lv[:n] = lv_r
+    tids, tcap, wcap, tid, tmask, lu, lv = pad_window(
+        prep or WindowPrep(), src_h, dst_h, vcap, wmin
+    )
     step = _forest_step_fn(tcap, wcap, vcap, mesh, tree, degree)
     canon = step(
         canon,
