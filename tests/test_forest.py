@@ -345,3 +345,28 @@ def test_cover_forest_held_emission_survives_dict_growth():
     assert str(first).startswith("(true,")
     # the early snapshot reflects ITS window: only vertices 0..2 touched
     assert set(first.components) == {0, 2} or set(first.components) == {0}
+
+
+def test_carry_with_event_time_windows(carry):
+    """The windowed carries engage on event-time blocks too (the
+    windower caches host columns for any policy); equality with dense
+    across a window-spanning event-time stream."""
+    from gelly_streaming_tpu import EventTimeWindow
+
+    edges = [
+        (1, 2, 0.0), (2, 3, 1.0), (4, 5, 5.0),
+        (3, 4, 9.0), (5, 6, 12.0), (1, 6, 13.0), (7, 8, 27.0),
+    ]
+
+    def run(c):
+        agg = ConnectedComponents(carry=c)
+        out = [str(x) for x in SimpleEdgeStream(
+            edges, window=EventTimeWindow(10, timestamp_fn=lambda e: e[2])
+        ).aggregate(agg)]
+        return out, agg._cc_mode
+
+    got, mode = run(carry)
+    dense, _ = run("dense")
+    assert mode == carry
+    assert got == dense
+    assert "1=[1, 2, 3, 4, 5, 6]" in got[-1] and "7=[7, 8]" in got[-1]
