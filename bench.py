@@ -1470,6 +1470,7 @@ def main():
         import subprocess
 
         bound = info["bound"]
+        binp = info["binp"]
         for key, expr in [
             ("e2e_text_identity",
              f"bench.bench_cc_e2e({path!r}, "
@@ -1477,6 +1478,15 @@ def main():
             ("e2e_dict_host",
              "bench.bench_cc_e2e("
              f"{path!r}, lambda: VertexDict(min_capacity={bound}), {n_edges})"),
+            # the carry trio on the CPU backend: the committed record of
+            # why auto picks the host union-find here (forest keeps the
+            # merge on the XLA-CPU "device"; dense is the r4 baseline)
+            ("e2e_carry_forest",
+             f"bench.bench_cc_e2e({binp!r}, "
+             f"lambda: datasets.IdentityDict({bound}), {n_edges}, carry='forest')"),
+            ("e2e_carry_dense",
+             f"bench.bench_cc_e2e({binp!r}, "
+             f"lambda: datasets.IdentityDict({bound}), {n_edges}, carry='dense')"),
         ]:
             log(f"cpu run: {key}...")
             code = (
@@ -1498,7 +1508,6 @@ def main():
         # latency/throughput window-size curve on the CPU backend (the
         # windowed carries made small windows viable here too; the curve
         # records which carry each point ran)
-        binp = info["binp"]
         curve = []
         for wexp in (10, 12, 14, 16, 18, 20):
             log(f"cpu run: latency_curve window=2^{wexp}...")
