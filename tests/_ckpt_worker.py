@@ -70,10 +70,15 @@ def main():
                 [int(i), int(c)] for i, c in enumerate(counts) if c
             ] if counts is not None else [],
         }
-    elif kind == "cc":
+    elif kind in ("cc", "cc_forest"):
         from gelly_streaming_tpu.library import ConnectedComponents
 
-        work = ConnectedComponents()
+        # "cc" exercises the auto carry (host on this CPU backend);
+        # "cc_forest" pins the accelerator default so the kill-and-resume
+        # parity proof covers the TPU carry too
+        work = ConnectedComponents(
+            carry="forest" if kind == "cc_forest" else "auto"
+        )
         n = 0
         last = None
         for last in ac.run(make_stream, work):

@@ -20,7 +20,7 @@ def _run_worker(kind, ckpt, out, kill_after, timeout=300):
     )
 
 
-@pytest.mark.parametrize("kind", ["triangles", "cc"])
+@pytest.mark.parametrize("kind", ["triangles", "cc", "cc_forest"])
 def test_kill_and_resume_matches_uninterrupted(tmp_path, kind):
     ref_out = str(tmp_path / "ref.json")
     r = _run_worker(kind, str(tmp_path / "ref.ckpt"), ref_out, -1)
