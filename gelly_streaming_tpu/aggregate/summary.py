@@ -158,6 +158,14 @@ class SummaryAggregation(abc.ABC):
         if step_fn is None:
             p = mesh.shape[EDGE_AXIS] if mesh is not None else 1
             tree = self._is_tree()
+            # a fan-in the mesh cannot honor degrades to 2 with a warning
+            # (reference posture; see SummaryTreeReduce docstring). Only
+            # the tree engine runs the butterfly — resolving for bulk
+            # aggregations would warn about a collective they never run.
+            degree = (
+                comm.resolve_tree_degree(p, getattr(self, "degree", 2))
+                if tree and mesh is not None else 2
+            )
 
             def step(summary, src, dst, val, mask):
                 init = self.initial_state(vcap)
@@ -169,7 +177,7 @@ class SummaryAggregation(abc.ABC):
                         if tree:
                             return comm.tree_all_reduce(
                                 part, EDGE_AXIS, self.combine, p,
-                                degree=getattr(self, "degree", 2),
+                                degree=degree,
                             )
                         return jax.tree.map(lambda x: x[None], part)
 
@@ -286,12 +294,20 @@ class SummaryTreeReduce(SummaryAggregation):
     partials merge through a ``log_degree(p)``-round ppermute butterfly
     (:func:`gelly_streaming_tpu.parallel.comm.tree_all_reduce`), the ICI
     equivalent of ``enhance()``'s recursive parallelism reduction
-    (``SummaryTreeReduce.java:95-123``). ``degree`` is the tree fan-in:
-    higher degrees run fewer collective rounds with more combines per
-    round; the mesh edge-axis size must be a power of ``degree`` (the
-    default 2 fits every power-of-two mesh). The combine must be
-    commutative as well as associative — all engine workloads'
-    join-semilattice merges are."""
+    (``SummaryTreeReduce.java:95-123``).
+
+    ``degree`` here GENERALIZES the reference rather than mirroring it:
+    the reference's ``degree`` sets the partial-aggregation parallelism
+    (``setParallelism(degree)``) while ``enhance()``'s fan-in is fixed
+    at 2 (``key = f0/2``, ``nextParal = p/2``); the butterfly promotes
+    it to a true tree fan-in — higher degrees run fewer collective
+    rounds with more combines per round. A degree the mesh edge axis
+    cannot honor (the axis size must be a power of the fan-in) degrades
+    to the degree-2 butterfly with a warning, matching the reference's
+    warn-and-run posture for non-conforming degrees
+    (:func:`~gelly_streaming_tpu.parallel.comm.resolve_tree_degree`).
+    The combine must be commutative as well as associative — all engine
+    workloads' join-semilattice merges are."""
 
     #: degree changes the compiled collective program
     config_fields: tuple = ("degree",)

@@ -34,10 +34,14 @@ class VertexDict:
 
     def __init__(self, min_capacity: int = 8):
         self._idx_to_raw: list[int] = []
-        # batch-lookup index: raw ids sorted, with their compact ids aligned
-        # (numpy fallback path; unused when the native encoder loads)
-        self._sorted_raw = np.empty(0, np.int64)
-        self._sorted_idx = np.empty(0, np.int32)
+        # batch-lookup index: (sorted raw ids, aligned compact ids) as ONE
+        # tuple (numpy fallback path; unused when the native encoder
+        # loads). The pair is replaced by a single reference assignment so
+        # a concurrent reader (the serving query worker's lookup_batch)
+        # always sees a mutually consistent raw/idx pair — two separate
+        # attributes could be observed mid-swap with mismatched lengths.
+        # The native encoder gets the same guarantee from its own mutex.
+        self._index = (np.empty(0, np.int64), np.empty(0, np.int32))
         self._min_capacity = min_capacity
         try:
             from ..native import NativeEncoder
@@ -72,11 +76,12 @@ class VertexDict:
                 self._idx_to_raw.extend(novel.tolist())
             return out
         out = np.empty(n, dtype=np.int32)
-        if self._sorted_raw.size:
-            pos = np.searchsorted(self._sorted_raw, raw)
-            pos_c = np.minimum(pos, self._sorted_raw.size - 1)
-            known = self._sorted_raw[pos_c] == raw
-            out[known] = self._sorted_idx[pos_c[known]]
+        sorted_raw, sorted_idx = self._index
+        if sorted_raw.size:
+            pos = np.searchsorted(sorted_raw, raw)
+            pos_c = np.minimum(pos, sorted_raw.size - 1)
+            known = sorted_raw[pos_c] == raw
+            out[known] = sorted_idx[pos_c[known]]
         else:
             known = np.zeros(n, bool)
         novel = ~known
@@ -89,11 +94,11 @@ class VertexDict:
             id_of_uniq[order] = base + np.arange(uniq.size, dtype=np.int32)
             out[novel] = id_of_uniq[np.searchsorted(uniq, vals)]
             self._idx_to_raw.extend(uniq[order].tolist())
-            merged_raw = np.concatenate([self._sorted_raw, uniq])
-            merged_idx = np.concatenate([self._sorted_idx, id_of_uniq])
+            merged_raw = np.concatenate([sorted_raw, uniq])
+            merged_idx = np.concatenate([sorted_idx, id_of_uniq])
             o = np.argsort(merged_raw, kind="stable")
-            self._sorted_raw = merged_raw[o]
-            self._sorted_idx = merged_idx[o]
+            # one atomic reference swap (see __init__)
+            self._index = (merged_raw[o], merged_idx[o])
         return out
 
     def encode_pair(self, src: np.ndarray, dst: np.ndarray):
@@ -137,10 +142,33 @@ class VertexDict:
         """Query without inserting; None if unseen."""
         if self._native is not None:
             return self._native.lookup(raw)
-        pos = int(np.searchsorted(self._sorted_raw, raw))
-        if pos < self._sorted_raw.size and self._sorted_raw[pos] == raw:
-            return int(self._sorted_idx[pos])
+        sorted_raw, sorted_idx = self._index
+        pos = int(np.searchsorted(sorted_raw, raw))
+        if pos < sorted_raw.size and sorted_raw[pos] == raw:
+            return int(sorted_idx[pos])
         return None
+
+    def lookup_batch(self, raw: np.ndarray) -> np.ndarray:
+        """Vectorized :meth:`lookup` (the serving query path): compact
+        ids aligned with ``raw``, -1 marking unseen ids. Never inserts.
+        Safe to call from a reader thread concurrent with ingest: the
+        native encoder serializes table access behind its mutex, and the
+        numpy index is read as one consistent snapshot."""
+        raw = np.asarray(raw, np.int64).ravel()
+        out = np.full(raw.size, -1, np.int32)
+        if raw.size == 0:
+            return out
+        if self._native is not None:
+            # the native encoder owns the table (no numpy sorted index
+            # is maintained beside it): one C call for the whole batch
+            return self._native.lookup_batch(raw)
+        sorted_raw, sorted_idx = self._index  # consistent snapshot
+        if sorted_raw.size:
+            pos = np.searchsorted(sorted_raw, raw)
+            pos_c = np.minimum(pos, sorted_raw.size - 1)
+            known = sorted_raw[pos_c] == raw
+            out[known] = sorted_idx[pos_c[known]]
+        return out
 
     def decode(self, idx: Iterable[int] | np.ndarray) -> np.ndarray:
         rev = self._rev_array()

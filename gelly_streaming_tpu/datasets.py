@@ -256,6 +256,14 @@ class IdentityDict:
     def lookup(self, raw: int):
         return int(raw) if 0 <= int(raw) < self.id_bound else None
 
+    def lookup_batch(self, raw) -> np.ndarray:
+        """Vectorized :meth:`lookup` (the serving query path): compact
+        ids, -1 for ids outside the declared bound."""
+        a = np.asarray(raw, np.int64).ravel()
+        return np.where(
+            (a >= 0) & (a < self.id_bound), a, -1
+        ).astype(np.int32)
+
     def raw_ids(self) -> np.ndarray:
         """Ids observed so far (the checkpoint surface): restoring these
         through ``encode`` reproduces the watermark instead of resetting

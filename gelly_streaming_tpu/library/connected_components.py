@@ -71,6 +71,19 @@ from ..summaries.labels import (
 )
 
 
+def _validate_min_rooted(lab: np.ndarray) -> None:
+    """Reject labels violating the min-rooted invariant (mirroring
+    ``cuf_load``): a corrupt table with ``label[v] > v`` would spin
+    ``resolve_flat_host``/``resolve_flat`` (and the serving root chase)
+    forever instead of failing fast."""
+    iota = np.arange(len(lab), dtype=lab.dtype)
+    if np.any(lab > iota) or np.any(lab < 0):
+        raise ValueError(
+            "restored labels are not a min-rooted forest "
+            "(label[v] must be in [0, v])"
+        )
+
+
 def _auto_carry() -> str:
     """Pick the windowed-ingest carry for this process.
 
@@ -131,16 +144,18 @@ class _CCMixin:
     # ---- windowed-carry run loop ---- #
     def run(self, stream) -> Iterator[Components]:
         mesh = self._resolve_mesh(stream)
+        eff_degree = getattr(self, "degree", 2)
         if mesh is not None and self._is_tree():
-            # validate the tree degree against the mesh EAGERLY: the host
-            # carry never runs the butterfly, so without this check a
-            # misconfigured degree would pass silently (or raise midway
-            # through the stream after a downgrade to dense)
+            # resolve the tree degree against the mesh EAGERLY: the host
+            # carry never runs the butterfly, so without this a
+            # misconfigured degree would pass silently (or warn midway
+            # through the stream after a downgrade to dense). A degree
+            # the mesh cannot honor degrades to 2 with ONE warning here.
             from ..parallel import comm
             from ..parallel.mesh import EDGE_AXIS
 
-            comm.validate_tree_degree(
-                mesh.shape[EDGE_AXIS], getattr(self, "degree", 2)
+            eff_degree = comm.resolve_tree_degree(
+                mesh.shape[EDGE_AXIS], eff_degree
             )
         vdict = stream.vertex_dict
         for block in stream.blocks():
@@ -179,7 +194,7 @@ class _CCMixin:
                     self._canon, tids = forest_window(
                         self._canon, src_h, dst_h, self._vcap, self._prep,
                         mesh=mesh, tree=self._is_tree(),
-                        degree=getattr(self, "degree", 2),
+                        degree=eff_degree,
                     )
                 self._log.add(tids)
                 # sync()/bench barriers block on _summary; keep it aimed
@@ -195,6 +210,7 @@ class _CCMixin:
             if self._summary is not None and "touched" in self._summary:
                 # restored (or converted) dense state: flat labels ARE a
                 # valid forest; rebuild the host touched log from the mask
+                _validate_min_rooted(np.asarray(self._summary["labels"]))
                 self._canon = self._summary["labels"]
                 self._log = TouchLog.from_touched_bool(
                     np.asarray(self._summary["touched"])
@@ -265,6 +281,86 @@ class _CCMixin:
         self._log = None
         self._uf = None
         self._prep = None
+
+    # ---- serving surface (serving/server.py Servable contract) ------- #
+    def servable(self, vdict=None) -> "CCServable":
+        """Adapter mapping this aggregation's carry to per-window
+        serving snapshots: ``labels`` is the live pointer forest (forest/
+        host carries — each window's functional scatter leaves the
+        published buffer immutable) or the dense flat-label table; the
+        :class:`~gelly_streaming_tpu.serving.query.QueryEngine` chases
+        either. Serves ``ConnectedQuery`` and ``ComponentSizeQuery``.
+        ``vdict`` seeds the boot payload when restoring from a
+        checkpoint before any stream is attached."""
+        return CCServable(self, vdict)
+
+
+def _counted_blocks(blocks, total):
+    """Pass blocks through, accumulating the edge watermark into
+    ``total[0]``: exact from host caches, the padded capacity (an upper
+    bound) for device-transformed blocks — never a mid-stream D2H."""
+    for b in blocks:
+        cache = getattr(b, "_host_cache", None)
+        total[0] += len(cache[0]) if cache is not None else int(b.capacity)
+        yield b
+
+
+class CCServable:
+    """:class:`~gelly_streaming_tpu.serving.server.Servable` adapter for
+    the CC aggregation. Every carry publishes one ``labels`` array per
+    window — the live pointer forest for the forest/host carries (each
+    window's functional update allocates a fresh buffer, so the
+    published one is immutable) or the dense flat table — plus the
+    stream's vertex dict for raw-id resolution."""
+
+    def __init__(self, agg, vdict=None):
+        from ..serving import ComponentSizeQuery, ConnectedQuery
+
+        self.query_classes = (ConnectedQuery, ComponentSizeQuery)
+        self._agg = agg
+        self._vdict = vdict
+
+    def _payload(self, vdict) -> dict:
+        agg = self._agg
+        if agg._cc_mode in ("forest", "host") and agg._canon is not None:
+            labels = agg._canon
+        elif agg._summary is not None and "labels" in agg._summary:
+            labels = agg._summary["labels"]
+        else:
+            return None
+        return {"labels": labels, "vdict": vdict}
+
+    def payloads(self, stream):
+        vdict = stream.vertex_dict
+        self._vdict = vdict
+        total = [0]
+        derive = getattr(stream, "_derive", None)
+        counted = (
+            stream if derive is None
+            else derive(lambda blocks: _counted_blocks(blocks, total))
+        )
+        window = 0
+        for _ in self._agg.run(counted):
+            window += 1
+            payload = self._payload(vdict)
+            if payload is None:  # carry not inspectable this window
+                continue
+            yield payload, (total[0] or window)
+
+    def boot_payload(self):
+        """The restored summary as a servable payload (None when nothing
+        was restored yet, or no vdict is known). Validates the
+        min-rooted invariant like ``_ensure_windowed``: a corrupt
+        checkpoint served as a boot snapshot would otherwise spin the
+        query worker's root chase forever on the first query, long
+        before the first live window could raise."""
+        if self._vdict is None:
+            return None
+        payload = self._payload(self._vdict)
+        if payload is None:
+            return None
+        _validate_min_rooted(np.asarray(payload["labels"]))
+        return payload, 0
 
 
 class ConnectedComponents(_CCMixin, SummaryBulkAggregation):

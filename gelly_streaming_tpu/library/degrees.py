@@ -189,6 +189,14 @@ class DegreeDistribution:
                 "already diverged from the checkpoint"
             )
 
+    # ---- serving surface (serving/server.py Servable contract) ------- #
+    def servable(self, vdict=None) -> "DegreeServable":
+        """Adapter publishing the carried degree vector per window for
+        ``DegreeQuery`` point lookups (``vdict`` is only consulted for
+        the checkpoint boot payload; live windows use the windower's
+        dict)."""
+        return DegreeServable(self, vdict)
+
     def histogram(self) -> dict:
         """Current (degree -> count) map, degree >= 1 entries only.
         A natural sync point: snaps the capacity shadow to the truth."""
@@ -257,6 +265,34 @@ class HistogramBatch(LazyListBatch):
                 w._max_deg_ub, true_max + (w._inc_total - self._inc)
             )
         return items
+
+
+class DegreeServable:
+    """:class:`~gelly_streaming_tpu.serving.server.Servable` adapter for
+    :class:`DegreeDistribution`: one ``deg`` table per window (the
+    jitted step returns fresh buffers, so published tables are
+    immutable), watermark = cumulative events folded."""
+
+    def __init__(self, workload: DegreeDistribution, vdict=None):
+        from ..serving import DegreeQuery
+
+        self.query_classes = (DegreeQuery,)
+        self._workload = workload
+        self._vdict = vdict
+
+    def payloads(self, events):
+        w = self._workload
+        vdict = w._windower.vertex_dict
+        self._vdict = vdict
+        for _ in w.run(events):
+            yield {"deg": w._deg, "vdict": vdict}, w._events_total
+
+    def boot_payload(self):
+        w = self._workload
+        if w._deg is None:
+            return None
+        vdict = self._vdict or w._windower.vertex_dict
+        return {"deg": w._deg, "vdict": vdict}, w._events_total
 
 
 def _delta(change) -> int:

@@ -285,6 +285,15 @@ class IncrementalPageRank:
             jnp.asarray(ranks),
         )
 
+    # ---- serving surface (serving/server.py Servable contract) ------- #
+    def servable(self, vdict=None) -> "RankServable":
+        """Adapter publishing the rank vector per window for
+        ``RankQuery`` point lookups. Unlike the CC/degree carries, the
+        PageRank step DONATES its carry buffers (the published array
+        would be invalidated by the next window's dispatch), so the
+        adapter snapshots ranks with one device-side copy per window."""
+        return RankServable(self, vdict)
+
     def ranks(self) -> dict:
         """Current (raw vertex id -> rank), seen vertices only."""
         if self._carry is None:
@@ -293,3 +302,37 @@ class IncrementalPageRank:
         r = np.asarray(self._carry[2])[:n]
         raw = self._vdict.decode(np.arange(n))
         return {int(v): float(x) for v, x in zip(raw, r)}
+
+
+class RankServable:
+    """:class:`~gelly_streaming_tpu.serving.server.Servable` adapter for
+    :class:`IncrementalPageRank`. The window step donates its carry, so
+    each published snapshot is ``jnp.copy`` of the rank vector — one
+    device-side copy per window; readers must never hold a donated
+    buffer (accessing it after the next dispatch raises)."""
+
+    def __init__(self, workload: IncrementalPageRank, vdict=None):
+        from ..serving import RankQuery
+
+        self.query_classes = (RankQuery,)
+        self._workload = workload
+        self._vdict = vdict
+
+    def payloads(self, stream):
+        pr = self._workload
+        vdict = stream.vertex_dict
+        self._vdict = vdict
+        for _ in pr.run(stream):
+            yield (
+                {"ranks": jnp.copy(pr._carry[2]), "vdict": vdict},
+                pr._n_edges,
+            )
+
+    def boot_payload(self):
+        pr = self._workload
+        if pr._carry is None or self._vdict is None:
+            return None
+        return (
+            {"ranks": jnp.copy(pr._carry[2]), "vdict": self._vdict},
+            pr._n_edges,
+        )

@@ -145,6 +145,10 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             lib.encoder_lookup.restype = ctypes.c_int32
             lib.encoder_lookup.argtypes = [ctypes.c_void_p, i64]
+            lib.encoder_lookup_batch.restype = None
+            lib.encoder_lookup_batch.argtypes = [
+                ctypes.c_void_p, p64, i64, pi32a,
+            ]
             lib.encoder_size.restype = i64
             lib.encoder_size.argtypes = [ctypes.c_void_p]
             lib.vbitmap_create.restype = ctypes.c_void_p
@@ -736,6 +740,17 @@ class NativeEncoder:
         with self._mu:
             v = self._lib.encoder_lookup(self._h, int(k))
         return None if v < 0 else int(v)
+
+    def lookup_batch(self, ks: np.ndarray) -> np.ndarray:
+        """Batched query-without-insert: int32 compact ids, -1 for
+        unseen. ONE C call (and one mutex acquisition) for the whole
+        batch — the serving read path must not pay a ctypes round trip
+        per id."""
+        ks = np.ascontiguousarray(ks, np.int64)
+        out = np.empty(ks.size, np.int32)
+        with self._mu:
+            self._lib.encoder_lookup_batch(self._h, ks, ks.size, out)
+        return out
 
     def __len__(self) -> int:
         return int(self._lib.encoder_size(self._h))

@@ -914,6 +914,26 @@ int32_t encoder_lookup(void* ptr, int64_t k) {
     }
 }
 
+// Batched lookup without insert (the serving read path): out[i] = compact
+// id or -1. One C call per query batch — a Python-side loop over
+// encoder_lookup costs a GIL/ctypes round trip per id, which is exactly
+// the per-query host loop the query engine forbids.
+void encoder_lookup_batch(void* ptr, const int64_t* ks, int64_t n,
+                          int32_t* out) {
+    Encoder* e = (Encoder*)ptr;
+    for (int64_t i = 0; i < n; ++i) {
+        if (i + 8 < n) prefetch_slot(e, ks[i + 8]);
+        int64_t k = ks[i];
+        if (k == EMPTY_KEY) { out[i] = e->min_idx; continue; }
+        uint64_t h = mix_hash((uint64_t)k) & (e->cap - 1);
+        while (true) {
+            if (e->keys[h] == k) { out[i] = e->vals[h]; break; }
+            if (e->keys[h] == EMPTY_KEY) { out[i] = -1; break; }
+            h = (h + 1) & (e->cap - 1);
+        }
+    }
+}
+
 int64_t encoder_size(void* ptr) { return ((Encoder*)ptr)->size; }
 
 }  // extern "C"
@@ -1208,12 +1228,23 @@ void cuf_destroy(void* h) { delete (CompactUF*)h; }
 // Fold one window of compact edges. touched_out/roots_out need capacity
 // 2n; changed_out/changed_roots_out need capacity n. Returns the touched
 // count (>= 0) and writes the demoted-root count to *n_changed_out.
+// Ids are validated in a PREPASS before any union is applied: a mid-loop
+// bail-out would leave the union-find partially mutated with the applied
+// unions' touched/changed outputs discarded, permanently desyncing a
+// device pointer-forest mirror from this state for callers that catch
+// the error and keep streaming. A -1 return therefore guarantees the
+// carry is untouched (the wprep epoch scheme self-heals on the next
+// window; a union does not).
 int64_t cuf_fold_window(void* h, const int32_t* src, const int32_t* dst,
                         int64_t n, int64_t vcap,
                         int32_t* touched_out, int32_t* roots_out,
                         int32_t* changed_out, int32_t* changed_roots_out,
                         int64_t* n_changed_out) {
     CompactUF& uf = *(CompactUF*)h;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t a = src[i], b = dst[i];
+        if (a < 0 || b < 0 || a >= vcap || b >= vcap) return -1;
+    }
     uf.ensure(vcap);
     if (++uf.epoch == 0) {  // uint32 wrap: see wprep_run
         std::fill(uf.stamp.begin(), uf.stamp.end(), 0u);
@@ -1222,7 +1253,6 @@ int64_t cuf_fold_window(void* h, const int32_t* src, const int32_t* dst,
     int64_t nt = 0, nc = 0;
     for (int64_t i = 0; i < n; ++i) {
         int32_t a = src[i], b = dst[i];
-        if (a < 0 || b < 0 || a >= vcap || b >= vcap) return -1;
         if (uf.stamp[(size_t)a] != uf.epoch) {
             uf.stamp[(size_t)a] = uf.epoch;
             touched_out[nt++] = a;

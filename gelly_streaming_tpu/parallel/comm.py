@@ -42,10 +42,23 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_fn  # type: ignore
 
 
+# the relaxed-check kwarg was renamed check_rep -> check_vma across JAX
+# releases; resolve which one this install accepts ONCE at import
+import inspect as _inspect
+
+_SM_PARAMS = _inspect.signature(_shard_map_fn).parameters
+_SM_CHECK_KW = (
+    "check_vma" if "check_vma" in _SM_PARAMS
+    else "check_rep" if "check_rep" in _SM_PARAMS
+    else None
+)
+
+
 def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
     """Thin wrapper over jax.shard_map with relaxed varying-manual-axes checks."""
+    kw = {} if _SM_CHECK_KW is None else {_SM_CHECK_KW: check_vma}
     return _shard_map_fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=check_vma)
+                         **kw)
 
 
 # --------------------------------------------------------------------------- #
@@ -115,6 +128,36 @@ def validate_tree_degree(n_shards: int, degree: int) -> None:
             f"power of the tree degree ({degree}); use degree=2 for "
             "power-of-two meshes"
         )
+
+
+def resolve_tree_degree(n_shards: int, degree: int) -> int:
+    """Effective butterfly fan-in for this mesh: ``degree`` when the
+    axis size is a power of it, else 2 (which fits every power-of-two
+    mesh) with a warning.
+
+    In the reference ``degree`` configures the partial-aggregation
+    PARALLELISM (``setParallelism(degree)``) while ``enhance()``'s
+    fan-in is fixed at 2 — a non-conforming degree there degrades with a
+    warning rather than failing. The butterfly generalizes degree into a
+    true fan-in, so a degree the mesh cannot honor degrades the same
+    way: warn, run the degree-2 butterfly. ``degree < 2`` still raises
+    (no meaningful fallback)."""
+    if degree < 2:
+        raise ValueError(f"tree_all_reduce degree must be >= 2, got {degree}")
+    total = 1
+    while total < n_shards:
+        total *= degree
+    if total == n_shards:
+        return degree
+    import warnings
+
+    warnings.warn(
+        f"tree degree {degree} does not fit the {n_shards}-shard edge "
+        "axis (axis size must be a power of the degree); falling back "
+        "to the degree-2 butterfly",
+        stacklevel=2,
+    )
+    return 2
 
 
 def tree_all_reduce(

@@ -1,0 +1,62 @@
+"""Live query serving over streaming summaries (the read path).
+
+The reference treats summaries as write-only: folded per window, emitted
+as a stream, never *asked* anything while the stream runs. The ROADMAP
+north star — heavy traffic from millions of users — needs the opposite
+contract too: point queries (``connected(u, v)``, ``degree(v)``,
+``rank(v)``) answered from the most recent published summary with bounded
+staleness, without stalling ingestion. This package is that serving
+stack:
+
+- :mod:`snapshot_store` — a wait-free publish/read split: the ingest
+  loop publishes an immutable :class:`PublishedSnapshot` (summary payload
+  + window index + watermark) after each window; readers grab the latest
+  by one atomic reference read, never a lock shared with the writer.
+- :mod:`query` — typed point queries plus a :class:`QueryEngine` that
+  answers a whole concurrent batch with ONE vectorized jitted lookup per
+  query class (a batch root-chase gather for CC, a table gather for
+  degrees/ranks) instead of per-query host loops.
+- :mod:`server` — :class:`StreamServer`: runs any emission iterator on a
+  background thread (reusing ``core/pipeline.py``'s producer discipline),
+  publishes snapshots, exposes ``submit(query) -> Future`` and a
+  synchronous ``ask()``, rejects with :class:`Overloaded` past the
+  admission limit, and drains cleanly on ``close()``.
+- :mod:`stats` — per-query-class latency histograms + staleness gauges,
+  exported as plain dict snapshots (metrics stay ordinary output
+  streams, the reference's design stance).
+
+Workloads opt in via a small ``servable()`` adapter
+(``library/connected_components.py``, ``library/degrees.py``,
+``library/pagerank.py``) mapping their carry to a snapshot payload;
+``aggregate/checkpoint.py:restore_server`` boots a server from a
+checkpoint so it serves the restored summary while catching up.
+"""
+
+from .query import (
+    Answer,
+    ComponentSizeQuery,
+    ConnectedQuery,
+    DegreeQuery,
+    Query,
+    QueryEngine,
+    RankQuery,
+)
+from .server import Overloaded, Servable, StreamServer
+from .snapshot_store import PublishedSnapshot, SnapshotStore
+from .stats import ServingStats
+
+__all__ = [
+    "Answer",
+    "ComponentSizeQuery",
+    "ConnectedQuery",
+    "DegreeQuery",
+    "Overloaded",
+    "PublishedSnapshot",
+    "Query",
+    "QueryEngine",
+    "RankQuery",
+    "Servable",
+    "ServingStats",
+    "SnapshotStore",
+    "StreamServer",
+]

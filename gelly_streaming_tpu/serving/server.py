@@ -1,0 +1,362 @@
+"""StreamServer: concurrent point-query serving beside live ingest.
+
+Thread layout (one server = two daemon threads, same discipline as
+``core/pipeline.py:prefetch`` — the producer owns the device step loop,
+consumers never stall it):
+
+- **ingest thread**: drives the servable's emission iterator (any
+  per-window payload stream) and publishes one immutable snapshot per
+  window into the :class:`~.snapshot_store.SnapshotStore`. Publishing is
+  one atomic reference swap, so ingest never waits on readers.
+- **query worker thread**: drains ALL currently-pending queries in one
+  sweep, groups them by class, and answers each group with one
+  vectorized :class:`~.query.QueryEngine` kernel against the latest
+  snapshot — concurrent load COALESCES into bigger batches instead of
+  queueing per-query dispatches (the serving analog of window batching).
+
+Admission control is explicit: past ``max_pending`` in-flight queries,
+:meth:`StreamServer.submit` raises :class:`Overloaded` immediately
+instead of buffering unboundedly or blocking the caller — clients see
+back-pressure, ingest sees nothing. ``close()`` stops ingest at the next
+window boundary, answers every already-admitted query from the final
+snapshot, and joins both threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Iterator, Optional, Tuple
+
+from .query import Answer, Query, QueryEngine
+from .snapshot_store import PublishedSnapshot, SnapshotStore
+from .stats import ServingStats
+
+
+class Overloaded(RuntimeError):
+    """The server's admission limit is reached; retry with back-off.
+    Raised from ``submit``/``ask`` so rejection is synchronous and
+    explicit — an overloaded serving tier must shed, not buffer."""
+
+
+class Servable:
+    """Adapter contract a workload implements to be served (see
+    ``library/connected_components.py:servable`` et al.).
+
+    ``payloads(source)`` is the emission iterator the ingest thread
+    drives: per window it yields ``(payload, watermark)`` where
+    ``payload`` is an immutable mapping the :class:`QueryEngine`
+    understands (``labels``/``deg``/``ranks`` + ``vdict``) and
+    ``watermark`` a monotone progress counter (cumulative edges where
+    cheap to count, else the window ordinal). ``boot_payload()`` returns
+    the same pair from already-restored carry state (or None when there
+    is nothing to serve yet) — the checkpoint-boot path publishes it as
+    window -1 before the first live window lands.
+    """
+
+    #: query classes this servable's payloads answer (documentation +
+    #: eager misconfiguration checks)
+    query_classes: tuple = ()
+
+    def payloads(self, source) -> Iterator[Tuple[dict, int]]:
+        raise NotImplementedError
+
+    def boot_payload(self) -> Optional[Tuple[dict, int]]:
+        return None
+
+
+class StreamServer:
+    """Serve point queries from a live stream's running summary.
+
+    Parameters
+    ----------
+    servable:
+        A :class:`Servable` (or any object with its ``payloads``
+        contract). A bare iterator of ``(payload, watermark)`` pairs is
+        accepted with ``source=None``.
+    source:
+        The stream / event iterable handed to ``servable.payloads``.
+    max_pending:
+        Admission limit: queries admitted but not yet answered. At the
+        limit, ``submit`` raises :class:`Overloaded`.
+    """
+
+    def __init__(
+        self,
+        servable,
+        source=None,
+        *,
+        max_pending: int = 1024,
+        store: Optional[SnapshotStore] = None,
+        engine: Optional[QueryEngine] = None,
+        stats: Optional[ServingStats] = None,
+    ):
+        self._servable = servable
+        self._source = source
+        self.store = store or SnapshotStore()
+        self.engine = engine or QueryEngine()
+        self.stats = stats or ServingStats()
+        self.max_pending = int(max_pending)
+        self._pending: deque = deque()  # (query, future, t_submit)
+        self._inflight = 0  # drained by the worker, not yet answered
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop_ingest = threading.Event()
+        self._ingest_done = threading.Event()
+        self._ingest_error: Optional[BaseException] = None
+        self._closing = False
+        self._closed = False
+        self._window = -1  # last published live window
+        self._ingest_thread: Optional[threading.Thread] = None
+        self._worker_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+    def publish_boot(self, payload: dict, watermark: int = 0) -> None:
+        """Publish a pre-ingest snapshot (window -1): the checkpoint-boot
+        path serves the restored summary immediately, before the first
+        catch-up window folds. Must run before :meth:`start`."""
+        if self._ingest_thread is not None:
+            raise RuntimeError("publish_boot must precede start()")
+        self.store.publish(payload, window=-1, watermark=watermark)
+
+    def start(self) -> "StreamServer":
+        if self._ingest_thread is not None:
+            raise RuntimeError("server already started")
+        self._ingest_thread = threading.Thread(
+            target=self._ingest, name="stream-server-ingest", daemon=True
+        )
+        self._worker_thread = threading.Thread(
+            target=self._worker, name="stream-server-queries", daemon=True
+        )
+        self._ingest_thread.start()
+        self._worker_thread.start()
+        return self
+
+    def __enter__(self) -> "StreamServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _payload_iter(self) -> Iterator[Tuple[dict, int]]:
+        payloads = getattr(self._servable, "payloads", None)
+        if payloads is not None:
+            return payloads(self._source)
+        if self._source is not None:
+            raise TypeError(
+                f"{type(self._servable).__name__} has no payloads(); "
+                "pass a Servable, or a bare (payload, watermark) "
+                "iterator with source=None"
+            )
+        return iter(self._servable)
+
+    def _ingest(self) -> None:
+        it = self._payload_iter()
+        try:
+            for payload, watermark in it:
+                if self._stop_ingest.is_set():
+                    break
+                if payload is None:  # a window with nothing servable
+                    continue
+                self._window += 1
+                self.store.publish(payload, self._window, int(watermark))
+        except BaseException as e:  # surfaced via join()/close()
+            self._ingest_error = e
+        finally:
+            if self._stop_ingest.is_set():
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+            self._ingest_done.set()
+            self._wake.set()  # the worker re-checks exit conditions
+
+    # ------------------------------------------------------------------ #
+    # Query surface
+    # ------------------------------------------------------------------ #
+    def submit(self, query: Query) -> "Future[Answer]":
+        """Admit one query; resolves to an :class:`~.query.Answer`.
+        Raises :class:`Overloaded` at the admission limit — immediately,
+        on the caller's thread, so clients get synchronous
+        back-pressure."""
+        declared = getattr(self._servable, "query_classes", ())
+        if declared and not isinstance(query, tuple(declared)):
+            # reject the wrong class SYNCHRONOUSLY on the caller's
+            # thread: batched answering would otherwise fail the whole
+            # drained sweep (hundreds of valid concurrent queries) on
+            # one client's misdirected query
+            raise TypeError(
+                f"{type(self._servable).__name__} serves "
+                f"{[c.__name__ for c in declared]}, not "
+                f"{type(query).__name__}"
+            )
+        f: "Future[Answer]" = Future()
+        with self._lock:
+            # the closing check must sit INSIDE the lock: an unlocked
+            # read could pass just before close() flips the flag, and an
+            # append landing after close()'s final leftover drain would
+            # hang its future forever (no worker left to answer it).
+            # Inside the lock, any append that beats the flag is still
+            # caught by close()'s drain, which runs after the flag set.
+            if self._closing or self._closed:
+                raise RuntimeError("server is closed")
+            # count the worker's drained-but-unanswered batch too, or a
+            # slow answer sweep would let admissions reach 2x the limit
+            admitted = len(self._pending) + self._inflight
+            if admitted >= self.max_pending:
+                self.stats.record_rejected()
+                raise Overloaded(
+                    f"{admitted} queries in flight "
+                    f"(max_pending={self.max_pending})"
+                )
+            self._pending.append((query, f, time.perf_counter()))
+        self._wake.set()
+        return f
+
+    def ask(self, query: Query, timeout: Optional[float] = None) -> Answer:
+        """Synchronous point query (submit + wait)."""
+        return self.submit(query).result(timeout)
+
+    def snapshot(self) -> Optional[PublishedSnapshot]:
+        """The snapshot queries are currently answered from."""
+        return self.store.latest()
+
+    # ------------------------------------------------------------------ #
+    # Worker
+    # ------------------------------------------------------------------ #
+    def _drain(self) -> list:
+        with self._lock:
+            batch = list(self._pending)
+            self._pending.clear()
+            self._inflight = len(batch)
+        return batch
+
+    def _settle(self) -> None:
+        with self._lock:
+            self._inflight = 0
+
+    def _answer(self, batch: list) -> None:
+        # during live ingest, trade bounded staleness (READY_LOOKBACK
+        # windows at most) for latency: answer from the freshest snapshot
+        # whose arrays already materialized instead of blocking on the
+        # just-dispatched window's fold. Once the stream has ended the
+        # head is insisted on, so post-stream answers are staleness-0.
+        snap = self.store.latest(
+            prefer_ready=not self._ingest_done.is_set()
+        )
+        if snap is None:
+            # admitted before the first publish and the stream is gone:
+            # fail explicitly rather than hang the futures
+            err = RuntimeError(
+                "server closed before any snapshot was published"
+            )
+            if self._ingest_error is not None:
+                err.__cause__ = self._ingest_error
+            for _, f, _ in batch:
+                f.set_exception(err)
+            return
+        queries = [q for q, _, _ in batch]
+        try:
+            answers = self.engine.answer_batch(
+                snap, queries, head_window=self.store.head_window()
+            )
+        except Exception as e:
+            for _, f, _ in batch:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        now = time.perf_counter()
+        self.stats.record_batch()
+        for (q, f, t0), ans in zip(batch, answers):
+            self.stats.record(type(q).__name__, now - t0, ans.staleness)
+            # a client may have cancel()ed its future mid-sweep;
+            # settling it then raises InvalidStateError, which must not
+            # poison the rest of the batch's answers
+            if not f.done():
+                try:
+                    f.set_result(ans)
+                except Exception:
+                    pass
+
+    def _worker(self) -> None:
+        while True:
+            batch = self._drain()
+            if batch:
+                if self.store.latest() is None and not (
+                    self._closing or self._ingest_done.is_set()
+                ):
+                    # nothing published yet: hold the batch until the
+                    # first window (or shutdown) instead of failing
+                    self.store.wait_for(1, timeout=0.1)
+                    with self._lock:
+                        self._pending.extendleft(reversed(batch))
+                        self._inflight = 0
+                    continue
+                try:
+                    self._answer(batch)
+                except BaseException as e:
+                    # the worker thread must survive ANY answer-path
+                    # error — a dead worker hangs every future forever;
+                    # fail this batch and keep serving
+                    for _, f, _ in batch:
+                        if not f.done():
+                            f.set_exception(e)
+                finally:
+                    self._settle()
+                continue
+            if self._closing and not self._pending:
+                return
+            self._wake.wait(0.05)
+            self._wake.clear()
+
+    # ------------------------------------------------------------------ #
+    # Shutdown
+    # ------------------------------------------------------------------ #
+    def ingest_finished(self) -> bool:
+        """True once the servable's emission iterator is exhausted (or
+        failed); the server keeps serving from the final snapshot."""
+        return self._ingest_done.is_set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for ingest to finish the stream (server keeps serving
+        from the final snapshot). Re-raises an ingest-side error."""
+        if not self._ingest_done.wait(timeout):
+            raise TimeoutError("ingest still running")
+        if self._ingest_error is not None:
+            raise self._ingest_error
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop ingest at the next window boundary, answer every
+        already-admitted query from the final snapshot, join both
+        threads. Idempotent."""
+        if self._closed:
+            return
+        self._closing = True
+        self._stop_ingest.set()
+        self._wake.set()
+        if self._ingest_thread is not None:
+            self._ingest_thread.join(timeout)
+        if self._worker_thread is not None:
+            self._worker_thread.join(timeout)
+        # a submit racing the closing flag can slip one entry past the
+        # worker's exit check; answer stragglers here so no future hangs
+        leftovers = self._drain()
+        if leftovers:
+            try:
+                self._answer(leftovers)
+            except BaseException as e:
+                for _, f, _ in leftovers:
+                    if not f.done():
+                        f.set_exception(e)
+            finally:
+                self._settle()
+        self.store.close()
+        self._closed = True
+        if self._ingest_error is not None:
+            raise self._ingest_error

@@ -1,0 +1,173 @@
+"""Wait-free snapshot publication: the serving stack's write/read split.
+
+The ingest loop must never block on readers and readers must never block
+on ingest — the same discipline as the producer loop's zero-D2H rule
+(``core/pipeline.py``). The contract here:
+
+- A snapshot is an IMMUTABLE :class:`PublishedSnapshot`: payload arrays
+  are never mutated after publish. The carries make this free — JAX
+  updates are functional, so each window's fold allocates a fresh device
+  buffer and the previous window's buffer stays alive for any reader
+  still holding it (the same property that makes per-window lazy
+  emissions valid snapshots, ``summaries/forest.py``).
+- Publication is ONE reference assignment. CPython guarantees attribute
+  stores are atomic under the GIL, so a reader either sees the old
+  snapshot or the new one, never a torn mix — the double-buffer swap of
+  a classic seqlock without the retry loop, because the buffers behind
+  the references are frozen.
+- Readers call :meth:`SnapshotStore.latest` — one attribute read, no
+  lock, O(1) regardless of writer activity. The store's lock exists only
+  for :meth:`wait_for` (condition-variable sleeps of readers who want a
+  *newer* snapshot than the current one); the writer grabs it just to
+  notify, after the swap is already visible.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+
+def _payload_ready(payload) -> bool:
+    """True when every array in the payload has finished computing
+    (host arrays and objects without ``is_ready`` count as ready)."""
+    for v in payload.values():
+        ready = getattr(v, "is_ready", None)
+        if ready is not None:
+            try:
+                if not ready():
+                    return False
+            except Exception:
+                pass
+    return True
+
+
+@dataclass(frozen=True)
+class PublishedSnapshot:
+    """One published summary state.
+
+    ``payload`` is a workload-defined mapping (see the ``servable()``
+    adapters) whose arrays must never be mutated after publish. The one
+    non-array member is the ``vdict`` entry: the LIVE vertex dictionary,
+    which is append-only (existing raw->compact mappings never change)
+    and whose lookup paths are safe against concurrent ingest (native
+    mutex / atomic index snapshot) — a reader may see a few ids newer
+    than the snapshot's tables, which the engines treat as unseen-or-
+    self-rooted, never inconsistent.
+    ``window`` is the index of the last window folded in (``-1`` for a
+    checkpoint boot snapshot published before any live window).
+    ``watermark`` is a monotone progress counter — cumulative edges or
+    events folded when the servable can count them cheaply, else the
+    window index — so staleness is meaningful even across restores.
+    """
+
+    payload: Mapping[str, Any]
+    window: int
+    watermark: int
+    version: int
+    published_at: float = field(default_factory=time.monotonic)
+
+
+class SnapshotStore:
+    """Single-writer, many-reader snapshot cell.
+
+    The writer (the server's ingest thread) calls :meth:`publish` once
+    per window; any number of reader threads call :meth:`latest`
+    wait-free. ``version`` increases by one per publish, so readers can
+    detect progress without comparing payloads.
+    """
+
+    #: how many recent snapshots stay reachable for ``prefer_ready``
+    #: reads (beyond the newest); the windows-behind-head staleness a
+    #: latency-preferring reader can be handed is bounded by this
+    READY_LOOKBACK = 3
+
+    def __init__(self):
+        self._current: Optional[PublishedSnapshot] = None
+        self._recent: tuple = ()  # newest-first, immutable (atomic swap)
+        self._cond = threading.Condition()
+        self._closed = False
+
+    # -- read side ----------------------------------------------------- #
+    def latest(self, prefer_ready: bool = False) -> Optional[PublishedSnapshot]:
+        """The newest published snapshot (or None before the first
+        publish). One atomic reference read; never blocks.
+
+        ``prefer_ready=True`` trades bounded staleness for latency: it
+        returns the newest snapshot whose payload arrays have finished
+        computing (``jax.Array.is_ready``), looking back at most
+        ``READY_LOOKBACK`` windows. The head snapshot references the
+        JUST-DISPATCHED window's async output — a reader that insists on
+        it blocks until the fold pipeline catches up, while the window
+        before is typically already materialized."""
+        if not prefer_ready:
+            return self._current
+        recent = self._recent
+        for snap in recent:
+            if _payload_ready(snap.payload):
+                return snap
+        return self._current
+
+    @staticmethod
+    def payload_ready(payload) -> bool:
+        return _payload_ready(payload)
+
+    def head_window(self) -> int:
+        """Window index of the newest snapshot; -2 before any publish
+        (so a boot snapshot's ``-1`` still reads as ahead of nothing)."""
+        snap = self._current
+        return -2 if snap is None else snap.window
+
+    def wait_for(
+        self, min_version: int = 1, timeout: Optional[float] = None
+    ) -> Optional[PublishedSnapshot]:
+        """Block until a snapshot with ``version >= min_version`` exists
+        (or the store closes / the timeout lapses); returns the newest
+        snapshot either way. Readers that only want *some* snapshot pass
+        the default ``min_version=1``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                snap = self._current
+                if snap is not None and snap.version >= min_version:
+                    return snap
+                if self._closed:
+                    return snap
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return snap
+                self._cond.wait(remaining)
+
+    # -- write side ---------------------------------------------------- #
+    def publish(
+        self, payload: Mapping[str, Any], window: int, watermark: int
+    ) -> PublishedSnapshot:
+        """Swap in a new snapshot and wake waiters. The assignment to
+        ``_current`` IS the publication point; the lock below only
+        guards the condition notify."""
+        prev = self._current
+        snap = PublishedSnapshot(
+            payload=payload,
+            window=window,
+            watermark=watermark,
+            version=1 if prev is None else prev.version + 1,
+        )
+        # both swaps are single reference assignments (atomic under the
+        # GIL); _recent is an immutable tuple rebuilt per publish
+        self._recent = (snap, *self._recent)[: self.READY_LOOKBACK + 1]
+        self._current = snap
+        with self._cond:
+            self._cond.notify_all()
+        return snap
+
+    def close(self) -> None:
+        """Release any ``wait_for`` sleepers; the last snapshot stays
+        readable (a closed server still answers from its final state)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

@@ -1,0 +1,124 @@
+"""Serving metrics: per-query-class latency + staleness, as plain dicts.
+
+The reference's design stance is that metrics are ordinary output
+streams (``utils/profiling.py`` docstring); the serving tier keeps it:
+no metrics server, no registry — :meth:`ServingStats.snapshot` returns a
+plain dict and :meth:`ServingStats.stream` yields those dicts like any
+other emission iterator. Percentiles reuse
+:class:`~gelly_streaming_tpu.utils.profiling.StreamProfiler` (one per
+query class; each answered query records as one "window").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator
+
+from ..utils.profiling import StreamProfiler, WindowStats
+
+
+def _pct(sorted_xs: list, q: float) -> float:
+    """Percentile over an ALREADY-SORTED sample list (the same
+    nearest-rank rule as ``StreamProfiler.latency_percentile``)."""
+    if not sorted_xs:
+        return 0.0
+    k = min(
+        len(sorted_xs) - 1,
+        max(0, int(round(q / 100 * (len(sorted_xs) - 1)))),
+    )
+    return sorted_xs[k]
+
+
+class ServingStats:
+    """Aggregates per-query-class latency histograms and staleness
+    gauges. Thread-safe: the query worker records, any thread reads.
+
+    Latency samples are bounded per class (``MAX_SAMPLES``; the oldest
+    half drops when full, so percentiles describe the recent window) —
+    a long-lived server must not grow a list per query forever. The
+    staleness gauges and counts stay exact over the full lifetime."""
+
+    #: per-class latency sample cap (drop-oldest-half on overflow)
+    MAX_SAMPLES = 1 << 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lat: Dict[str, StreamProfiler] = {}
+        self._counts: Dict[str, int] = {}  # lifetime (samples are capped)
+        self._stale_sum: Dict[str, int] = {}
+        self._stale_max: Dict[str, int] = {}
+        self._rejected = 0
+        self._batches = 0
+
+    # -- write side (query worker) ------------------------------------- #
+    def record(self, qclass: str, seconds: float, staleness: int) -> None:
+        """One answered query: wall seconds from submit to answer, and
+        the answer's windows-behind-head staleness."""
+        with self._lock:
+            prof = self._lat.get(qclass)
+            if prof is None:
+                prof = self._lat[qclass] = StreamProfiler()
+                self._stale_sum[qclass] = 0
+                self._stale_max[qclass] = 0
+                self._counts[qclass] = 0
+            if len(prof.stats) >= self.MAX_SAMPLES:
+                prof.stats = prof.stats[self.MAX_SAMPLES // 2 :]
+            prof.record(WindowStats(len(prof.stats), seconds, None))
+            self._counts[qclass] += 1
+            self._stale_sum[qclass] += staleness
+            self._stale_max[qclass] = max(
+                self._stale_max[qclass], staleness
+            )
+
+    def record_batch(self) -> None:
+        with self._lock:
+            self._batches += 1
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self._rejected += 1
+
+    # -- read side ------------------------------------------------------ #
+    def snapshot(self) -> dict:
+        """Plain-dict gauge/histogram export::
+
+            {"rejected": 0, "batches": 12,
+             "queries": {"ConnectedQuery": {
+                 "count": 10000, "p50_ms": 0.8, "p99_ms": 3.1,
+                 "staleness_mean": 0.2, "staleness_max": 2}}}
+        """
+        # copy under the lock, sort OUTSIDE it: sorting 64k samples per
+        # class while holding the lock would block the query worker's
+        # record() (futures settle after it) for milliseconds — tail
+        # latency injected by the act of measuring it
+        with self._lock:
+            out = {
+                "rejected": self._rejected,
+                "batches": self._batches,
+                "queries": {},
+            }
+            copied = {
+                qclass: (
+                    [s.wall_seconds for s in prof.stats],
+                    self._counts[qclass],
+                    self._stale_sum[qclass],
+                    self._stale_max[qclass],
+                )
+                for qclass, prof in self._lat.items()
+            }
+        for qclass, (xs, n, ssum, smax) in copied.items():
+            xs.sort()  # one sort serves both percentiles
+            out["queries"][qclass] = {
+                "count": n,
+                "p50_ms": _pct(xs, 50) * 1e3,
+                "p99_ms": _pct(xs, 99) * 1e3,
+                "staleness_mean": ssum / n if n else 0.0,
+                "staleness_max": smax,
+            }
+        return out
+
+    def stream(self) -> Iterator[dict]:
+        """Unbounded metrics stream: each ``next()`` yields the current
+        snapshot dict (pull-based, like every other emission stream)."""
+        while True:
+            yield self.snapshot()

@@ -264,12 +264,17 @@ def forest_window(
     src_h: np.ndarray,
     dst_h: np.ndarray,
     vcap: int,
-    prep: Optional[WindowPrep] = None,
+    prep: WindowPrep,
     mesh=None,
     tree: bool = False,
     degree: int = 2,
 ) -> Tuple[jax.Array, np.ndarray]:
     """Fold one window (host compact-id columns) into the forest.
+
+    ``prep`` is REQUIRED: it is the reusable per-stream scratch (native
+    wprep handle + vcap-sized table) — constructing one per window would
+    silently re-allocate all of it, defeating the class's design
+    (round-5 advisor finding 4). Callers hold one WindowPrep per stream.
 
     Returns ``(new_canon, touched_ids)`` where ``touched_ids`` holds the
     window's unique endpoints (ORDER UNSPECIFIED: arrival order from the
@@ -278,6 +283,12 @@ def forest_window(
     first-seen log for emission. All device inputs are bucketed to
     powers of two so a stream hits O(log^2) jit signatures.
     """
+    if prep is None:
+        raise ValueError(
+            "forest_window requires a per-stream WindowPrep (its scratch "
+            "is reusable by design; allocating one per window would "
+            "silently re-create the native handle and vcap-sized table)"
+        )
     n = len(src_h)
     if n == 0:
         return canon, np.zeros(0, np.int32)
@@ -290,7 +301,7 @@ def forest_window(
         # width (the edgeblock.py convention), not just powers of two
         wmin = max(wmin, mesh.shape[EDGE_AXIS])
     tids, tcap, wcap, tid, tmask, lu, lv = pad_window(
-        prep or WindowPrep(), src_h, dst_h, vcap, wmin
+        prep, src_h, dst_h, vcap, wmin
     )
     step = _forest_step_fn(tcap, wcap, vcap, mesh, tree, degree)
     canon = step(

@@ -253,15 +253,18 @@ def test_tree_reduce_degree_fanin():
 
     assert run_degree(8) == base
     assert run_degree(8, carry="forest") == base
-    with pytest.raises(ValueError, match="power of the tree degree"):
-        run_degree(3)
-    with pytest.raises(ValueError, match="power of the tree degree"):
-        run_degree(3, carry="forest")
-    # eager validation: even the auto(host) carry — which never runs the
-    # butterfly — must reject a degree that cannot fit the mesh, before
-    # any window is processed (round-5 review)
-    with pytest.raises(ValueError, match="power of the tree degree"):
-        run_degree(3, carry="auto")
+    # a degree the mesh cannot honor degrades to the degree-2 butterfly
+    # with a warning (reference posture: degree configures parallelism
+    # there, enhance()'s fan-in is fixed at 2 — non-conforming degrees
+    # warn and run), producing identical results
+    with pytest.warns(UserWarning, match="falling back"):
+        assert run_degree(3) == base
+    with pytest.warns(UserWarning, match="falling back"):
+        assert run_degree(3, carry="forest") == base
+    # the eager resolve fires even for the auto(host) carry — which
+    # never runs the butterfly — before any window is processed
+    with pytest.warns(UserWarning, match="falling back"):
+        assert run_degree(3, carry="auto") == base
     with pytest.raises(ValueError, match="degree must be >= 2"):
         ConnectedComponentsTree(degree=1)
 

@@ -1,0 +1,391 @@
+"""Serving-subsystem tests: snapshot atomicity, batched query
+correctness vs the offline union-find oracle during LIVE ingest,
+staleness, admission control, and checkpoint-boot-then-serve."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+from gelly_streaming_tpu.core.window import CountWindow
+from gelly_streaming_tpu.library import ConnectedComponents
+from gelly_streaming_tpu.serving import (
+    ComponentSizeQuery,
+    ConnectedQuery,
+    DegreeQuery,
+    Overloaded,
+    RankQuery,
+    SnapshotStore,
+    StreamServer,
+)
+
+from _uf import union_find_components
+
+
+# --------------------------------------------------------------------- #
+# Oracle: per-window DSU root snapshots
+# --------------------------------------------------------------------- #
+def _dsu_window_roots(src, dst, window, n_vertices):
+    """roots[w][v] = v's union-find root after windows 0..w folded."""
+    parent = list(range(n_vertices))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    out = []
+    for start in range(0, len(src), window):
+        for a, b in zip(src[start : start + window],
+                        dst[start : start + window]):
+            ra, rb = find(int(a)), find(int(b))
+            if ra != rb:
+                parent[max(ra, rb)] = min(ra, rb)
+        out.append(np.asarray([find(v) for v in range(n_vertices)]))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# 1. Snapshot swap atomicity under a writer thread
+# --------------------------------------------------------------------- #
+def test_snapshot_swap_atomicity_under_writer():
+    """Readers racing a fast writer must only ever observe internally
+    consistent snapshots (payload built as a coupled pair) with
+    monotonically increasing versions."""
+    store = SnapshotStore()
+    n_pub = 2000
+    stop = threading.Event()
+    torn = []
+
+    def write():
+        for i in range(n_pub):
+            a = np.full(8, i)
+            store.publish({"a": a, "b": -a}, window=i, watermark=i)
+        stop.set()
+
+    def read():
+        last_version = 0
+        while not stop.is_set() or store.latest() is None:
+            snap = store.latest()
+            if snap is None:
+                continue
+            a, b = snap.payload["a"], snap.payload["b"]
+            if not np.array_equal(a, -b) or a[0] != snap.window:
+                torn.append(snap.version)
+            if snap.version < last_version:
+                torn.append(("version regressed", snap.version))
+            last_version = snap.version
+
+    readers = [threading.Thread(target=read) for _ in range(3)]
+    w = threading.Thread(target=write)
+    for t in readers:
+        t.start()
+    w.start()
+    w.join()
+    for t in readers:
+        t.join()
+    assert not torn
+    final = store.latest()
+    assert final.version == n_pub and final.window == n_pub - 1
+
+
+# --------------------------------------------------------------------- #
+# 2. Batched CC queries vs the offline oracle, during live ingest
+# --------------------------------------------------------------------- #
+def test_batched_cc_queries_match_oracle_during_ingest():
+    """10k ConnectedQuerys submitted while the stream runs: every answer
+    must match the offline union-find oracle AT THE ANSWERED SNAPSHOT'S
+    WINDOW (staleness-consistent reads, not just final-state reads)."""
+    rng = np.random.default_rng(42)
+    n_vertices, window, n_win = 96, 50, 40
+    src = rng.integers(0, n_vertices, window * n_win).astype(np.int32)
+    dst = rng.integers(0, n_vertices, window * n_win).astype(np.int32)
+    roots = _dsu_window_roots(src, dst, window, n_vertices)
+
+    gate = threading.Event()
+
+    def edges():
+        for i, (a, b) in enumerate(zip(src.tolist(), dst.tolist())):
+            if i % window == 0 and i:
+                gate.wait(0.001)  # let queries land mid-stream
+            yield a, b
+
+    stream = SimpleEdgeStream(edges(), window=CountWindow(window))
+    agg = ConnectedComponents()
+    server = StreamServer(agg.servable(), stream, max_pending=20_000)
+    server.start()
+
+    n_q = 10_000
+    qu = rng.integers(0, n_vertices, n_q)
+    qv = rng.integers(0, n_vertices, n_q)
+    futures = []
+    for i in range(n_q):
+        futures.append(
+            server.submit(ConnectedQuery(int(qu[i]), int(qv[i])))
+        )
+        if i % 500 == 0:
+            time.sleep(0.001)
+    gate.set()
+
+    windows_seen = set()
+    for i, f in enumerate(futures):
+        ans = f.result(60)
+        windows_seen.add(ans.window)
+        r = roots[ans.window]
+        want = bool(r[qu[i]] == r[qv[i]])
+        assert ans.value == want, (
+            f"query {i} ({qu[i]},{qv[i]}) at window {ans.window}: "
+            f"got {ans.value}, oracle {want}"
+        )
+    server.join(60)
+    server.close()
+    # answers must actually have been batched (coalesced sweeps), not
+    # answered one dispatch per query
+    stats = server.stats.snapshot()
+    assert stats["queries"]["ConnectedQuery"]["count"] == n_q
+    assert stats["batches"] < n_q
+    assert windows_seen  # at least one window answered
+
+
+def test_component_size_and_final_components_match_oracle():
+    rng = np.random.default_rng(3)
+    n_vertices = 40
+    src = rng.integers(0, n_vertices, 300).astype(np.int32)
+    dst = rng.integers(0, n_vertices, 300).astype(np.int32)
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(64))
+    agg = ConnectedComponents()
+    with StreamServer(agg.servable(), stream) as server:
+        server.join(60)
+        comps = union_find_components(zip(src.tolist(), dst.tolist()))
+        by_vertex = {}
+        for comp in comps:
+            for v in comp:
+                by_vertex[v] = comp
+        for v in range(n_vertices):
+            size = server.ask(ComponentSizeQuery(v), 30)
+            want = len(by_vertex.get(v, ())) or 1  # seen singletons: 1
+            if v not in by_vertex:
+                # vertex the stream never touched: still a valid answer
+                # (its own singleton slot in the compact table)
+                assert size.value in (0, 1)
+            else:
+                assert size.value == want, (v, size)
+        u, v = sorted(by_vertex)[0], sorted(by_vertex)[-1]
+        same = by_vertex[u] is by_vertex[v]
+        assert server.ask(ConnectedQuery(u, v), 30).value == same
+
+
+# --------------------------------------------------------------------- #
+# 3. Staleness bound after stream end
+# --------------------------------------------------------------------- #
+def test_staleness_zero_after_stream_end():
+    src = np.arange(100, dtype=np.int32)
+    dst = (np.arange(100, dtype=np.int32) + 1) % 100
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(10))
+    agg = ConnectedComponents()
+    with StreamServer(agg.servable(), stream) as server:
+        server.join(60)
+        ans = server.ask(ConnectedQuery(0, 99), 30)
+        assert ans.value is True or ans.value == True  # noqa: E712
+        assert ans.window == 9  # 100 edges / 10-edge windows
+        assert ans.staleness == 0
+        assert ans.watermark == 100  # exact edge watermark (host cache)
+
+
+# --------------------------------------------------------------------- #
+# 4. Admission control
+# --------------------------------------------------------------------- #
+def test_wrong_query_class_rejected_synchronously():
+    """A misdirected query class fails the CALLER, not the drained batch
+    of valid concurrent queries it would otherwise poison."""
+    src = np.asarray([0, 1], np.int32)
+    dst = np.asarray([1, 2], np.int32)
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(2))
+    agg = ConnectedComponents()
+    with StreamServer(agg.servable(), stream) as server:
+        server.join(60)
+        with pytest.raises(TypeError, match="DegreeQuery"):
+            server.submit(DegreeQuery(0))
+        assert server.ask(ConnectedQuery(0, 2), 30).value is True
+
+
+def test_overloaded_rejection_at_queue_limit():
+    release = threading.Event()
+
+    def blocked_payloads():
+        release.wait(30)
+        return
+        yield  # pragma: no cover
+
+    server = StreamServer(blocked_payloads(), None, max_pending=4)
+    server.start()
+    futs = [server.submit(ConnectedQuery(0, 1)) for _ in range(4)]
+    with pytest.raises(Overloaded):
+        server.submit(ConnectedQuery(0, 1))
+    assert server.stats.snapshot()["rejected"] == 1
+    release.set()
+    server.close()
+    # admitted queries were drained explicitly: no snapshot ever
+    # published, so they fail fast instead of hanging
+    for f in futs:
+        with pytest.raises(RuntimeError):
+            f.result(5)
+
+
+# --------------------------------------------------------------------- #
+# 5. Checkpoint-boot-then-serve round trip
+# --------------------------------------------------------------------- #
+def test_checkpoint_boot_then_serve(tmp_path):
+    from gelly_streaming_tpu.aggregate.checkpoint import (
+        load_vertex_dict,
+        restore_server,
+        save_aggregation,
+    )
+
+    rng = np.random.default_rng(7)
+    n_vertices = 64
+    raw_ids = rng.permutation(10_000)[:n_vertices]  # sparse raw id space
+    e1 = rng.integers(0, n_vertices, 400)
+    f1 = rng.integers(0, n_vertices, 400)
+    e2 = rng.integers(0, n_vertices, 400)
+    f2 = rng.integers(0, n_vertices, 400)
+
+    def pairs(es, fs):
+        return [(int(raw_ids[a]), int(raw_ids[b])) for a, b in zip(es, fs)]
+
+    # phase 1: run + checkpoint
+    s1 = SimpleEdgeStream(pairs(e1, f1), window=CountWindow(50))
+    agg1 = ConnectedComponents()
+    for _ in s1.aggregate(agg1):
+        pass
+    path = str(tmp_path / "cc")
+    save_aggregation(path, agg1, vdict=s1.vertex_dict)
+
+    # phase 2: boot a server from the checkpoint, catch up on the rest
+    vdict = load_vertex_dict(path)
+    s2 = SimpleEdgeStream(
+        pairs(e2, f2), window=CountWindow(50), vertex_dict=vdict
+    )
+    agg2 = ConnectedComponents()
+    server = restore_server(path, agg2, s2)
+    try:
+        # the boot snapshot (window -1) serves the RESTORED state before
+        # any catch-up window folds
+        boot = server.snapshot()
+        assert boot is not None and boot.version >= 1
+        half = union_find_components(pairs(e1, f1))
+        by_v1 = {v: c for c in half for v in c}
+        u, v = pairs(e1, f1)[0]
+        ans = server.ask(ConnectedQuery(u, v), 30)
+        if ans.window == -1:  # answered from the boot snapshot
+            assert ans.value == (by_v1.get(u) is by_v1.get(v) and u in by_v1)
+
+        server.join(60)
+        full = union_find_components(pairs(e1, f1) + pairs(e2, f2))
+        by_v = {v: c for c in full for v in c}
+        qs = rng.integers(0, n_vertices, 200)
+        rs = rng.integers(0, n_vertices, 200)
+        for a, b in zip(qs, rs):
+            u, v = int(raw_ids[a]), int(raw_ids[b])
+            want = (u in by_v and by_v.get(u) is by_v.get(v)) or u == v
+            got = server.ask(ConnectedQuery(u, v), 30)
+            assert got.value == want, (u, v, got)
+            assert got.staleness == 0
+    finally:
+        server.close()
+
+
+# --------------------------------------------------------------------- #
+# Degree + rank serving
+# --------------------------------------------------------------------- #
+def test_degree_serving_matches_truth():
+    from gelly_streaming_tpu.library.degrees import DegreeDistribution
+
+    rng = np.random.default_rng(5)
+    n_vertices = 32
+    events = [
+        (int(a), int(b), "+")
+        for a, b in zip(
+            rng.integers(0, n_vertices, 500),
+            rng.integers(0, n_vertices, 500),
+        )
+    ]
+    dd = DegreeDistribution(window=CountWindow(64))
+    with StreamServer(dd.servable(), events) as server:
+        server.join(60)
+        deg = {}
+        for a, b, _ in events:
+            deg[a] = deg.get(a, 0) + 1
+            deg[b] = deg.get(b, 0) + 1
+        for v in range(n_vertices):
+            ans = server.ask(DegreeQuery(v), 30)
+            assert ans.value == deg.get(v, 0), v
+        # never-seen raw id answers 0, not an error
+        assert server.ask(DegreeQuery(10_000), 30).value == 0
+
+
+def test_rank_serving_matches_ranks_view():
+    from gelly_streaming_tpu.library.pagerank import IncrementalPageRank
+
+    rng = np.random.default_rng(9)
+    n_vertices = 32
+    src = rng.integers(0, n_vertices, 400).astype(np.int32)
+    dst = rng.integers(0, n_vertices, 400).astype(np.int32)
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(100))
+    pr = IncrementalPageRank(tol=1e-8, max_iter=200)
+    with StreamServer(pr.servable(), stream) as server:
+        server.join(60)
+        truth = pr.ranks()
+        for v, want in list(truth.items())[:16]:
+            got = server.ask(RankQuery(v), 30)
+            np.testing.assert_allclose(got.value, want, rtol=1e-5)
+        assert server.ask(RankQuery(99_999), 30).value == 0.0
+
+
+# --------------------------------------------------------------------- #
+# Satellite guards riding this PR
+# --------------------------------------------------------------------- #
+def test_forest_window_requires_prep():
+    from gelly_streaming_tpu.summaries.forest import forest_window, init_forest
+
+    s = np.asarray([0, 1], np.int32)
+    d = np.asarray([1, 2], np.int32)
+    with pytest.raises(ValueError, match="WindowPrep"):
+        forest_window(init_forest(4), s, d, 4, None)
+
+
+def test_restore_rejects_non_min_rooted_labels():
+    import jax.numpy as jnp
+
+    agg = ConnectedComponents(carry="forest")
+    bad = {
+        "labels": jnp.asarray([0, 1, 3, 3], jnp.int32),  # label[2] > 2
+        "touched": jnp.ones(4, bool),
+    }
+    agg.restore_state(bad, vcap=4)
+    stream = SimpleEdgeStream([(0, 1)], window=CountWindow(4))
+    with pytest.raises(ValueError, match="min-rooted"):
+        for _ in stream.aggregate(agg):
+            pass
+
+
+def test_cuf_fold_window_validates_before_mutating():
+    from gelly_streaming_tpu import native
+
+    if not native.native_available():
+        pytest.skip("native toolchain unavailable")
+    uf = native.CompactUnionFind()
+    uf.fold(np.asarray([0, 1], np.int32), np.asarray([1, 2], np.int32), 4)
+    before = uf.flatten(4).tolist()
+    with pytest.raises(ValueError):
+        # (2,3) is valid but must NOT be applied: id 9 later in the same
+        # window fails the prepass, so the whole window is rejected
+        uf.fold(np.asarray([2, 9], np.int32),
+                np.asarray([3, 0], np.int32), 4)
+    assert uf.flatten(4).tolist() == before
+    # the carry keeps working after the rejected window
+    uf.fold(np.asarray([2], np.int32), np.asarray([3], np.int32), 4)
+    assert uf.flatten(4).tolist() == [0, 0, 0, 0]
