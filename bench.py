@@ -106,37 +106,91 @@ def probe_backend() -> tuple:
     return False, reasons
 
 
-def stale_headline(probe_reasons=None) -> dict:
-    """Last-good headline, tagged stale — emitted (rc 0) when the backend
-    stays down so an outage costs freshness, not the round's artifact.
-    Records WHY the pinned probe failed and when, so 'tunnel down' can
-    never be confused with 'new code wedged the bench' (which would fail
-    AFTER a green probe, with a nonzero exit the driver sees).
-    Sources, newest first: BENCH_DETAIL.json, then driver BENCH_r*.json."""
-    import glob
-    import os
+#: substrings that mark an artifact_note as a RETRACTION of the
+#: artifact's own numbers (the round-3 BENCH_DETAIL.json shape:
+#: "measurement bugs diagnosed", "inflated", "physically impossible")
+_RETRACTION_MARKERS = (
+    "bug", "retract", "inflat", "impossible", "invalid", "unsynced",
+    "do not trust",
+)
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    candidates = [os.path.join(here, "BENCH_DETAIL.json")] + sorted(
-        glob.glob(os.path.join(here, "BENCH_r*.json")), reverse=True
-    )
+
+def _artifact_honest(doc: dict, headline: dict) -> bool:
+    """Whether an artifact may seed the stale fallback.
+
+    An artifact is DISQUALIFIED when it disclaims itself: a headline
+    that is already ``stale`` (replaying it would launder a replay into
+    a fresh-looking value — the BENCH_r05 failure), a ``partial`` /
+    ``incomplete`` flush, an explicit ``retracted`` flag, or an
+    ``artifact_note`` whose text retracts the numbers (round-3
+    BENCH_DETAIL.json annotates its own measurement bugs)."""
+    if headline.get("stale") or doc.get("partial") or doc.get("incomplete"):
+        return False
+    if doc.get("retracted"):
+        return False
+    note = str(doc.get("artifact_note", "")).lower()
+    return not any(m in note for m in _RETRACTION_MARKERS)
+
+
+def stale_headline(probe_reasons=None, root=None) -> dict:
+    """Last-good HONEST headline, tagged stale — emitted (rc 0) when the
+    backend stays down so an outage costs freshness, not the round's
+    artifact. Records WHY the pinned probe failed and when, so 'tunnel
+    down' can never be confused with 'new code wedged the bench' (which
+    would fail AFTER a green probe, with a nonzero exit the driver sees).
+
+    Provenance (round-5 verdict weak #1 — the fallback replayed the
+    retracted round-3 BENCH_DETAIL.json into the round headline):
+    sources are only artifacts THIS bench writes under its measurement
+    discipline — BENCH_DETAIL.json, BENCH_CPU.json, BENCH_NORTHSTAR*.json
+    — each vetted by :func:`_artifact_honest`; driver roundups
+    (BENCH_r*.json) are never sources (they echo earlier bench output,
+    so replaying one can only re-launder). When no honest artifact
+    exists the fallback emits ``value: null`` rather than a number the
+    repo has disavowed."""
+    here = root or os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(here, "BENCH_DETAIL.json"),
+        os.path.join(here, "BENCH_CPU.json"),
+        os.path.join(here, "BENCH_NORTHSTAR.json"),
+        os.path.join(here, "BENCH_NORTHSTAR_CPU.json"),
+    ]
     for path in candidates:
         try:
             with open(path) as f:
                 doc = json.load(f)
         except (OSError, json.JSONDecodeError):
             continue
-        h = doc.get("headline", doc)
-        if isinstance(h, dict) and "metric" in h and "value" in h:
-            h = dict(h)
-            h["stale"] = True
-            h["stale_source"] = os.path.basename(path)
-            h["stale_reason"] = probe_reasons or []
-            h["stale_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-            return h
+        h = doc.get("headline")
+        if h is None and isinstance(doc.get("window_100m"), dict):
+            # northstar artifacts carry no headline key; synthesize the
+            # north-star metric so a complete honest northstar can seed
+            # the fallback (the metric name rides along, so the value
+            # is never mistaken for the streaming-CC headline)
+            h = {
+                "metric": "northstar_cc_100m_window_edges_per_sec",
+                "value": doc["window_100m"].get("eps"),
+                "unit": "edges/sec",
+                "vs_baseline": doc.get("vs_baseline_100m"),
+            }
+        if h is None:
+            h = doc
+        if not (isinstance(h, dict) and "metric" in h
+                and h.get("value") is not None):
+            continue
+        if not _artifact_honest(doc, h):
+            log(f"bench: stale fallback skipping {os.path.basename(path)} "
+                "(retracted/partial/already-stale)")
+            continue
+        h = dict(h)
+        h["stale"] = True
+        h["stale_source"] = os.path.basename(path)
+        h["stale_reason"] = probe_reasons or []
+        h["stale_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        return h
     return {
-        "metric": "streaming_cc_e2e_edges_per_sec", "value": 0.0,
-        "unit": "edges/sec", "vs_baseline": 0.0, "stale": True,
+        "metric": "streaming_cc_e2e_edges_per_sec", "value": None,
+        "unit": "edges/sec", "vs_baseline": None, "stale": True,
         "stale_source": None, "stale_reason": probe_reasons or [],
         "stale_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
@@ -370,13 +424,27 @@ def bench_cc_e2e_device_text(path: str, cap_hint: int, n_edges: int) -> dict:
     return out
 
 
+def auto_superbatch_k(window: int, target: int = 1 << 18) -> int:
+    """Default superbatch K for a window size: enough windows per group
+    to put ~256k edges in one fused dispatch (where the measured
+    per-window fixed costs amortize to noise), capped at 256."""
+    return max(1, min(256, target // max(1, window)))
+
+
 def bench_latency_window(binp: str, bound: int, window: int,
-                         n_edges: int = 1 << 22) -> dict:
+                         n_edges: int = 1 << 22,
+                         superbatch: int = 1) -> dict:
     """One point of the latency/throughput curve (round-3 verdict missing
     #1: the low-latency micro-batch configuration was never measured):
     streaming CC over a corpus prefix at the given CountWindow, recording
     per-window p50/p95 latency alongside throughput. Small windows buy
-    latency with dispatch overhead; the curve quantifies the trade."""
+    latency with dispatch overhead; the curve quantifies the trade.
+
+    ``superbatch=K > 1`` measures the fused K-window path (ISSUE 2): one
+    dispatch per K windows, per-window emission values unchanged. Note
+    the p50/p95 then measure EMISSION INTER-ARRIVAL — a group's K
+    records surface together, so p50 collapses and p95 reflects the
+    group period (the latency grain the superbatch trades away)."""
     from gelly_streaming_tpu import datasets
     from gelly_streaming_tpu.core.stream import SimpleEdgeStream
     from gelly_streaming_tpu.core.window import CountWindow
@@ -400,7 +468,7 @@ def bench_latency_window(binp: str, bound: int, window: int,
         lat = []
         t0 = time.perf_counter()
         last_t = t0
-        agg = ConnectedComponents()
+        agg = ConnectedComponents(superbatch=superbatch)
         for _ in stream.aggregate(agg):
             now = time.perf_counter()
             lat.append(now - last_t)
@@ -408,17 +476,116 @@ def bench_latency_window(binp: str, bound: int, window: int,
         agg.sync()  # throughput, not enqueue rate
         dt = time.perf_counter() - t0
         lat_ms = np.asarray(lat) * 1e3
-        return {
+        out = {
             "window": window,
             "eps": len(src) / dt,
             "p50_ms": float(np.percentile(lat_ms, 50)),
             "p95_ms": float(np.percentile(lat_ms, 95)),
             "carry": agg._cc_mode,
         }
+        if superbatch > 1:
+            out["superbatch"] = superbatch
+        return out
 
     out, eps_all = median_steady(one_pass)
     out["eps_all"] = eps_all
     return out
+
+
+LATENCY_SWEEP_WEXP = (10, 12, 13, 14, 16, 18, 20, 22, 24)
+
+
+def run_latency_curve(artifact: str, cpu: bool = False) -> dict:
+    """The full window-size sweep 1k -> 16M as a KEYED artifact (ISSUE 2
+    satellite: the cliff was tracked only by a one-off BENCH_CPU entry).
+    Per window size: the per-window path and, where the superbatch can
+    bite (window <= 256k), the fused path at :func:`auto_superbatch_k`.
+    Each point runs in a fresh subprocess (the in-process degradation
+    discipline); the artifact flushes incrementally and is marked
+    ``incomplete`` until every point landed."""
+    import subprocess
+
+    from gelly_streaming_tpu import datasets
+
+    path, is_real = _corpus_path()
+    bound = _id_bound(path, is_real)
+    binp = datasets.binary_cache(path)
+    corpus_edges = int(np.sum(
+        [len(c[0]) for c in datasets.iter_binary_chunks(binp, 1 << 24)]
+    ))
+    doc = {
+        "note": (
+            "streaming-CC latency/throughput vs window size, per-window "
+            "vs superbatch (fused K-window dispatch). Small-window "
+            "points use the same 4M-edge prefix + identity mapping as "
+            "BENCH_CPU.json's historical latency_curve for "
+            "comparability; superbatch p50/p95 measure emission "
+            "inter-arrival (a group's records surface together)."
+        ),
+        "platform": "cpu-xla" if cpu else "default",
+        "corpus": path,
+        "corpus_edges": corpus_edges,
+        "points": {},
+        "incomplete": True,
+    }
+    pin = (
+        "import jax; jax.config.update('jax_platforms','cpu'); "
+        if cpu else ""
+    )
+
+    def flush():
+        with open(artifact, "w") as f:
+            json.dump(doc, f, indent=2)
+
+    flush()
+    failures = 0
+    for wexp in LATENCY_SWEEP_WEXP:
+        window = 1 << wexp
+        if window > corpus_edges:
+            break
+        n_e = min(corpus_edges, max(1 << 22, window))
+        point = {}
+        variants = [("per_window", 1)]
+        k = auto_superbatch_k(window)
+        if k > 1:
+            variants.append(("superbatch", k))
+        for name, kk in variants:
+            log(f"latency-curve: window=2^{wexp} {name} (k={kk})...")
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-c",
+                     f"{pin}import bench, json; "
+                     f"print(json.dumps(bench.bench_latency_window({binp!r}, "
+                     f"{bound}, {window}, n_edges={n_e}, superbatch={kk})))"],
+                    capture_output=True, text=True, timeout=1800,
+                )
+            except subprocess.TimeoutExpired:
+                # one hung point is a per-point failure, not a crashed
+                # sweep: the remaining points still run and the artifact
+                # keeps its incomplete marker + nonzero exit
+                point[name] = None
+                failures += 1
+                log(f"latency-curve: {name} @2^{wexp} hung >1800s")
+                continue
+            if out.returncode == 0:
+                point[name] = _parse_sub(out.stdout)
+            else:
+                point[name] = None
+                failures += 1
+                log(out.stderr[-500:])
+        if point.get("per_window") and point.get("superbatch"):
+            point["superbatch_speedup"] = round(
+                point["superbatch"]["eps"] / point["per_window"]["eps"], 2
+            )
+        doc["points"][str(window)] = point
+        flush()
+    if not failures:
+        doc.pop("incomplete")
+    flush()
+    log(f"latency-curve: {json.dumps(doc)}")
+    if failures:
+        sys.exit(1)
+    return doc
 
 
 def bench_cc_flink_proxy(src, dst) -> dict:
@@ -1430,9 +1597,12 @@ def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
 
     def _flush():
         # partial artifact after every expensive phase: a runner timeout
-        # mid-northstar must still leave committed evidence
+        # mid-northstar must still leave committed evidence — marked
+        # BOTH partial and incomplete so no consumer (including the
+        # stale fallback and a later commit) can mistake the hole for a
+        # finished measurement (round-5 verdict weak #3)
         with open(artifact, "w") as f:
-            json.dump(dict(doc, partial=True), f, indent=2)
+            json.dump(dict(doc, partial=True, incomplete=True), f, indent=2)
 
     log(f"northstar: {n_edges} edges; 1M-edge windows...")
     e2e = run_e2e(WINDOW)
@@ -1443,7 +1613,6 @@ def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
     doc["vs_baseline"] = round(e2e["eps"] / base["eps"], 2)
     doc["vs_flink"] = round(e2e["eps"] / flink["eps"], 2)
     _flush()
-    e2e_ident = None
     if device_encode:
         # the identity-mapping variant keeps compact columns host-visible,
         # which unlocks the window-local carries (forest/host) — at
@@ -1461,17 +1630,36 @@ def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
         )
         doc["window_1m_identity"] = e2e_ident
         _flush()
+    else:
+        # the CPU path already runs the identity mapping as ITS e2e
+        # pipeline (the device-dict probe kernel is TPU-oriented), so
+        # window_1m IS the identity configuration; recording it under
+        # both keys keeps the schema hole-free (the committed round-5
+        # artifact shipped `"window_1m_identity": null` because this
+        # assignment was missing — round-5 verdict weak #3)
+        doc["window_1m_identity"] = e2e
     log("northstar: one 100M-edge window...")
     mega = run_e2e(max(n_edges, 100_000_000))
     assert mega["components"] == base["components"], (
         mega["components"], base["components"]
     )
-    doc["window_1m_identity"] = e2e_ident
     doc["window_100m"] = mega
     # BASELINE.md's north-star config IS the 100M-edge window; the
     # 1M-window series is the latency-oriented configuration
     doc["vs_baseline_100m"] = round(mega["eps"] / base["eps"], 2)
     doc["vs_flink_100m"] = round(mega["eps"] / flink["eps"], 2)
+    holes = [
+        key for key in ("window_1m", "window_1m_identity", "window_100m")
+        if doc.get(key) is None
+    ]
+    if holes:
+        # a hole can never be silently committed as a finished artifact
+        # again: mark it and FAIL the run so the driver sees it
+        doc["incomplete"] = True
+        with open(artifact, "w") as f:
+            json.dump(doc, f, indent=2)
+        log(f"northstar: INCOMPLETE (holes: {holes}) — failing the run")
+        sys.exit(1)
     with open(artifact, "w") as f:
         json.dump(doc, f, indent=2)
     log(f"northstar: {json.dumps(doc)}")
@@ -1533,6 +1721,32 @@ def main():
         info, _s64, _d64 = _headline()
         with open(out_path, "w") as f:
             json.dump(info, f)
+        return
+
+    if "--latency-curve" in sys.argv:
+        # window-size sweep 1k -> 16M, per-window vs superbatch, to a
+        # keyed artifact (ISSUE 2 satellite: track the cliff per round)
+        cpu = "--cpu" in sys.argv
+        if cpu:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        elif "--no-probe" not in sys.argv:
+            ok, probe_reasons = probe_backend()
+            if not ok:
+                log("bench: backend down — latency curve needs a live "
+                    "backend (no stale fallback for curve artifacts)")
+                sys.exit(1)
+        artifact = "BENCH_LATENCY_CPU.json" if cpu else "BENCH_LATENCY.json"
+        doc = run_latency_curve(artifact, cpu=cpu)
+        small = doc["points"].get("1024", {})
+        print(json.dumps({
+            "metric": "latency_curve_superbatch_eps_at_1024",
+            "value": (small.get("superbatch") or {}).get("eps"),
+            "unit": "edges/sec",
+            "points": len(doc["points"]),
+            "artifact": artifact,
+        }))
         return
 
     if "--serving" in sys.argv:

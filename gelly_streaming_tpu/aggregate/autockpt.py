@@ -28,6 +28,20 @@ Flink's checkpoint coordinator, not of its exactly-once sink protocol:
 emissions between the last barrier and a kill are re-emitted after
 resume, exactly like Flink's at-least-once outputs without transactional
 sinks.
+
+SUPERBATCH GRANULARITY: when the work runs with ``superbatch=K > 1``
+(``SummaryAggregation``), K windows execute as one fused scan dispatch
+and the carried summary is only observable on group boundaries —
+between a group's yields, ``snapshot_state()`` would capture the
+END-of-group summary while ``windows_done`` recorded a mid-group index,
+and the resume would re-fold windows the state already contains
+(harmless for idempotent semilattice summaries like CC, wrong for
+counting summaries like degrees). Barriers therefore land only on
+window indices that are BOTH a multiple of ``every`` and a multiple of
+K (effectively ``lcm(every, K)``); pick ``every`` a multiple of K to
+keep the nominal cadence. Mid-superbatch kills restore from the last
+group-aligned barrier and replay, which the equivalence tests pin
+(``tests/test_superbatch.py``).
 """
 
 from __future__ import annotations
@@ -42,6 +56,12 @@ import numpy as np
 class _SkipStream:
     """View of a stream whose first ``skip`` windows are consumed (for
     vertex-dictionary replay) but not surfaced to the workload."""
+
+    #: disable the wrapped stream's superbatch fast path: the replay
+    #: skip applies to blocks(), which the generic group packer
+    #: (``core.window.iter_superbatches``) consumes — forwarding the
+    #: inner packer would resurface the skipped windows
+    superbatches = None
 
     def __init__(self, stream, skip: int):
         self._stream = stream
@@ -95,11 +115,20 @@ class AutoCheckpoint:
         self.restored_vdict = vdict
         stream = make_stream(vdict)
         src = _SkipStream(stream, done) if done else stream
+        # barrier alignment (see module doc): under superbatch=K the
+        # summary is only valid on group boundaries. The work reports
+        # its EFFECTIVE granularity (1 when its run loop opts out of
+        # superbatching — host-side aggregations, transient CC), so a
+        # per-window run keeps the full `every` cadence. `done` is
+        # always group-aligned, so a resumed run's groups re-tile
+        # identically.
+        gran = getattr(work, "checkpoint_granularity", None)
+        k = int(gran()) if callable(gran) else 1
         w = done
         for batch in work.run(src):
             yield batch
             w += 1
-            if w % self.every == 0:
+            if w % self.every == 0 and w % k == 0:
                 self._snapshot(work, stream.vertex_dict, w)
 
     def restored_emission(self, work):

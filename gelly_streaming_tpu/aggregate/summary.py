@@ -40,8 +40,9 @@ from typing import Any, Callable, Iterator, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
-from ..core.edgeblock import EdgeBlock
+from ..core.edgeblock import EdgeBlock, StackedEdgeBlock
 from ..parallel import comm
 from ..parallel.mesh import EDGE_AXIS
 from jax.sharding import PartitionSpec as P
@@ -75,6 +76,19 @@ class SummaryAggregation(abc.ABC):
     mesh:
         Optional ``jax.sharding.Mesh`` with an ``"edges"`` axis; falls back
         to the stream context's mesh, else single-device execution.
+    superbatch:
+        Fuse this many consecutive windows into ONE jitted dispatch — a
+        ``lax.scan`` over a ``[K, cap]``
+        :class:`~gelly_streaming_tpu.core.edgeblock.StackedEdgeBlock` —
+        instead of K separate window steps. Amortizes the per-window
+        fixed cost (host block assembly + dispatch) that dominates below
+        ~64k-edge windows (the BENCH_CPU latency cliff: 714k eps at
+        1024-edge windows vs 15.5M at 1M). Emission SEQUENCE is
+        unchanged (one record per window, same values); emission TIMING
+        batches — the K records of a superbatch surface together after
+        its single dispatch, and the stacked per-window summaries cost
+        K x summary bytes of device memory while their lazy emissions
+        are live. ``1`` (default) keeps the per-window path.
 
     Contract for the state hooks (initial/update/combine): they must be
     pure functions of their arguments for a given constructor
@@ -93,9 +107,13 @@ class SummaryAggregation(abc.ABC):
     #: key. Values must be hashable.
     config_fields: tuple = ()
 
-    def __init__(self, transient_state: bool = False, mesh=None):
+    def __init__(self, transient_state: bool = False, mesh=None,
+                 superbatch: int = 1):
         self.transient_state = transient_state
         self.mesh = mesh
+        if superbatch < 1:
+            raise ValueError(f"superbatch must be >= 1, got {superbatch}")
+        self.superbatch = int(superbatch)
         self._summary = None
         self._vcap = 0
         self._sync_ref = None  # last dispatched window state (sync target)
@@ -147,6 +165,49 @@ class SummaryAggregation(abc.ABC):
             return None
         return mesh
 
+    def _make_partial_fn(self, vcap: int, mesh) -> Callable:
+        """Build the traced one-window fold: per-shard ``update`` from
+        ``initial_state`` + cross-shard combine. Shared by the per-window
+        step and the superbatch scan body so the two paths cannot drift."""
+        p = mesh.shape[EDGE_AXIS] if mesh is not None else 1
+        tree = self._is_tree()
+        # a fan-in the mesh cannot honor degrades to 2 with a warning
+        # (reference posture; see SummaryTreeReduce docstring). Only
+        # the tree engine runs the butterfly — resolving for bulk
+        # aggregations would warn about a collective they never run.
+        degree = (
+            comm.resolve_tree_degree(p, getattr(self, "degree", 2))
+            if tree and mesh is not None else 2
+        )
+
+        def partial_fn(src, dst, val, mask):
+            init = self.initial_state(vcap)
+            if mesh is None:
+                return self.update(init, src, dst, val, mask)
+
+            def shard_fn(src, dst, val, mask):
+                part = self.update(init, src, dst, val, mask)
+                if tree:
+                    return comm.tree_all_reduce(
+                        part, EDGE_AXIS, self.combine, p, degree=degree,
+                    )
+                return jax.tree.map(lambda x: x[None], part)
+
+            in_specs = (
+                P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)
+            )
+            out_specs = jax.tree.map(
+                lambda _: P() if tree else P(EDGE_AXIS), init
+            )
+            out = comm.shard_map(shard_fn, mesh, in_specs, out_specs)(
+                src, dst, val, mask
+            )
+            # bulk: stacked shard partials -> log-depth reduction
+            # (the timeWindowAll gather analog)
+            return out if tree else comm.stacked_reduce(out, p, self.combine)
+
+        return partial_fn
+
     def _window_step(self, summary: Any, block: EdgeBlock, vcap: int, mesh) -> Any:
         """One window's full pipeline — per-shard fold, cross-shard combine,
         Merger merge — as ONE jitted dispatch (the keyBy->fold->reduce->
@@ -156,47 +217,10 @@ class SummaryAggregation(abc.ABC):
         cache_key = (self.step_cache_key(), vcap, mesh, self._is_tree())
         step_fn = _STEP_CACHE.get(cache_key)
         if step_fn is None:
-            p = mesh.shape[EDGE_AXIS] if mesh is not None else 1
-            tree = self._is_tree()
-            # a fan-in the mesh cannot honor degrades to 2 with a warning
-            # (reference posture; see SummaryTreeReduce docstring). Only
-            # the tree engine runs the butterfly — resolving for bulk
-            # aggregations would warn about a collective they never run.
-            degree = (
-                comm.resolve_tree_degree(p, getattr(self, "degree", 2))
-                if tree and mesh is not None else 2
-            )
+            partial_fn = self._make_partial_fn(vcap, mesh)
 
             def step(summary, src, dst, val, mask):
-                init = self.initial_state(vcap)
-                if mesh is None:
-                    partial = self.update(init, src, dst, val, mask)
-                else:
-                    def shard_fn(src, dst, val, mask):
-                        part = self.update(init, src, dst, val, mask)
-                        if tree:
-                            return comm.tree_all_reduce(
-                                part, EDGE_AXIS, self.combine, p,
-                                degree=degree,
-                            )
-                        return jax.tree.map(lambda x: x[None], part)
-
-                    in_specs = (
-                        P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS), P(EDGE_AXIS)
-                    )
-                    out_specs = jax.tree.map(
-                        lambda _: P() if tree else P(EDGE_AXIS), init
-                    )
-                    out = comm.shard_map(shard_fn, mesh, in_specs, out_specs)(
-                        src, dst, val, mask
-                    )
-                    # bulk: stacked shard partials -> log-depth reduction
-                    # (the timeWindowAll gather analog)
-                    partial = (
-                        out if tree
-                        else comm.stacked_reduce(out, p, self.combine)
-                    )
-                return self.combine(summary, partial)
+                return self.combine(summary, partial_fn(src, dst, val, mask))
 
             step_fn = jax.jit(step)
             _step_cache_put(cache_key, step_fn)
@@ -204,8 +228,64 @@ class SummaryAggregation(abc.ABC):
             summary, block.src, block.dst, block.val, block.mask
         )
 
+    def _superbatch_step(
+        self, summary: Any, sblock: StackedEdgeBlock, vcap: int, mesh
+    ) -> tuple:
+        """K window steps as ONE jitted ``lax.scan`` over the stacked
+        axis. Returns ``(carry, ys)``: the carried summary after all K
+        windows, and the stacked per-window summaries ``[K, ...]`` that
+        back the group's lazy emissions. ``transient_state`` resets the
+        carry to a fresh ``initial_state`` INSIDE the scan (the per-yield
+        reset of the per-window path, fused).
+
+        The carried summary is DONATED to the dispatch when the backend
+        supports donation and no mesh is involved: successive superbatches
+        then update HBM state in place instead of allocating a fresh
+        buffer per dispatch. Safe because the group's emissions reference
+        ``ys`` (fresh buffers), never the donated carry, and the engine
+        re-aims ``_summary``/``_sync_ref`` at the new carry immediately.
+        """
+        cache_key = ("superbatch", self.step_cache_key(), vcap,
+                     sblock.capacity, sblock.k, mesh, self._is_tree(),
+                     self.transient_state)
+        step_fn = _STEP_CACHE.get(cache_key)
+        if step_fn is None:
+            partial_fn = self._make_partial_fn(vcap, mesh)
+            transient = self.transient_state
+
+            def superstep(summary, src, dst, val, mask):
+                def body(carry, xs):
+                    s, d, v, m = xs
+                    new = self.combine(carry, partial_fn(s, d, v, m))
+                    nxt = self.initial_state(vcap) if transient else new
+                    return nxt, new
+
+                return lax.scan(body, summary, (src, dst, val, mask))
+
+            donate = (
+                (0,)
+                if mesh is None and jax.default_backend() != "cpu"
+                else ()
+            )
+            step_fn = jax.jit(superstep, donate_argnums=donate)
+            _step_cache_put(cache_key, step_fn)
+        return step_fn(
+            summary, sblock.src, sblock.dst, sblock.val, sblock.mask
+        )
+
     def _is_tree(self) -> bool:
         return False
+
+    def checkpoint_granularity(self) -> int:
+        """Window stride at which the carried summary is observable — 1
+        on the per-window path, ``superbatch`` when :meth:`run` will
+        actually take the fused-group path. Checkpoint drivers
+        (``aggregate/autockpt.py``) align barriers to this so a
+        mid-group snapshot can never pair an end-of-group summary with
+        a mid-group window count; subclasses whose run loop opts out of
+        superbatching under extra conditions override it (the CC mixin
+        does for ``transient_state``)."""
+        return self.superbatch if (self.device and self.superbatch > 1) else 1
 
     def _device_block(self, block: EdgeBlock, mesh) -> None:
         """Grow + fold one block into the carried summary (the device
@@ -222,9 +302,32 @@ class SummaryAggregation(abc.ABC):
 
     def run(self, stream) -> Iterator[Any]:
         """Drive the aggregation over the stream's windows
-        (``SummaryAggregation.run`` / ``SummaryBulkAggregation.java:68-90``)."""
+        (``SummaryAggregation.run`` / ``SummaryBulkAggregation.java:68-90``).
+
+        With ``superbatch=K > 1`` (device aggregations only), K
+        consecutive windows run as one fused ``lax.scan`` dispatch and
+        still yield one record per window with identical values — only
+        the records of a group surface together, after its dispatch.
+        CHECKPOINT GRANULARITY under superbatching: the carried summary
+        is only observable on superbatch boundaries (mid-group states
+        exist solely as stacked emission rows), so checkpoint barriers
+        must land on multiples of K —
+        :class:`~gelly_streaming_tpu.aggregate.autockpt.AutoCheckpoint`
+        aligns its ``every`` to the work's
+        :meth:`checkpoint_granularity` automatically; manual
+        ``snapshot_state()`` calls between a group's yields capture the
+        END-of-group summary, not the mid-group window's. Vertex
+        capacity growth likewise quantizes to group boundaries (see
+        :meth:`_fold_group_states`). Feed the loop
+        with a prefetched stream whose depth covers a full group
+        (:func:`~gelly_streaming_tpu.core.pipeline.superbatch_prefetch_depth`)
+        so the host assembles superbatch N+1 while the device scans N.
+        """
         mesh = self._resolve_mesh(stream) if self.device else None
         vdict = stream.vertex_dict
+        if self.device and self.superbatch > 1:
+            yield from self._run_superbatched(stream, mesh, vdict)
+            return
         for block in stream.blocks():
             if self.device:
                 self._device_block(block, mesh)
@@ -244,6 +347,51 @@ class SummaryAggregation(abc.ABC):
                 self._summary = (
                     self.initial_state(self._vcap) if self.device else self.initial_state(0)
                 )
+
+    def _run_superbatched(self, stream, mesh, vdict) -> Iterator[Any]:
+        """The fused-group drive loop: pack K windows per group, one
+        scan dispatch, unstack K emissions lazily (see :meth:`run`).
+        Groups come from the stream's superbatch packer when it has one
+        (zero per-window device assembly on the windower fast path) and
+        are PREFETCHED one group ahead — the host assembles superbatch
+        N+1 while the device scans N, the group-granular form of the
+        pipeline coupling (:mod:`gelly_streaming_tpu.core.pipeline`)."""
+        from ..core.pipeline import prefetch
+        from ..core.window import iter_superbatches
+
+        for group in prefetch(iter_superbatches(stream, self.superbatch), 2):
+            for state in self._fold_group_states(group, mesh):
+                yield self.transform(state, vdict)
+
+    def _fold_group_states(self, group, mesh) -> Iterator[Any]:
+        """Grow + fold one :class:`SuperbatchGroup` through the fused
+        scan, yielding the K per-window summary states (shared by the
+        engine loop and the CC mixin's dense group path).
+
+        Capacity growth quantizes to GROUP boundaries here: a group
+        whose windows grow the vertex table folds (and emits) every
+        window at the group's FINAL capacity — scatter-style summaries
+        are value-identical on the shared prefix with initial-state
+        tails, but an aggregation whose update/transform depends on the
+        table SIZE itself observes the quantized capacity one group
+        early. Per-window growth semantics need the per-window path."""
+        from ..core.emission import iter_unstacked
+
+        vmax = max(1, group.n_vertices)
+        if self._summary is None:
+            self._vcap = vmax
+            self._summary = self.initial_state(self._vcap)
+        elif vmax > self._vcap:
+            self._summary = self.grow_state(self._summary, self._vcap, vmax)
+            self._vcap = vmax
+        carry, ys = self._superbatch_step(
+            self._summary, group.stacked(), self._vcap, mesh
+        )
+        # the carry IS the post-reset summary under transient_state
+        # (the scan body resets it), so one assignment serves both
+        self._summary = carry
+        self._sync_ref = carry
+        yield from iter_unstacked(ys, len(group))
 
     def sync(self) -> None:
         """Block until the carried summary's device work completes — the
@@ -312,8 +460,10 @@ class SummaryTreeReduce(SummaryAggregation):
     #: degree changes the compiled collective program
     config_fields: tuple = ("degree",)
 
-    def __init__(self, transient_state: bool = False, mesh=None, degree: int = 2):
-        super().__init__(transient_state=transient_state, mesh=mesh)
+    def __init__(self, transient_state: bool = False, mesh=None,
+                 degree: int = 2, superbatch: int = 1):
+        super().__init__(transient_state=transient_state, mesh=mesh,
+                         superbatch=superbatch)
         if degree < 2:
             raise ValueError(f"degree must be >= 2, got {degree}")
         self.degree = degree

@@ -216,6 +216,25 @@ class LazyCountRange:
         return repr(self._materialize())
 
 
+def iter_unstacked(stacked, n: int):
+    """Unstack a superbatch's ``[K, ...]`` per-window outputs into K
+    per-window pytrees.
+
+    Each yielded state is a device SLICE of the stacked buffer — one
+    cheap async slice dispatch per window, never a host round trip — so
+    downstream lazy emission types (:class:`DeviceColumnBatch`,
+    ``Components``, ...) keep their contract: only consumers that
+    actually read a window's records pay its download, and the stacked
+    buffer stays alive exactly as long as some window's emission holds a
+    slice of it. This is the output-side half of the superbatch path
+    (``SummaryAggregation._superbatch_step`` produces the stack).
+    """
+    import jax
+
+    for i in range(n):
+        yield jax.tree.map(lambda y, i=i: y[i], stacked)
+
+
 class EmissionStream:
     """Re-iterable stream of emissions with a per-window batch view."""
 

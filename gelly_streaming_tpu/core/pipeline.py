@@ -28,6 +28,22 @@ T = TypeVar("T")
 _SENTINEL = object()
 
 
+def superbatch_prefetch_depth(superbatch: int, base: int = 2) -> int:
+    """Prefetch depth matched to a superbatch of K windows.
+
+    The engine's superbatch path (``SummaryAggregation._superbatch_step``)
+    consumes K blocks per dispatch, so a depth-2 queue — sized for the
+    per-window cadence — would stall the device scan while the host
+    assembles most of the next group. Covering a full group plus one
+    window (``K + 1``) lets the host windower run a whole superbatch
+    ahead: it assembles group N+1 while the device scans group N, the
+    superbatch analog of the per-window double buffer. Memory cost is
+    the queued blocks themselves (~K x window bytes), which is the same
+    data the stacked block materializes anyway.
+    """
+    return max(int(base), int(superbatch) + 1)
+
+
 def prefetch(iterator: Iterator[T], depth: int = 2) -> Iterator[T]:
     """Iterate ``iterator`` on a background thread, ``depth`` items ahead.
 

@@ -137,6 +137,8 @@ class SimpleEdgeStream(GraphStream):
         _vdict: Optional[VertexDict] = None,
     ):
         self.context = context or StreamContext()
+        self._windower = None  # superbatch ingest fast path (see below)
+        self._edges = None
         if _blocks is not None:
             assert _vdict is not None
             self._vdict = _vdict
@@ -162,6 +164,8 @@ class SimpleEdgeStream(GraphStream):
                 self._block_source = lambda: windower.blocks(edges_it)
             else:
                 self._block_source = lambda: windower.blocks(iter(edges_it))
+            self._windower = windower
+            self._edges = edges_it
 
     # ------------------------------------------------------------------ #
     # Plumbing
@@ -195,6 +199,21 @@ class SimpleEdgeStream(GraphStream):
             _blocks=lambda: prefetch(source(), depth),
             _vdict=self._vdict,
         )
+
+    def superbatches(self, k: int):
+        """Superbatch ingest: K consecutive windows per
+        :class:`~gelly_streaming_tpu.core.window.SuperbatchGroup`.
+
+        Streams built directly from edges route to the Windower's packer
+        (zero per-window device work on the count-window column fast
+        path); derived/prefetched/block-backed streams fall back to
+        packing their block iterator. Single-use like :meth:`blocks`.
+        """
+        from .window import superbatches_from_blocks
+
+        if self._windower is not None and self._edges is not None:
+            return self._windower.superbatches(self._edges, k)
+        return superbatches_from_blocks(self.blocks(), k)
 
     def _derive(self, block_fn: Callable[[Iterator[EdgeBlock]], Iterator[EdgeBlock]]) -> "SimpleEdgeStream":
         parent_source = self._block_source

@@ -32,7 +32,13 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .edgeblock import EdgeBlock, bucket_capacity
+from .edgeblock import (
+    EdgeBlock,
+    StackedEdgeBlock,
+    bucket_capacity,
+    stack_blocks,
+    stack_host_cols,
+)
 from .vertexdict import VertexDict
 
 
@@ -265,6 +271,83 @@ class Windower:
     def _info(self, index: int, time_slot: int) -> "WindowInfo":
         size = self.policy.size
         return WindowInfo(index, time_slot * size, (time_slot + 1) * size)
+
+    # ------------------------------------------------------------------ #
+    # Superbatch packing: K windows -> one ingest group
+    # ------------------------------------------------------------------ #
+    def superbatches(
+        self, edges: Iterable[Tuple], k: int
+    ) -> Iterator["SuperbatchGroup"]:
+        """Pack K consecutive windows into one :class:`SuperbatchGroup`
+        (the final group may be shorter).
+
+        This is the ingest half of the superbatch execution path: the
+        per-window fixed cost below ~64k-edge windows is dominated by
+        assembling one device EdgeBlock PER WINDOW (compact-id encode +
+        padding + several host->device puts each), so the packer's array
+        fast path (count windows over column input) never builds
+        per-window blocks at all — it encodes the whole group once and
+        hands out per-window host column views; the ``[K, cap]`` device
+        stack materializes lazily only for consumers that dispatch on it
+        (``SummaryAggregation._superbatch_step``). Window BOUNDARIES are
+        unchanged — each member window keeps its own WindowInfo and mask
+        row, so emission semantics stay per-window.
+        """
+        if k < 1:
+            raise ValueError(f"superbatch k must be >= 1, got {k}")
+        policy = self.policy
+        is_col_seq = (
+            isinstance(edges, (tuple, list))
+            and len(edges) >= 2
+            and all(isinstance(c, np.ndarray) and c.ndim == 1 for c in edges)
+        )
+        if isinstance(policy, CountWindow) and (
+            isinstance(edges, np.ndarray) or is_col_seq
+        ):
+            yield from self._array_superbatches(edges, k)
+            return
+        yield from superbatches_from_blocks(
+            self.blocks_with_info(edges), k, with_info=True,
+            val_dtype=self.val_dtype,
+        )
+
+    def _array_superbatches(self, edges, k: int) -> Iterator["SuperbatchGroup"]:
+        """Count-window column fast path: slice + one group encode, zero
+        per-window device work."""
+        if isinstance(edges, np.ndarray):
+            if edges.ndim != 2 or not 2 <= edges.shape[1] <= 3:
+                raise ValueError("edge array must be [N, 2] or [N, 3]")
+            cols = [edges[:, i] for i in range(edges.shape[1])]
+        else:
+            cols = [np.asarray(c) for c in edges]
+        src = cols[0].astype(np.int64)
+        dst = cols[1].astype(np.int64)
+        val = cols[2].astype(self.val_dtype) if len(cols) > 2 else None
+        n = src.shape[0]
+        size = self.policy.size
+        index = 0
+        for g0 in range(0, n, size * k):
+            g1 = min(g0 + size * k, n)
+            # paired group encode: same first-seen order as per-window
+            # encodes run back to back (concatenation in window order)
+            s_g, d_g = self.vertex_dict.encode_pair(src[g0:g1], dst[g0:g1])
+            s_g = np.asarray(s_g, np.int32)
+            d_g = np.asarray(d_g, np.int32)
+            nv = self.vertex_dict.capacity
+            win_cols = []
+            infos = []
+            for w0 in range(g0, g1, size):
+                w1 = min(w0 + size, g1)
+                a, b = w0 - g0, w1 - g0
+                win_cols.append((
+                    s_g[a:b], d_g[a:b],
+                    None if val is None else val[w0:w1],
+                ))
+                infos.append(WindowInfo(index, None, None))
+                index += 1
+            yield SuperbatchGroup(
+                infos, win_cols, nv, val_dtype=self.val_dtype
+            )
 
     # ------------------------------------------------------------------ #
     # Vectorized ingest: numpy columns instead of per-record tuples
@@ -507,6 +590,115 @@ def iter_time_slot_runs(chunks, policy: "EventTimeWindow",
     w = flush()
     if w is not None:
         yield w
+
+
+class SuperbatchGroup:
+    """K consecutive windows as ONE ingest unit (the superbatch).
+
+    ``cols`` holds per-window host column triples ``(src, dst, val|None)``
+    of compact int32 ids — the zero-device-work view the windowed CC
+    carries consume; ``None`` when the member windows were
+    device-transformed (no usable host caches). :meth:`stacked`
+    materializes (and caches) the ``[K, cap]``
+    :class:`~gelly_streaming_tpu.core.edgeblock.StackedEdgeBlock` for
+    consumers that dispatch on the device stack — built from ``cols``
+    with ONE host->device transfer per column, or from the member
+    blocks' device arrays as the fallback.
+    """
+
+    __slots__ = ("infos", "cols", "n_vertices", "val_dtype", "_blocks",
+                 "_stacked")
+
+    def __init__(self, infos, cols, n_vertices: int, *,
+                 val_dtype=np.float32, blocks=None):
+        self.infos = infos
+        self.cols = cols
+        self.n_vertices = n_vertices
+        self.val_dtype = val_dtype
+        self._blocks = blocks
+        self._stacked = None
+
+    def __len__(self) -> int:
+        return len(self.infos)
+
+    def stacked(self) -> StackedEdgeBlock:
+        if self._stacked is not None:
+            return self._stacked
+        if self.cols is not None:
+            self._stacked = stack_host_cols(
+                self.cols, self.n_vertices, val_dtype=self.val_dtype
+            )
+        else:
+            self._stacked = stack_blocks(self._blocks)
+        return self._stacked
+
+
+def superbatches_from_blocks(
+    blocks: Iterable, k: int, with_info: bool = False,
+    val_dtype=np.float32,
+) -> Iterator[SuperbatchGroup]:
+    """Pack an EdgeBlock iterator into :class:`SuperbatchGroup`\\ s of K
+    (generic fallback — per-window blocks were already assembled, so
+    this recovers only the dispatch fusion, not the ingest fusion).
+    Host column views come from the blocks' prefix-aligned host caches
+    when every member has one; otherwise ``cols`` is None and consumers
+    use the device stack."""
+
+    def emit(group, infos):
+        cols = None
+        # same honesty guard as stack_blocks: prefix-aligned caches with
+        # plain ndarray vals only — pytree vals (tuple-valued map_edges)
+        # cannot fill a single [K, cap] val plane and take the device
+        # stacking fallback instead
+        if all(
+            getattr(b, "_host_cache", None) is not None
+            and getattr(b, "_host_cache_pos", None) is None
+            and (b._host_cache[2] is None
+                 or isinstance(b._host_cache[2], np.ndarray))
+            for b in group
+        ):
+            cols = [b._host_cache for b in group]
+        return SuperbatchGroup(
+            infos, cols, max(b.n_vertices for b in group),
+            val_dtype=val_dtype, blocks=group,
+        )
+
+    group: list = []
+    infos: list = []
+    for item in blocks:
+        info, block = item if with_info else (None, item)
+        group.append(block)
+        infos.append(info)
+        if len(group) >= k:
+            yield emit(group, infos)
+            group, infos = [], []
+    if group:
+        yield emit(group, infos)
+
+
+def iter_superbatches(stream, k: int) -> Iterator[SuperbatchGroup]:
+    """Superbatch groups for any stream: the stream's own packer when it
+    offers one (``SimpleEdgeStream.superbatches`` routes to the
+    Windower's zero-per-window-device-work fast path), else generic
+    packing of its block iterator. Streams can OPT OUT of the fast path
+    by setting ``superbatches = None`` (``autockpt._SkipStream`` does:
+    its replay-skip applies to ``blocks()``, which the generic packer
+    consumes).
+
+    On the generic path the block iterator is prefetched
+    :func:`~gelly_streaming_tpu.core.pipeline.superbatch_prefetch_depth`
+    windows deep — per-window block assembly still happens on that path
+    (the blocks pre-exist), so a depth sized for the per-window cadence
+    would stall each group behind its own K assemblies."""
+    fast = getattr(stream, "superbatches", None)
+    if callable(fast):
+        yield from fast(k)
+        return
+    from .pipeline import prefetch, superbatch_prefetch_depth
+
+    yield from superbatches_from_blocks(
+        prefetch(stream.blocks(), superbatch_prefetch_depth(k)), k
+    )
 
 
 def blocks_from_edges(

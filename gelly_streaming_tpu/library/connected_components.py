@@ -38,6 +38,19 @@ Emission converts either carry to a
 ``DisjointSet`` stand-in); checkpoints always store canonical flat labels
 + touched, so the two carries share one checkpoint format.
 
+``superbatch=K`` fuses K consecutive windows into one dispatch on every
+carry (the small-window latency-cliff fix, ISSUE 2): the forest carry
+runs a group-local fused fold (one vcap-sized chase/commit per GROUP,
+scan over window-sized label tables), the host carry folds the group in
+ONE native call (``cuf_fold_group``) with one batched mirror commit,
+and dense mode scans the group's stacked block through the generic
+engine. Emission VALUES are per-window identical (equivalence-tested);
+a group's K records surface together after its dispatch, mid-group
+snapshots reconstruct lazily on first read, and checkpoint barriers
+land on group boundaries (see ``aggregate/autockpt.py``).
+``transient_state`` keeps the per-window loop (its carry reset is
+window-granular by definition).
+
 Usage parity with the reference::
 
     for comps in stream.aggregate(ConnectedComponents()):
@@ -53,8 +66,10 @@ import numpy as np
 
 from ..aggregate.summary import SummaryBulkAggregation, SummaryTreeReduce
 from ..summaries.forest import (
+    MirrorReplay,
     TouchLog,
     WindowPrep,
+    forest_superbatch,
     forest_window,
     grow_forest,
     init_forest,
@@ -158,52 +173,175 @@ class _CCMixin:
                 mesh.shape[EDGE_AXIS], eff_degree
             )
         vdict = stream.vertex_dict
+        k = int(getattr(self, "superbatch", 1) or 1)
+        if k > 1 and not self.transient_state:
+            # the superbatched drive loop (fused K-window groups); the
+            # transient_state edge case keeps the per-window loop — its
+            # per-yield carry reset is inherently window-granular here
+            yield from self._run_superbatched_cc(
+                stream, mesh, eff_degree, vdict, k
+            )
+            return
         for block in stream.blocks():
             cache = getattr(block, "_host_cache", None)
-            if (
-                cache is None
-                or self.carry == "dense"
-                or self._cc_mode == "dense"
-            ):
+            yield from self._one_window(block, cache, mesh, eff_degree, vdict)
+
+    def _run_superbatched_cc(self, stream, mesh, eff_degree, vdict, k):
+        """Drive the stream in fused K-window groups: the host/forest
+        carries fold each group as ONE batched device dispatch
+        (``_host_group`` / ``_forest_group``) with mid-group canons
+        reconstructed lazily by the group's emissions; dense mode
+        superbatches through the generic engine scan (``_dense_group``).
+        Groups come from the stream's packer (zero per-window device
+        assembly on the windower fast path) and are PREFETCHED one group
+        ahead — host assembly of group N+1 overlaps the fold of N, the
+        pipeline coupling at group granularity."""
+        from ..core.pipeline import prefetch
+        from ..core.window import iter_superbatches
+
+        for group in prefetch(iter_superbatches(stream, k), 2):
+            windowed = (
+                group.cols is not None
+                and self.carry != "dense"
+                and self._cc_mode != "dense"
+            )
+            if windowed and self._cc_mode is None:
+                self._cc_mode = (
+                    self.carry if self.carry != "auto" else _auto_carry()
+                )
+            if windowed and self._cc_mode in ("forest", "host"):
+                if self._cc_mode == "host":
+                    yield from self._host_group(group, vdict)
+                else:
+                    yield from self._forest_group(
+                        group, mesh, eff_degree, vdict
+                    )
+            else:
                 if self._cc_mode in ("forest", "host"):
                     self._to_dense()
                 self._cc_mode = "dense"
-                self._device_block(block, mesh)
-                self._sync_ref = self._summary
-                yield self.transform(self._summary, vdict)
+                yield from self._dense_group(group, mesh, vdict)
+
+    def _one_window(self, block, cache, mesh, eff_degree, vdict):
+        """The per-window path (every carry; superbatch groups bypass it)."""
+        if (
+            cache is None
+            or self.carry == "dense"
+            or self._cc_mode == "dense"
+        ):
+            if self._cc_mode in ("forest", "host"):
+                self._to_dense()
+            self._cc_mode = "dense"
+            self._device_block(block, mesh)
+            self._sync_ref = self._summary
+            yield self.transform(self._summary, vdict)
+        else:
+            if self._cc_mode is None:
+                self._cc_mode = (
+                    self.carry if self.carry != "auto" else _auto_carry()
+                )
+            self._ensure_windowed(block.n_vertices)
+            src_h, dst_h = cache[0], cache[1]
+            if self._cc_mode == "host":
+                # the host union-find computes the merge exactly; a
+                # mesh adds nothing (the mirror is one scatter)
+                tids, roots, changed, chroots = self._uf.fold(
+                    src_h, dst_h, self._vcap
+                )
+                self._canon = mirror_update(
+                    self._canon,
+                    np.concatenate([tids, changed]),
+                    np.concatenate([roots, chroots]),
+                    self._vcap,
+                )
             else:
-                if self._cc_mode is None:
-                    self._cc_mode = (
-                        self.carry if self.carry != "auto" else _auto_carry()
-                    )
-                self._ensure_windowed(block.n_vertices)
-                src_h, dst_h = cache[0], cache[1]
-                if self._cc_mode == "host":
-                    # the host union-find computes the merge exactly; a
-                    # mesh adds nothing (the mirror is one scatter)
-                    tids, roots, changed, chroots = self._uf.fold(
-                        src_h, dst_h, self._vcap
-                    )
-                    self._canon = mirror_update(
-                        self._canon,
-                        np.concatenate([tids, changed]),
-                        np.concatenate([roots, chroots]),
-                        self._vcap,
-                    )
-                else:
-                    self._canon, tids = forest_window(
-                        self._canon, src_h, dst_h, self._vcap, self._prep,
-                        mesh=mesh, tree=self._is_tree(),
-                        degree=eff_degree,
-                    )
-                self._log.add(tids)
-                # sync()/bench barriers block on _summary; keep it aimed
-                # at the live carry
-                self._summary = {"labels": self._canon}
-                self._sync_ref = self._canon
-                yield Components.from_forest(self._canon, self._log, vdict)
-            if self.transient_state:
-                self._reset_transient()
+                self._canon, tids = forest_window(
+                    self._canon, src_h, dst_h, self._vcap, self._prep,
+                    mesh=mesh, tree=self._is_tree(),
+                    degree=eff_degree,
+                )
+            self._log.add(tids)
+            # sync()/bench barriers block on _summary; keep it aimed
+            # at the live carry
+            self._summary = {"labels": self._canon}
+            self._sync_ref = self._canon
+            yield Components.from_forest(self._canon, self._log, vdict)
+        if self.transient_state:
+            self._reset_transient()
+
+    def _forest_group(self, group, mesh, eff_degree, vdict):
+        """Fold a K-window group as ONE fused group-local dispatch
+        (:func:`~gelly_streaming_tpu.summaries.forest.forest_superbatch`)
+        and yield the K per-window emissions, resolution-identical to K
+        :func:`forest_window` steps. Mid-group canons exist only as the
+        group's delta stack; emissions reconstruct them lazily on first
+        read (``Components.from_forest_replay``), so unread windows cost
+        nothing and the group pays ONE vcap-sized buffer copy where the
+        per-window path paid K."""
+        self._ensure_windowed(group.n_vertices)
+        windows = [(c[0], c[1]) for c in group.cols]
+        self._canon, tids_list, replay = forest_superbatch(
+            self._canon, windows, self._vcap, self._prep,
+            mesh=mesh, tree=self._is_tree(), degree=eff_degree,
+        )
+        # first-seen log advances in window order BEFORE the emissions
+        # surface; each snapshot is a count into the append-only log
+        counts = []
+        for tids in tids_list:
+            self._log.add(tids)
+            counts.append(self._log.count)
+        self._summary = {"labels": self._canon}
+        self._sync_ref = self._canon
+        for i, count in enumerate(counts):
+            yield Components.from_forest_replay(
+                replay, i, self._log, count, vdict
+            )
+
+    def _host_group(self, group, vdict):
+        """Host-carry superbatch: K union-find window folds in ONE
+        native call (``CompactUnionFind.fold_group`` — the per-window
+        python/ctypes fold overhead dominates sub-8k windows), ONE
+        batched device mirror scatter per group from the C-deduped
+        group delta. The per-window deltas the UF computes anyway become
+        the group's lazy replay
+        (:class:`~gelly_streaming_tpu.summaries.forest.MirrorReplay`),
+        so mid-group emissions reconstruct on first read and the group
+        pays one vcap buffer copy where the per-window mirror paid K."""
+        self._ensure_windowed(group.n_vertices)
+        wins, gids, groots, gtcnt = self._uf.fold_group(
+            group.cols, self._vcap
+        )
+        ngt = int(np.sum(gtcnt))
+        counts = self._log.add_grouped(gids[:ngt], gtcnt)
+        # group commit on HOST: the union-find's truth is host-side
+        # anyway, and one numpy fancy-assign (+ two vcap memcpys) beats
+        # the XLA scatter by ~10x on the CPU backend where this carry
+        # runs; the published device canon is a fresh immutable buffer
+        # per group, same contract as mirror_update's functional scatter
+        base = np.asarray(self._canon)  # zero-copy view on CPU
+        new_np = base.copy()
+        new_np[gids] = groots
+        self._canon = jnp.asarray(new_np)
+        replay = MirrorReplay(base, wins)
+        self._summary = {"labels": self._canon}
+        self._sync_ref = self._canon
+        for i, count in enumerate(counts):
+            yield Components.from_forest_replay(
+                replay, i, self._log, count, vdict
+            )
+
+    def _dense_group(self, group, mesh, vdict):
+        """Dense-mode superbatch: the generic engine scan over the
+        group's stacked block (``SummaryAggregation._fold_group_states``),
+        one lazy ``Components`` per stacked summary row."""
+        for state in self._fold_group_states(group, mesh):
+            yield self.transform(state, vdict)
+
+    def checkpoint_granularity(self) -> int:
+        """Superbatching (and thus group-aligned barriers) is skipped
+        under ``transient_state`` — the per-yield carry reset is
+        window-granular, so every window is a valid barrier point."""
+        return 1 if self.transient_state else super().checkpoint_granularity()
 
     def _ensure_windowed(self, vcap: int) -> None:
         if self._canon is None:
@@ -311,7 +449,16 @@ class CCServable:
     window — the live pointer forest for the forest/host carries (each
     window's functional update allocates a fresh buffer, so the
     published one is immutable) or the dense flat table — plus the
-    stream's vertex dict for raw-id resolution."""
+    stream's vertex dict for raw-id resolution.
+
+    SUPERBATCH GRANULARITY: with ``superbatch=K`` the aggregation
+    yields a group's K emissions after its fused fold, so the live
+    carry read here is the END-of-group state for all K publishes (the
+    per-window replay views exist only for emission consumers). That
+    is safe — the CC carry is monotone, so a query sees a FRESHER
+    snapshot, never a wrong one — but snapshots and their seq
+    watermark advance at group granularity: serving deployments that
+    need per-window snapshot pinning should run ``superbatch=1``."""
 
     def __init__(self, agg, vdict=None):
         from ..serving import ComponentSizeQuery, ConnectedQuery

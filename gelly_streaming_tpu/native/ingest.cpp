@@ -1276,6 +1276,80 @@ int64_t cuf_fold_window(void* h, const int32_t* src, const int32_t* dst,
     return nt;
 }
 
+// Fold K windows in ONE call (the superbatch host-carry path): columns
+// are concatenated with offsets[w]..offsets[w+1] delimiting window w
+// (offsets has k+1 entries). Per-window outputs land back to back in
+// the shared buffers with lengths in t_counts/c_counts (same capacity
+// contract as k cuf_fold_window calls: touched/roots 2n total,
+// changed/changed_roots n total, n = offsets[k]). Additionally emits
+// the GROUP-deduped commit delta — the union of every touched or
+// demoted id with its POST-GROUP root — into group_ids/group_roots
+// (capacity 3n; count to *n_group_out): exactly the single masked
+// scatter a device mirror needs per group, deduped here because a
+// python-side unique() measured 26 ms per 64-window group. Ids are
+// validated across the WHOLE group before any union (same no-partial-
+// mutation guarantee as cuf_fold_window, extended to the group).
+int64_t cuf_fold_group(void* h, const int32_t* src, const int32_t* dst,
+                       const int64_t* offsets, int64_t k, int64_t vcap,
+                       int32_t* touched_out, int32_t* roots_out,
+                       int32_t* changed_out, int32_t* changed_roots_out,
+                       int64_t* t_counts, int64_t* c_counts,
+                       int32_t* group_ids, int32_t* group_roots,
+                       int64_t* gt_counts, int64_t* n_group_out) {
+    CompactUF& uf = *(CompactUF*)h;
+    const int64_t n = offsets[k];
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t a = src[i], b = dst[i];
+        if (a < 0 || b < 0 || a >= vcap || b >= vcap) return -1;
+    }
+    int64_t tt = 0, tc = 0;
+    for (int64_t w = 0; w < k; ++w) {
+        const int64_t a = offsets[w];
+        int64_t nc = 0;
+        int64_t nt = cuf_fold_window(
+            h, src + a, dst + a, offsets[w + 1] - a, vcap,
+            touched_out + tt, roots_out + tt,
+            changed_out + tc, changed_roots_out + tc, &nc);
+        if (nt < 0) return -1;  // unreachable: ids validated above
+        t_counts[w] = nt;
+        c_counts[w] = nc;
+        tt += nt;
+        tc += nc;
+    }
+    // group dedup pass: group-unique TOUCHED ids first, in window order
+    // (first-seen) with per-window counts in gt_counts — the caller's
+    // first-seen emission log batches on this — then any demoted roots
+    // not already present complete the commit delta.
+    if (++uf.epoch == 0) {
+        std::fill(uf.stamp.begin(), uf.stamp.end(), 0u);
+        uf.epoch = 1;
+    }
+    int64_t ng = 0, toff = 0;
+    for (int64_t w = 0; w < k; ++w) {
+        const int64_t start = ng;
+        for (int64_t i = toff; i < toff + t_counts[w]; ++i) {
+            int32_t v = touched_out[i];
+            if (uf.stamp[(size_t)v] != uf.epoch) {
+                uf.stamp[(size_t)v] = uf.epoch;
+                group_ids[ng++] = v;
+            }
+        }
+        toff += t_counts[w];
+        gt_counts[w] = ng - start;
+    }
+    for (int64_t i = 0; i < tc; ++i) {
+        int32_t v = changed_out[i];
+        if (uf.stamp[(size_t)v] != uf.epoch) {
+            uf.stamp[(size_t)v] = uf.epoch;
+            group_ids[ng++] = v;
+        }
+    }
+    for (int64_t i = 0; i < ng; ++i)
+        group_roots[i] = uf.find(group_ids[i]);
+    *n_group_out = ng;
+    return tt;
+}
+
 // Canonical flat labels for [0, vcap) (checkpoint sync point).
 void cuf_flatten(void* h, int32_t* out, int64_t vcap) {
     CompactUF& uf = *(CompactUF*)h;

@@ -129,13 +129,23 @@ def commit_roots(canon, local, key_, r, tid, tmask, tcap: int, vcap: int):
     return canon, nr
 
 
-def _forest_step_fn(tcap: int, wcap: int, vcap: int, mesh=None,
-                    tree: bool = False, degree: int = 2):
-    key = (tcap, wcap, vcap, mesh, tree, degree)
-    fn = _FOREST_STEP_CACHE.get(key)
-    if fn is not None:
-        return fn
-
+def _make_local_fixpoint(tcap: int, mesh=None, tree: bool = False,
+                         degree: int = 2):
+    """The T-sized local min-label fixpoint, shared by the per-window
+    step and the superbatch scan body: ``fixpoint(seed, lu, lv,
+    targets)`` folds the window's edge columns PLUS the pointer edges
+    ``(i, targets[i])`` (lu/lv pads are (0,0) self-loops, no mask
+    needed; the pointer edges must ride along as EDGES because
+    ``_propagate`` hooks only edge endpoints — the label_combine
+    correctness argument, labels.py). The per-window step seeds from
+    iota with the same-root group edges as targets; the superbatch scan
+    body seeds from (and targets) the carried group label table. Under
+    a mesh this is the engine's per-shard-fold + cross-shard-combine
+    shape on WINDOW-SIZED tables: each shard folds its slice of the
+    edge columns (the T-sized pointer edges replicate — same
+    constraints everywhere), then the label tables merge through the
+    bulk stack or the ppermute butterfly. The vcap-sized carry never
+    crosses the mesh."""
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
 
@@ -145,38 +155,117 @@ def _forest_step_fn(tcap: int, wcap: int, vcap: int, mesh=None,
         p = mesh.shape[EDGE_AXIS]
         combine = _table_combine(tcap)
 
-    def step(canon, tid, tmask, lu, lv):
-        r, v2, key_, iota = chase_and_group(canon, tid, tmask, tcap, vcap)
-        # local min-label fixpoint on the T-sized table (window edges +
-        # group edges; lu/lv pads are (0,0) self-loops, no mask needed).
-        # Under a mesh this is the engine's per-shard-fold + cross-shard-
-        # combine shape on WINDOW-SIZED tables: each shard folds its
-        # slice of the edge columns (the T-sized group edges replicate —
-        # same constraints everywhere), then the T-sized label tables
-        # merge through the bulk stack or the ppermute butterfly. The
-        # vcap-sized carry never crosses the mesh.
+    iota = jnp.arange(tcap, dtype=jnp.int32)
+
+    def fixpoint(seed, lu, lv, targets):
         if mesh is None:
             u = jnp.concatenate([lu, iota])
-            w = jnp.concatenate([lv, v2])
-            local = _propagate(iota, u, w, jnp.ones(u.shape[0], bool))
-        else:
-            def shard_fn(lu_s, lv_s):
-                u = jnp.concatenate([lu_s, iota])
-                w = jnp.concatenate([lv_s, v2])
-                lab = _propagate(iota, u, w, jnp.ones(u.shape[0], bool))
-                if tree:
-                    return comm.tree_all_reduce(
-                        lab, EDGE_AXIS, combine, p, degree=degree
-                    )
-                return lab[None]
+            w = jnp.concatenate([lv, targets])
+            return _propagate(seed, u, w, jnp.ones(u.shape[0], bool))
 
-            out = comm.shard_map(
-                shard_fn, mesh, (P(EDGE_AXIS), P(EDGE_AXIS)),
-                P() if tree else P(EDGE_AXIS),
-            )(lu, lv)
-            local = out if tree else comm.stacked_reduce(out, p, combine)
+        def shard_fn(lu_s, lv_s):
+            u = jnp.concatenate([lu_s, iota])
+            w = jnp.concatenate([lv_s, targets])
+            lab = _propagate(seed, u, w, jnp.ones(u.shape[0], bool))
+            if tree:
+                return comm.tree_all_reduce(
+                    lab, EDGE_AXIS, combine, p, degree=degree
+                )
+            return lab[None]
+
+        out = comm.shard_map(
+            shard_fn, mesh, (P(EDGE_AXIS), P(EDGE_AXIS)),
+            P() if tree else P(EDGE_AXIS),
+        )(lu, lv)
+        return out if tree else comm.stacked_reduce(out, p, combine)
+
+    return fixpoint
+
+
+def _forest_step_fn(tcap: int, wcap: int, vcap: int, mesh=None,
+                    tree: bool = False, degree: int = 2):
+    key = (tcap, wcap, vcap, mesh, tree, degree)
+    fn = _FOREST_STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    fixpoint = _make_local_fixpoint(tcap, mesh, tree, degree)
+
+    def step(canon, tid, tmask, lu, lv):
+        r, v2, key_, iota = chase_and_group(canon, tid, tmask, tcap, vcap)
+        local = fixpoint(iota, lu, lv, v2)
         canon, _nr = commit_roots(canon, local, key_, r, tid, tmask, tcap, vcap)
         return canon
+
+    fn = jax.jit(step)
+    if len(_FOREST_STEP_CACHE) >= _FOREST_STEP_CACHE_MAX:
+        _FOREST_STEP_CACHE.pop(next(iter(_FOREST_STEP_CACHE)))
+    _FOREST_STEP_CACHE[key] = fn
+    return fn
+
+
+def _forest_superbatch_fn(tcap: int, wcap: int, vcap: int, k: int,
+                          mesh=None, tree: bool = False, degree: int = 2):
+    """K forest window-steps fused into one jitted dispatch, GROUP-LOCAL.
+
+    The naive fusion — scanning the per-window step with the vcap-sized
+    canon as the carry — still pays vcap-sized work per window (XLA
+    materializes carry updates, and the group-rep scratch memset is
+    vcap-wide), which is exactly the cost shape the forest carry exists
+    to avoid. This kernel instead hoists ALL vcap-sized work to the
+    group boundary:
+
+    1. ONE root chase + same-root grouping over the group's union
+       touched set (``chase_and_group`` — one vcap scratch memset per
+       GROUP, not per window);
+    2. a ``lax.scan`` over the K windows whose carry is only the
+       T-sized local label table: window k folds its edge columns into
+       the carried table (seeded ``_propagate``) and emits
+       ``nr_k[lane] = min pre-group root value of lane's merged group``
+       — the per-window new-root assignment, [k, tcap];
+    3. ONE masked scatter pair re-roots the old roots and
+       path-compresses the whole touched set with the final window's
+       assignment.
+
+    Sequential window semantics are preserved by the carried table
+    (window k sees every merge from windows < k); per-window canon
+    snapshots are recovered lazily from ``(r, nr_k)`` by
+    :class:`ForestReplay` — value-identical under resolution to the
+    per-window path's canon (pointer SHAPE may differ: the fused commit
+    path-compresses the group's touched set once at the end, which
+    changes no root assignment).
+
+    The input canon is NOT donated: the pre-group buffer backs the
+    group's lazy emissions — the one vcap-copy per GROUP replaces the
+    per-window path's copy per WINDOW.
+    """
+    key = ("superbatch", tcap, wcap, vcap, k, mesh, tree, degree)
+    fn = _FOREST_STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    fixpoint = _make_local_fixpoint(tcap, mesh, tree, degree)
+
+    def step(canon, tid, tmask, lu, lv):
+        r, v2, key_, iota = chase_and_group(canon, tid, tmask, tcap, vcap)
+        # v2 maps each lane to the MIN lane of its pre-group root group:
+        # a depth-1 min-rooted pointer forest, i.e. already a valid
+        # label table encoding the group constraints — no fixpoint needed
+        lab0 = v2
+
+        def body(lab, xs):
+            lu_k, lv_k = xs
+            lab = fixpoint(lab, lu_k, lv_k, lab)
+            minr = jnp.full(tcap, _I32_MAX, jnp.int32).at[lab].min(key_)
+            return lab, minr[lab]
+
+        lab_end, nr_s = lax.scan(body, lab0, (lu, lv))
+        nr_end = nr_s[-1]
+        sid_r = jnp.where(tmask, r, vcap)
+        canon = canon.at[sid_r].set(nr_end, mode="drop")
+        tid_s = jnp.where(tmask, tid, vcap)
+        canon = canon.at[tid_s].set(nr_end, mode="drop")
+        return canon, r, nr_s
 
     fn = jax.jit(step)
     if len(_FOREST_STEP_CACHE) >= _FOREST_STEP_CACHE_MAX:
@@ -314,6 +403,159 @@ def forest_window(
     return canon, tids
 
 
+class ForestReplay:
+    """Lazy mid-group canon reconstruction for superbatch emissions.
+
+    A superbatch dispatch materializes only the FINAL canon plus the
+    group's per-window new-root assignments (``nr``, device ``[k, tcap]``)
+    over the group-shared touched lanes (host ``tid``/``tmask``, device
+    old roots ``r``). A window-k emission that is actually read rebuilds
+    that window's canon on host: copy the pre-group base and apply
+    window k's assignment to the old roots and the touched set — the
+    same scatter pair the fused commit runs with the last window's
+    assignment, so the reconstruction resolves identically to the
+    per-window path's canon. Unread emissions cost nothing; the delta
+    download happens once per group on first read.
+    """
+
+    __slots__ = ("_base", "_tid", "_tmask", "_r_dev", "_nr_dev",
+                 "_base_np", "_r", "_nr")
+
+    def __init__(self, base_canon, tid: np.ndarray, tmask: np.ndarray,
+                 r_dev, nr_stack):
+        self._base = base_canon  # device buffer, pre-group (not donated)
+        self._tid = tid          # host [tcap]
+        self._tmask = tmask      # host [tcap]
+        self._r_dev = r_dev      # device [tcap]
+        self._nr_dev = nr_stack  # device [k, tcap]
+        self._base_np = None
+        self._r = None
+        self._nr = None
+
+    def canon_np(self, k: int) -> np.ndarray:
+        """Host canon after window ``k`` of the group (a private copy)."""
+        if self._r is None:
+            self._r = np.asarray(self._r_dev)
+            self._nr = np.asarray(self._nr_dev)
+            self._base_np = np.asarray(self._base)
+        canon = self._base_np.copy()
+        m = self._tmask
+        canon[self._r[m]] = self._nr[k][m]
+        canon[self._tid[m]] = self._nr[k][m]
+        return canon
+
+
+def forest_superbatch(
+    canon: jax.Array,
+    windows,
+    vcap: int,
+    prep: WindowPrep,
+    mesh=None,
+    tree: bool = False,
+    degree: int = 2,
+) -> Tuple[jax.Array, list, "ForestReplay"]:
+    """Fold K windows (list of host ``(src_h, dst_h)`` column pairs)
+    into the forest as ONE fused group-local dispatch.
+
+    Host side, two prep passes through the same per-stream
+    :class:`WindowPrep` scratch: (a) one prep per window for the
+    PER-WINDOW touched ids (the first-seen log advances in window
+    order), (b) one prep over the group's concatenated columns for the
+    GROUP touched set and the group-local edge renumbering — the lane
+    space the device scan's carried label table lives in. All K windows
+    pad to the group's bucketed caps, so a stream hits
+    O(log^2 x distinct-k) jit signatures; padding lanes are inert in
+    every kernel (pads chase from 0 and scatter-drop).
+
+    Returns ``(new_canon, [touched_ids per window], replay)`` — the
+    caller feeds ``touched_ids`` to its first-seen log in window order
+    and hands ``replay`` to the group's lazy emissions.
+    """
+    if prep is None:
+        raise ValueError(
+            "forest_superbatch requires a per-stream WindowPrep (see "
+            "forest_window)"
+        )
+    k = len(windows)
+    _e = np.zeros(0, np.int32)
+    # (a) per-window touched ids, in window order, for the TouchLog
+    win_tids = [
+        prep.prep(s, d, vcap)[0] if len(s) else _e for s, d in windows
+    ]
+    # (b) group touched set + group-local renumbering in ONE pass
+    src_g = np.concatenate([s for s, _ in windows]) if k else _e
+    dst_g = np.concatenate([d for _, d in windows]) if k else _e
+    if len(src_g):
+        tids_g, lu_all, lv_all = prep.prep(src_g, dst_g, vcap)
+    else:
+        tids_g, lu_all, lv_all = _e, _e, _e
+    n_max = max((len(s) for s, _ in windows), default=0)
+    wmin = 8
+    if mesh is not None:
+        from ..parallel.mesh import EDGE_AXIS
+
+        wmin = max(wmin, mesh.shape[EDGE_AXIS])
+    tcap = bucket_capacity(len(tids_g), minimum=8)
+    wcap = bucket_capacity(n_max, minimum=wmin)
+    t = len(tids_g)
+    tid = np.zeros(tcap, np.int32)
+    tid[:t] = tids_g
+    tmask = np.zeros(tcap, bool)
+    tmask[:t] = True
+    lu = np.zeros((k, wcap), np.int32)
+    lv = np.zeros((k, wcap), np.int32)
+    off = 0
+    for i, (s, _) in enumerate(windows):
+        n = len(s)
+        lu[i, :n] = lu_all[off:off + n]
+        lv[i, :n] = lv_all[off:off + n]
+        off += n
+    step = _forest_superbatch_fn(tcap, wcap, vcap, k, mesh, tree, degree)
+    new_canon, r_dev, nr_s = step(
+        canon,
+        jnp.asarray(tid),
+        jnp.asarray(tmask),
+        jnp.asarray(lu),
+        jnp.asarray(lv),
+    )
+    replay = ForestReplay(canon, tid, tmask, r_dev, nr_s)
+    return new_canon, win_tids, replay
+
+
+class MirrorReplay:
+    """Lazy mid-group canon reconstruction for HOST-carry superbatches.
+
+    The host union-find computes each window's re-rooting delta
+    ``(idx, val)`` on host anyway; the superbatch path defers the device
+    mirror to ONE batched scatter per group, so mid-group canons exist
+    only as these host deltas. Reconstruction is cumulative (deltas
+    apply in window order); sequential reads advance incrementally, a
+    backward read restarts from the pre-group base. The base device
+    buffer downloads once, lazily.
+    """
+
+    __slots__ = ("_base", "_deltas", "_canon", "_upto")
+
+    def __init__(self, base_canon, deltas):
+        self._base = base_canon  # device buffer, pre-group
+        # [(touched, roots, changed, changed_roots) per window]
+        self._deltas = deltas
+        self._canon = None
+        self._upto = -1
+
+    def canon_np(self, k: int) -> np.ndarray:
+        """Host canon after window ``k`` of the group (a private copy)."""
+        if self._canon is None or k < self._upto:
+            self._canon = np.asarray(self._base).copy()
+            self._upto = -1
+        for j in range(self._upto + 1, k + 1):
+            t, r, c, cr = self._deltas[j]
+            self._canon[t] = r
+            self._canon[c] = cr
+        self._upto = k
+        return self._canon.copy()
+
+
 #: device-mirror scatter for the host carry (jit re-specializes per
 #: (ncap, vcap) shape pair automatically)
 _mirror_jit = jax.jit(lambda c, i, v: c.at[i].set(v, mode="drop"))
@@ -386,6 +628,9 @@ class TouchLog:
         if len(fresh) == 0:
             return
         self.seen[fresh] = True
+        self._append(fresh)
+
+    def _append(self, fresh: np.ndarray) -> None:
         need = self.count + len(fresh)
         if need > len(self.ids):
             cap = len(self.ids)
@@ -396,6 +641,26 @@ class TouchLog:
             self.ids = grown
         self.ids[self.count : need] = fresh
         self.count = need
+
+    def add_grouped(self, ids: np.ndarray, counts: np.ndarray) -> list:
+        """Batch K windows' touched sets in ONE vectorized pass.
+
+        ``ids`` is a GROUP-unique concatenation in window first-seen
+        order with per-window lengths ``counts`` (the shape
+        ``CompactUnionFind.fold_group`` emits); per-window ``add`` calls
+        cost ~0.1 ms each in numpy call overhead, which dominates
+        1k-edge windows. Returns the per-window log counts (the
+        emission snapshots ``add`` would have produced)."""
+        fresh_mask = ~self.seen[ids]
+        fresh = ids[fresh_mask]
+        self.seen[fresh] = True
+        before = self.count
+        self._append(fresh)
+        ends = np.cumsum(np.asarray(counts, np.int64))
+        fresh_cum = np.concatenate(
+            [[0], np.cumsum(fresh_mask.astype(np.int64))]
+        )
+        return (before + fresh_cum[ends]).tolist()
 
     def touched_bool(self, vcap: int) -> np.ndarray:
         out = np.zeros(vcap, bool)

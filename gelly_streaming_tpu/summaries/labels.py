@@ -119,13 +119,17 @@ class Components:
     """
 
     def __init__(self, components: Optional[Dict[int, List[int]]] = None, *,
-                 _lazy=None, _lazy_forest=None):
+                 _lazy=None, _lazy_forest=None, _lazy_replay=None):
         self._components = components
         self._lazy = _lazy  # (labels_dev, touched_dev, n, vdict)
         # (canon_dev, touch_log, count, vdict): forest-carry emission —
         # canon chains resolve on host at materialization; the touched
         # set is the first `count` entries of the append-only host log
         self._lazy_forest = _lazy_forest
+        # (ForestReplay, window_index, touch_log, count, vdict):
+        # superbatch emission — the mid-group canon reconstructs from
+        # the group's delta stack on first read (forest.ForestReplay)
+        self._lazy_replay = _lazy_replay
 
     @property
     def components(self) -> Dict[int, List[int]]:
@@ -133,7 +137,13 @@ class Components:
         grouping happen on first access, so un-inspected per-window
         emissions cost nothing (windows pipeline on device)."""
         if self._components is None:
-            if self._lazy_forest is not None:
+            if self._lazy_replay is not None:
+                from .forest import resolve_flat_host
+
+                replay, win, log, count, vdict = self._lazy_replay
+                labels = resolve_flat_host(replay.canon_np(win))
+                idx = np.sort(log.ids[:count])
+            elif self._lazy_forest is not None:
                 from .forest import resolve_flat_host
 
                 canon_dev, log, count, vdict = self._lazy_forest
@@ -184,6 +194,17 @@ class Components:
         canon snapshot is this window's immutable device buffer; the
         touched set snapshots as a COUNT into the append-only host log."""
         return Components(_lazy_forest=(canon, log, log.count, vdict))
+
+    @staticmethod
+    def from_forest_replay(replay, win: int, log, count: int,
+                           vdict) -> "Components":
+        """Lazy view over window ``win`` of a forest SUPERBATCH
+        (``forest.ForestReplay``): the mid-group canon exists only as
+        the group's delta stack and reconstructs on first read; the
+        touched set snapshots as the caller-recorded per-window COUNT
+        into the append-only host log (the log advances past this
+        window before the group's emissions surface)."""
+        return Components(_lazy_replay=(replay, win, log, count, vdict))
 
     def num_components(self) -> int:
         return len(self.components)

@@ -1,0 +1,88 @@
+"""Stale-fallback provenance: the bench must never replay a retracted,
+partial, or already-stale artifact as the round headline (round-5
+verdict weak #1 — ``BENCH_r05.json`` laundered the measurement-bugged
+round-3 ``BENCH_DETAIL.json`` into a fresh-looking stale value)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _write(tmp_path, name, doc):
+    with open(tmp_path / name, "w") as f:
+        json.dump(doc, f)
+
+
+def _headline(value, **kw):
+    return dict(
+        {"metric": "streaming_cc_e2e_edges_per_sec", "value": value,
+         "unit": "edges/sec", "vs_baseline": 1.0}, **kw
+    )
+
+
+def test_skips_retracted_artifact_note(tmp_path):
+    _write(tmp_path, "BENCH_DETAIL.json", {
+        "headline": _headline(999.0),
+        "artifact_note": "TWO measurement bugs diagnosed in round 4: "
+                         "entries were inflated; 250% MFU is physically "
+                         "impossible",
+    })
+    _write(tmp_path, "BENCH_CPU.json", {"headline": _headline(5.0)})
+    h = bench.stale_headline(["probe down"], root=str(tmp_path))
+    assert h["stale"] is True
+    assert h["stale_source"] == "BENCH_CPU.json"
+    assert h["value"] == 5.0
+
+
+def test_never_reads_driver_roundups(tmp_path):
+    # a BENCH_r*.json is a driver echo of earlier bench output — even a
+    # plausible-looking one is never a fallback source
+    _write(tmp_path, "BENCH_r05.json", {"parsed": _headline(777.0)})
+    h = bench.stale_headline([], root=str(tmp_path))
+    assert h["value"] is None
+    assert h["stale_source"] is None
+
+
+def test_skips_already_stale_and_partial(tmp_path):
+    _write(tmp_path, "BENCH_DETAIL.json",
+           {"headline": _headline(888.0, stale=True)})
+    _write(tmp_path, "BENCH_NORTHSTAR.json",
+           {"headline": _headline(333.0), "partial": True,
+            "incomplete": True})
+    h = bench.stale_headline([], root=str(tmp_path))
+    assert h["value"] is None
+
+
+def test_northstar_synthesizes_headline(tmp_path):
+    # northstar artifacts carry no headline key; a complete honest one
+    # must still qualify (the north-star metric name rides along)
+    _write(tmp_path, "BENCH_NORTHSTAR_CPU.json", {
+        "window_1m": {"eps": 1.0},
+        "window_100m": {"eps": 12584779.0},
+        "vs_baseline_100m": 3.1,
+    })
+    h = bench.stale_headline([], root=str(tmp_path))
+    assert h["metric"] == "northstar_cc_100m_window_edges_per_sec"
+    assert h["value"] == 12584779.0
+    assert h["vs_baseline"] == 3.1
+    assert h["stale_source"] == "BENCH_NORTHSTAR_CPU.json"
+
+
+def test_incomplete_northstar_stays_disqualified(tmp_path):
+    _write(tmp_path, "BENCH_NORTHSTAR_CPU.json", {
+        "window_100m": {"eps": 9.0}, "partial": True, "incomplete": True,
+    })
+    h = bench.stale_headline([], root=str(tmp_path))
+    assert h["value"] is None
+
+
+def test_accepts_honest_detail(tmp_path):
+    _write(tmp_path, "BENCH_DETAIL.json", {"headline": _headline(42.0)})
+    h = bench.stale_headline(["try 0: hung"], root=str(tmp_path))
+    assert h["value"] == 42.0
+    assert h["stale_source"] == "BENCH_DETAIL.json"
+    assert h["stale_reason"] == ["try 0: hung"]

@@ -1,0 +1,374 @@
+"""Superbatch equivalence: the fused K-window dispatch must be
+emission-identical to the per-window path (ISSUE 2 acceptance).
+
+Covers every execution surface the superbatch touches: the three CC
+carries (forest group-local scan, host batched union-find, dense engine
+scan), a NON-idempotent engine aggregation (weighted degrees — catches
+double-fold bugs an idempotent semilattice like CC would absorb),
+transient_state reset parity inside the scan, the sharded-mesh path,
+checkpoint/restore at a mid-superbatch kill, and the ingest packer.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gelly_streaming_tpu.aggregate.summary import SummaryBulkAggregation
+from gelly_streaming_tpu.core.stream import SimpleEdgeStream, StreamContext
+from gelly_streaming_tpu.core.window import (
+    CountWindow,
+    Windower,
+    iter_superbatches,
+)
+from gelly_streaming_tpu.core.pipeline import superbatch_prefetch_depth
+from gelly_streaming_tpu.datasets import IdentityDict
+from gelly_streaming_tpu.library import (
+    ConnectedComponents,
+    ConnectedComponentsTree,
+)
+from gelly_streaming_tpu.parallel import make_mesh
+
+N_VERTS = 160
+WINDOW = 23  # deliberately not a divisor of the edge count
+
+
+def _edges(seed=0, n=700):
+    rng = np.random.default_rng(seed)
+    return [
+        (int(a), int(b), 0.0)
+        for a, b in rng.integers(0, N_VERTS, size=(n, 2))
+    ]
+
+
+def _cc_run(edges, **kw):
+    stream = SimpleEdgeStream(edges, window=CountWindow(WINDOW))
+    agg = ConnectedComponents(**kw)
+    out = [str(c) for c in stream.aggregate(agg)]
+    return out, agg
+
+
+# --------------------------------------------------------------------- #
+# Emission-sequence equivalence, all carries
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("carry", ["forest", "host", "dense"])
+@pytest.mark.parametrize("k", [2, 7, 64])
+def test_cc_emissions_identical(carry, k):
+    edges = _edges(1)
+    base, _ = _cc_run(edges, carry="forest")
+    got, agg = _cc_run(edges, carry=carry, superbatch=k)
+    if carry == "host" and agg._cc_mode != "host":
+        pytest.skip("native toolchain unavailable")
+    assert got == base
+
+
+def test_cc_emissions_out_of_order_reads():
+    """Mid-group canons reconstruct lazily; reads must not depend on
+    consumption order (a consumer may materialize window 5 before 2)."""
+    edges = _edges(2)
+    base, _ = _cc_run(edges, carry="forest")
+    stream = SimpleEdgeStream(edges, window=CountWindow(WINDOW))
+    ems = list(stream.aggregate(ConnectedComponents(carry="forest",
+                                                    superbatch=8)))
+    for i in (5, 2, 7, 0, 6, 2):
+        assert str(ems[i]) == base[i], f"window {i}"
+
+
+@pytest.mark.parametrize("carry", ["forest", "host"])
+def test_cc_checkpoint_state_identical(carry):
+    """snapshot_state after a superbatched run equals the per-window
+    run's (canonical flat labels + touched, the shared format)."""
+    edges = _edges(3)
+    _, ref = _cc_run(edges, carry=carry)
+    _, sup = _cc_run(edges, carry=carry, superbatch=5)
+    if carry == "host" and ref._cc_mode != "host":
+        pytest.skip("native toolchain unavailable")
+    a, b = ref.snapshot_state(), sup.snapshot_state()
+    np.testing.assert_array_equal(np.asarray(a["labels"]),
+                                  np.asarray(b["labels"]))
+    np.testing.assert_array_equal(np.asarray(a["touched"]),
+                                  np.asarray(b["touched"]))
+
+
+# --------------------------------------------------------------------- #
+# Generic engine: non-idempotent summary + transient reset parity
+# --------------------------------------------------------------------- #
+class _WeightedDegrees(SummaryBulkAggregation):
+    """Scatter-add summary: NOT idempotent, so re-folded or dropped
+    windows change the numbers (unlike CC's semilattice)."""
+
+    def initial_state(self, vcap):
+        return jnp.zeros(max(1, vcap), jnp.float32)
+
+    def grow_state(self, state, old, new):
+        return jnp.concatenate([state, jnp.zeros(new - old, jnp.float32)])
+
+    def update(self, state, src, dst, val, mask):
+        w = jnp.where(mask, val + 1.0, 0.0)
+        return state.at[src].add(w).at[dst].add(w)
+
+    def combine(self, a, b):
+        return a + b
+
+    def transform(self, state, vdict):
+        return np.asarray(state)
+
+
+def _wd_run(edges, **kw):
+    stream = SimpleEdgeStream(edges, window=CountWindow(WINDOW),
+                              vertex_dict=IdentityDict(N_VERTS))
+    return [t.copy() for t in _WeightedDegrees(**kw).run(stream)]
+
+
+@pytest.mark.parametrize("transient", [False, True])
+@pytest.mark.parametrize("k", [3, 16])
+def test_engine_superbatch_identical(transient, k):
+    edges = _edges(4)
+    base = _wd_run(edges, transient_state=transient)
+    got = _wd_run(edges, transient_state=transient, superbatch=k)
+    assert len(got) == len(base)
+    for i, (a, b) in enumerate(zip(base, got)):
+        np.testing.assert_allclose(a, b, err_msg=f"window {i}")
+
+
+# --------------------------------------------------------------------- #
+# Sharded-mesh path
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("shards", [2, 4])
+def test_superbatch_mesh_engine(shards):
+    edges = _edges(5, n=384)
+    base = _wd_run(edges)
+    ctx = StreamContext(mesh=make_mesh(shards))
+    stream = SimpleEdgeStream(edges, window=CountWindow(WINDOW),
+                              vertex_dict=IdentityDict(N_VERTS),
+                              context=ctx)
+    got = [t.copy() for t in _WeightedDegrees(superbatch=4).run(stream)]
+    for i, (a, b) in enumerate(zip(base, got)):
+        np.testing.assert_allclose(a, b, err_msg=f"window {i}")
+
+
+@pytest.mark.parametrize("agg_cls", [ConnectedComponents,
+                                     ConnectedComponentsTree])
+def test_superbatch_mesh_forest_cc(agg_cls):
+    edges = _edges(6, n=384)
+    base, _ = _cc_run(edges, carry="forest")
+    ctx = StreamContext(mesh=make_mesh(4))
+    stream = SimpleEdgeStream(edges, window=CountWindow(WINDOW),
+                              context=ctx)
+    got = [
+        str(c) for c in stream.aggregate(
+            agg_cls(carry="forest", superbatch=4)
+        )
+    ]
+    assert got == base
+
+
+# --------------------------------------------------------------------- #
+# Checkpoint: barriers align to superbatch boundaries; a mid-group kill
+# restores and replays to an identical end state
+# --------------------------------------------------------------------- #
+def _ckpt_run(tmp_path, edges, kill_after=None, every=2, k=3):
+    from gelly_streaming_tpu.aggregate.autockpt import AutoCheckpoint
+
+    tmp_path.mkdir(exist_ok=True)
+    ac = AutoCheckpoint(str(tmp_path / "sb.ckpt"), every=every)
+    agg = ConnectedComponents(carry="forest", superbatch=k)
+
+    def make_stream(vdict):
+        return SimpleEdgeStream(edges, window=CountWindow(WINDOW),
+                                vertex_dict=vdict)
+
+    out = []
+    it = ac.run(make_stream, agg)
+    for i, c in enumerate(it):
+        out.append(str(c))
+        if kill_after is not None and i + 1 >= kill_after:
+            it.close()  # the kill: mid-group, between a group's yields
+            break
+    return ac, agg, out
+
+
+def test_mid_superbatch_kill_and_resume(tmp_path):
+    edges = _edges(7)
+    n_windows = (len(edges) + WINDOW - 1) // WINDOW
+    ref_ac, ref_agg, ref_out = _ckpt_run(tmp_path / "ref", edges)
+    assert len(ref_out) == n_windows
+
+    # kill mid-group (7 emissions in, k=3: inside group 3) ...
+    (tmp_path / "kr").mkdir(exist_ok=True)
+    ac, agg, partial = _ckpt_run(tmp_path / "kr", edges, kill_after=7)
+    done = ac.windows_done()
+    assert done > 0, "a barrier must have committed"
+    # barriers only land on superbatch boundaries (every=2 alone would
+    # have put one at 2, 4, 6...; aligned to k=3 they land at 6)
+    assert done % 3 == 0
+
+    # ... and resume in a FRESH aggregation: replay yields exactly the
+    # post-barrier windows, and the end state matches the uninterrupted
+    # run's
+    ac2, agg2, resumed = _ckpt_run(tmp_path / "kr", edges)
+    assert len(resumed) == n_windows - done
+    assert resumed == ref_out[done:]
+    a, b = ref_agg.snapshot_state(), agg2.snapshot_state()
+    np.testing.assert_array_equal(np.asarray(a["labels"]),
+                                  np.asarray(b["labels"]))
+
+
+# --------------------------------------------------------------------- #
+# Ingest packer + plumbing
+# --------------------------------------------------------------------- #
+def test_windower_superbatches_match_blocks():
+    """The packer's column views and stacked block must agree with the
+    per-window block sequence (array fast path)."""
+    rng = np.random.default_rng(8)
+    src = rng.integers(0, N_VERTS, 500).astype(np.int64)
+    dst = rng.integers(0, N_VERTS, 500).astype(np.int64)
+
+    w1 = Windower(CountWindow(37), IdentityDict(N_VERTS))
+    blocks = list(w1.blocks((src, dst)))
+    w2 = Windower(CountWindow(37), IdentityDict(N_VERTS))
+    groups = list(w2.superbatches((src, dst), 4))
+
+    assert sum(len(g) for g in groups) == len(blocks)
+    i = 0
+    for g in groups:
+        sb = g.stacked()
+        assert sb.k == len(g)
+        for j, (s, d, v) in enumerate(g.cols):
+            bs, bd, _bv = blocks[i].to_host()
+            np.testing.assert_array_equal(s, bs)
+            np.testing.assert_array_equal(d, bd)
+            np.testing.assert_array_equal(
+                np.asarray(sb.src[j])[np.asarray(sb.mask[j])], bs
+            )
+            i += 1
+        # window infos number consecutively
+        assert [wi.index for wi in g.infos] == list(range(i - len(g), i))
+
+
+def test_iter_superbatches_generic_fallback():
+    """Streams without a packer (here: a bare object exposing blocks())
+    still group correctly, preserving per-window host caches."""
+
+    class Bare:
+        def __init__(self, blocks):
+            self._b = blocks
+
+        def blocks(self):
+            return iter(self._b)
+
+    w = Windower(CountWindow(11), IdentityDict(N_VERTS))
+    rng = np.random.default_rng(9)
+    src = rng.integers(0, N_VERTS, 100).astype(np.int64)
+    dst = rng.integers(0, N_VERTS, 100).astype(np.int64)
+    blocks = list(w.blocks((src, dst)))
+    groups = list(iter_superbatches(Bare(blocks), 4))
+    assert sum(len(g) for g in groups) == len(blocks)
+    assert groups[0].cols is not None
+
+
+def test_superbatch_prefetch_depth():
+    assert superbatch_prefetch_depth(1) == 2
+    assert superbatch_prefetch_depth(8) == 9
+    assert superbatch_prefetch_depth(4, base=16) == 16
+
+
+def test_checkpoint_granularity():
+    """Barriers align to the EFFECTIVE superbatch stride: 1 wherever the
+    run loop opts out (per-window, transient CC), K where it fuses."""
+    assert ConnectedComponents().checkpoint_granularity() == 1
+    assert ConnectedComponents(superbatch=4).checkpoint_granularity() == 4
+    assert ConnectedComponents(
+        superbatch=4, transient_state=True
+    ).checkpoint_granularity() == 1
+    # the generic engine superbatches transient state inside the scan
+    assert _WeightedDegrees(
+        superbatch=4, transient_state=True
+    ).checkpoint_granularity() == 4
+
+
+def test_native_fold_group_matches_sequential():
+    pytest.importorskip("gelly_streaming_tpu.native")
+    from gelly_streaming_tpu import native
+
+    try:
+        uf_a = native.CompactUnionFind()
+        uf_b = native.CompactUnionFind()
+    except RuntimeError:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(10)
+    vcap = 256
+    cols = [
+        (rng.integers(0, vcap, 40).astype(np.int32),
+         rng.integers(0, vcap, 40).astype(np.int32))
+        for _ in range(5)
+    ]
+    wins, gids, groots, gtcnt = uf_a.fold_group(cols, vcap)
+    seen = {}
+    for (s, d), (t, r, c, cr) in zip(cols, wins):
+        t2, r2, c2, cr2 = uf_b.fold(s, d, vcap)
+        np.testing.assert_array_equal(t, t2)
+        np.testing.assert_array_equal(r, r2)
+        np.testing.assert_array_equal(c, c2)
+        np.testing.assert_array_equal(cr, cr2)
+        for v in t.tolist() + c.tolist():
+            seen[v] = True
+    np.testing.assert_array_equal(uf_a.flatten(vcap), uf_b.flatten(vcap))
+    # the group delta covers exactly the touched/demoted union, with
+    # post-group roots
+    assert sorted(gids.tolist()) == sorted(seen)
+    flat = uf_a.flatten(vcap)
+    np.testing.assert_array_equal(groots, flat[gids])
+    assert int(np.sum(gtcnt)) <= len(gids)
+
+
+def test_superbatch_rejects_bad_k():
+    with pytest.raises(ValueError):
+        ConnectedComponents(superbatch=0)
+
+
+def test_generic_packer_preserves_val_dtype():
+    """Generic packing must take the val dtype from the cached columns —
+    defaulting to float32 would silently cast int-valued streams (the
+    per-window path preserves leaf dtypes via from_arrays_tree)."""
+    from gelly_streaming_tpu.core.edgeblock import from_arrays_tree
+    from gelly_streaming_tpu.core.window import superbatches_from_blocks
+
+    src = np.arange(6, dtype=np.int32)
+    dst = (src + 1) % 7
+    blocks = [
+        from_arrays_tree(src, dst, np.full(6, 7, np.int32), n_vertices=8)
+        for _ in range(3)
+    ]
+    per_window_dtype = np.asarray(blocks[0].val).dtype
+    (g,) = superbatches_from_blocks(blocks, 4)
+    assert g.cols is not None
+    sb = g.stacked()
+    assert np.asarray(sb.val).dtype == per_window_dtype == np.int32
+    np.testing.assert_array_equal(
+        np.asarray(sb.val)[np.asarray(sb.mask)], np.full(18, 7, np.int32)
+    )
+
+
+def test_generic_packer_pytree_vals_fall_back_to_device_stack():
+    """Tuple-valued blocks (the map_edges pytree shape) cannot fill one
+    [K, cap] val plane; the packer must route them through the device
+    stacking fallback instead of crashing on assembly."""
+    from gelly_streaming_tpu.core.edgeblock import from_arrays_tree
+    from gelly_streaming_tpu.core.window import superbatches_from_blocks
+
+    src = np.arange(5, dtype=np.int32)
+    dst = (src + 2) % 6
+    val = (np.ones(5, np.float32), np.full(5, 3.0, np.float32))
+    blocks = [
+        from_arrays_tree(src, dst, val, n_vertices=8) for _ in range(2)
+    ]
+    (g,) = superbatches_from_blocks(blocks, 2)
+    assert g.cols is None  # pytree vals: no host column view
+    sb = g.stacked()
+    assert sb.k == 2
+    leaves = [np.asarray(x) for x in sb.val]
+    assert leaves[0].shape == leaves[1].shape == sb.mask.shape
+    np.testing.assert_array_equal(
+        leaves[1][np.asarray(sb.mask)], np.full(10, 3.0, np.float32)
+    )
