@@ -502,10 +502,18 @@ def run_latency_curve(artifact: str, cpu: bool = False) -> dict:
     bite (window <= 256k), the fused path at :func:`auto_superbatch_k`.
     Each point runs in a fresh subprocess (the in-process degradation
     discipline); the artifact flushes incrementally and is marked
-    ``incomplete`` until every point landed."""
+    ``incomplete`` until every point landed.
+
+    Obs evidence (ISSUE 3 satellite): the sweep DRIVER records one span
+    per point (``bench.latency_point``: window size, variant, K,
+    subprocess rc, measured eps) to an event log keyed next to the
+    artifact. Driver spans time the whole subprocess — point-internal
+    span evidence would need in-process runs, which the degradation
+    discipline forbids — so the log documents the sweep's shape and
+    wall cost, flushed incrementally like the artifact itself."""
     import subprocess
 
-    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu import datasets, obs
 
     path, is_real = _corpus_path()
     bound = _id_bound(path, is_real)
@@ -528,6 +536,15 @@ def run_latency_curve(artifact: str, cpu: bool = False) -> dict:
         "points": {},
         "incomplete": True,
     }
+    obs_path = (
+        artifact[: -len(".json")] if artifact.endswith(".json") else artifact
+    ) + "_OBS.jsonl"
+    doc["obs_log"] = os.path.basename(obs_path)
+    obs_sink = obs.JsonlSink(obs_path)
+    obs_sink.emit({"kind": "meta", "bench": "latency_curve",
+                   "artifact": os.path.basename(artifact)})
+    obs.enable()
+    obs.attach_sink(obs_sink)
     pin = (
         "import jax; jax.config.update('jax_platforms','cpu'); "
         if cpu else ""
@@ -536,52 +553,67 @@ def run_latency_curve(artifact: str, cpu: bool = False) -> dict:
     def flush():
         with open(artifact, "w") as f:
             json.dump(doc, f, indent=2)
+        obs_sink.write()
 
-    flush()
-    failures = 0
-    for wexp in LATENCY_SWEEP_WEXP:
-        window = 1 << wexp
-        if window > corpus_edges:
-            break
-        n_e = min(corpus_edges, max(1 << 22, window))
-        point = {}
-        variants = [("per_window", 1)]
-        k = auto_superbatch_k(window)
-        if k > 1:
-            variants.append(("superbatch", k))
-        for name, kk in variants:
-            log(f"latency-curve: window=2^{wexp} {name} (k={kk})...")
-            try:
-                out = subprocess.run(
-                    [sys.executable, "-c",
-                     f"{pin}import bench, json; "
-                     f"print(json.dumps(bench.bench_latency_window({binp!r}, "
-                     f"{bound}, {window}, n_edges={n_e}, superbatch={kk})))"],
-                    capture_output=True, text=True, timeout=1800,
-                )
-            except subprocess.TimeoutExpired:
-                # one hung point is a per-point failure, not a crashed
-                # sweep: the remaining points still run and the artifact
-                # keeps its incomplete marker + nonzero exit
-                point[name] = None
-                failures += 1
-                log(f"latency-curve: {name} @2^{wexp} hung >1800s")
-                continue
-            if out.returncode == 0:
-                point[name] = _parse_sub(out.stdout)
-            else:
-                point[name] = None
-                failures += 1
-                log(out.stderr[-500:])
-        if point.get("per_window") and point.get("superbatch"):
-            point["superbatch_speedup"] = round(
-                point["superbatch"]["eps"] / point["per_window"]["eps"], 2
-            )
-        doc["points"][str(window)] = point
+    try:
         flush()
-    if not failures:
-        doc.pop("incomplete")
-    flush()
+        failures = 0
+        for wexp in LATENCY_SWEEP_WEXP:
+            window = 1 << wexp
+            if window > corpus_edges:
+                break
+            n_e = min(corpus_edges, max(1 << 22, window))
+            point = {}
+            variants = [("per_window", 1)]
+            k = auto_superbatch_k(window)
+            if k > 1:
+                variants.append(("superbatch", k))
+            for name, kk in variants:
+                log(f"latency-curve: window=2^{wexp} {name} (k={kk})...")
+                with obs.span(
+                    "bench.latency_point",
+                    {"window": window, "variant": name, "k": kk},
+                ) as sp:
+                    try:
+                        out = subprocess.run(
+                            [sys.executable, "-c",
+                             f"{pin}import bench, json; "
+                             "print(json.dumps(bench.bench_latency_window("
+                             f"{binp!r}, {bound}, {window}, n_edges={n_e}, "
+                             f"superbatch={kk})))"],
+                            capture_output=True, text=True, timeout=1800,
+                        )
+                    except subprocess.TimeoutExpired:
+                        # one hung point is a per-point failure, not a
+                        # crashed sweep: the remaining points still run
+                        # and the artifact keeps its incomplete marker +
+                        # nonzero exit
+                        point[name] = None
+                        failures += 1
+                        sp.set(outcome="timeout")
+                        log(f"latency-curve: {name} @2^{wexp} hung >1800s")
+                        continue
+                    if out.returncode == 0:
+                        point[name] = _parse_sub(out.stdout)
+                        sp.set(rc=0, eps=(point[name] or {}).get("eps"))
+                    else:
+                        point[name] = None
+                        failures += 1
+                        sp.set(rc=out.returncode)
+                        log(out.stderr[-500:])
+            if point.get("per_window") and point.get("superbatch"):
+                point["superbatch_speedup"] = round(
+                    point["superbatch"]["eps"] / point["per_window"]["eps"],
+                    2,
+                )
+            doc["points"][str(window)] = point
+            flush()
+        if not failures:
+            doc.pop("incomplete")
+        flush()
+    finally:
+        obs.detach_sink(obs_sink)
+        obs.disable()
     log(f"latency-curve: {json.dumps(doc)}")
     if failures:
         sys.exit(1)
@@ -1142,6 +1174,7 @@ def bench_graphsage(n_vertices: int = 1 << 16, window: int = 1 << 18, feat: int 
 def bench_serving(
     n_vertices: int = 1 << 17, window: int = 1 << 18, n_win: int = 8,
     burst: int = 256, pace_s: float = 0.01,
+    obs_log: str = None,
 ) -> dict:
     """The serving scenario: streaming CC with a StreamServer publishing
     per-window snapshots while a client thread drives batched
@@ -1154,7 +1187,18 @@ def bench_serving(
     acceptance bound is about the read path's cost at a bounded query
     rate, not about an unthrottled closed loop saturating the same
     cores ingest parses on (which on the shared-host CPU backend would
-    measure core contention, not serving overhead)."""
+    measure core contention, not serving overhead).
+
+    ``obs_log`` (ISSUE 3 satellite): path for the obs JSONL event log of
+    the MEDIAN served pass. Every ServingStats mutation is mirrored to a
+    sink during each served pass (the sink rides inside the measured
+    region — it is part of the serving cost being reported), and before
+    the log is written the run REPLAYS it through a fresh registry and
+    asserts the reconstructed ``ServingStats.snapshot()`` equals the
+    live one — the reported p50/p99 ship with a log that proves them.
+    Global span tracing stays OFF here on purpose: enabling it for the
+    served passes but not the plain passes would bias the
+    ingest-overhead comparison this bench exists to make."""
     import threading
 
     from gelly_streaming_tpu.core.stream import SimpleEdgeStream
@@ -1183,12 +1227,17 @@ def bench_serving(
         return {"eps": n_edges / (time.perf_counter() - t0)}
 
     def served_pass():
+        from gelly_streaming_tpu.obs.export import JsonlSink
+
         stream = SimpleEdgeStream(
             (src, dst), window=CountWindow(window),
             vertex_dict=IdentityDict(n_vertices),
         )
         agg = ConnectedComponents()
         server = StreamServer(agg.servable(), stream, max_pending=1 << 15)
+        sink = JsonlSink()
+        if obs_log:
+            server.stats.attach_sink(sink)
         rng = np.random.default_rng(29)
         answered = [0]
         rejected = [0]
@@ -1228,13 +1277,16 @@ def bench_serving(
         agg.sync()
         dt = time.perf_counter() - t0
         ct.join(120)
-        stats = server.stats.snapshot()
+        # snapshot AFTER close: close() may answer straggler queries,
+        # and the replay check below needs snapshot == f(event log)
         server.close()
+        stats = server.stats.snapshot()
         if client_errs:
             raise RuntimeError(
                 f"serving bench client failed after {answered[0]} queries"
             ) from client_errs[0]
         q = stats["queries"].get("ConnectedQuery", {})
+        obs_runs.append((sink.events if obs_log else None, stats))
         return {
             "eps": n_edges / dt,
             "queries_answered": answered[0],
@@ -1250,27 +1302,143 @@ def bench_serving(
     # sides share jit/OS caches in-process, so back-to-back blocks of
     # passes would hand whichever runs second an unearned warm-cache
     # advantage (measured swinging the "overhead" by tens of percent)
+    obs_runs = []
     plain_pass()
     served_pass()
+    obs_runs.clear()  # keep only the steady passes' logs
     plain_runs, served_runs = [], []
     for _ in range(STEADY_REPS):
         plain_runs.append(plain_pass())
         served_runs.append(served_pass())
     plain_runs.sort(key=lambda p: p["eps"])
-    served_runs.sort(key=lambda p: p["eps"])
+    # sort indices, not dicts: the median pass's event log must stay
+    # paired with its stats for the replay check
+    order = sorted(range(STEADY_REPS), key=lambda i: served_runs[i]["eps"])
+    mid = order[STEADY_REPS // 2]
     plain = plain_runs[STEADY_REPS // 2]
-    served = served_runs[STEADY_REPS // 2]
+    served = served_runs[mid]
     overhead = (
         100.0 * (plain["eps"] - served["eps"]) / plain["eps"]
         if plain["eps"] else 0.0
     )
-    return {
+    out = {
         "eps_no_server": round(plain["eps"], 1),
         "eps_serving": round(served["eps"], 1),
         "ingest_overhead_pct": round(overhead, 2),
         "eps_no_server_all": [round(p["eps"], 1) for p in plain_runs],
-        "eps_serving_all": [round(p["eps"], 1) for p in served_runs],
+        "eps_serving_all": [
+            round(served_runs[i]["eps"], 1) for i in order
+        ],
         "serving": served,
+    }
+    if obs_log:
+        from gelly_streaming_tpu.obs.export import write_jsonl
+        from gelly_streaming_tpu.serving.stats import ServingStats
+
+        events, live_snap = obs_runs[mid]
+        replayed = ServingStats.from_events(events).snapshot()
+        if replayed != live_snap:
+            # the log failing to reproduce its own run's stats means the
+            # evidence is broken — fail loudly, never ship the artifact
+            raise RuntimeError(
+                "serving obs event log did not replay to the live "
+                f"stats snapshot:\nlive     {live_snap}\nreplayed "
+                f"{replayed}"
+            )
+        write_jsonl(
+            [{"kind": "meta", "bench": "serving", "pass": "median",
+              "queries_answered": served["queries_answered"]}] + events,
+            obs_log,
+        )
+        out["serving"] = dict(served, stats=live_snap)
+        out["obs"] = {
+            "log": obs_log,
+            "events": len(events),
+            "replay_ok": True,
+        }
+    return out
+
+
+def bench_obs_overhead(
+    n_vertices: int = 1 << 17, window: int = 1 << 20, n_win: int = 4,
+    reps: int = 7,
+) -> dict:
+    """Observability cost on the hot path (ISSUE 3 acceptance): the
+    1M-edge-window streaming-CC identity pipeline with instrumentation
+    OFF vs ON (spans + registry mirroring + a JSONL sink attached — the
+    full enabled configuration, not a cheaper one).
+
+    Measurement: passes interleave with ALTERNATING order per rep (the
+    shared host drifts several percent over a run, so a fixed A-then-B
+    order biases whichever side runs second), and the headline ratio
+    compares BEST passes — best-of-N approximates the unhindered
+    runtime of each mode, which is the right estimator when the noise
+    (scheduler preemption, frequency drift) is strictly additive. All
+    passes are recorded so the artifact shows the spread. The
+    acceptance bound is enabled < 2% overhead; disabled is the measured
+    baseline itself (the off-path guard is one flag check per
+    instrumentation site)."""
+    from gelly_streaming_tpu import obs
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    n_edges = window * n_win
+    src, dst = make_stream(n_vertices, n_edges, seed=31)
+
+    def one_pass():
+        stream = SimpleEdgeStream(
+            (src, dst), window=CountWindow(window),
+            vertex_dict=IdentityDict(n_vertices),
+        )
+        agg = ConnectedComponents()
+        t0 = time.perf_counter()
+        for _ in stream.aggregate(agg):
+            pass
+        agg.sync()
+        return n_edges / (time.perf_counter() - t0)
+
+    events = [0]
+
+    def enabled_pass():
+        obs.enable()
+        sink = obs.JsonlSink()
+        obs.attach_sink(sink)
+        try:
+            eps = one_pass()
+        finally:
+            obs.detach_sink(sink)
+            obs.disable()
+        events[0] = max(events[0], len(sink))
+        return eps
+
+    one_pass()
+    enabled_pass()
+    dis, en = [], []
+    for i in range(reps):
+        if i % 2 == 0:
+            dis.append(one_pass())
+            en.append(enabled_pass())
+        else:
+            en.append(enabled_pass())
+            dis.append(one_pass())
+    dis.sort()
+    en.sort()
+    d, e = dis[-1], en[-1]  # best pass per mode (see docstring)
+    return {
+        "eps_disabled": round(d, 1),
+        "eps_enabled": round(e, 1),
+        "overhead_pct": round(100.0 * (d - e) / d, 3) if d else 0.0,
+        "overhead_pct_median": round(
+            100.0 * (dis[reps // 2] - en[reps // 2]) / dis[reps // 2], 3
+        ) if dis[reps // 2] else 0.0,
+        "events_per_run": events[0],
+        "eps_disabled_all": [round(x, 1) for x in dis],
+        "eps_enabled_all": [round(x, 1) for x in en],
+        "model": "streaming-CC identity path, 1M-edge windows; enabled "
+                 "= spans + registry mirroring + JSONL sink attached; "
+                 "headline = best-of-reps per mode, alternating order",
     }
 
 
@@ -1587,13 +1755,30 @@ def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
                 binp, lambda: datasets.IdentityDict(bound), n_edges, window=w
             )
 
+    from gelly_streaming_tpu import obs
+
+    obs_path = (
+        artifact[: -len(".json")] if artifact.endswith(".json") else artifact
+    ) + "_OBS.jsonl"
     doc = {
         "note": note or "default backend",
         "corpus": path,
         "n_edges": n_edges,
         "baseline_compiled_binary": base,
         "flink_proxy": flink,
+        "obs_log": os.path.basename(obs_path),
     }
+    # obs evidence rides the measurement (ISSUE 3 satellite): the e2e
+    # phases run in-process, so the log holds the REAL pipeline spans
+    # (window.pack, engine.dispatch, prefetch coupling) behind each
+    # committed eps. Enabled instrumentation is part of the measured
+    # path — bounded < 2% by the overhead guard (tests/test_obs.py,
+    # BENCH_DETAIL obs_overhead) — and the log says so.
+    obs_sink = obs.JsonlSink(obs_path)
+    obs_sink.emit({"kind": "meta", "bench": "northstar",
+                   "artifact": os.path.basename(artifact)})
+    obs.enable()
+    obs.attach_sink(obs_sink)
 
     def _flush():
         # partial artifact after every expensive phase: a runner timeout
@@ -1603,65 +1788,79 @@ def run_northstar(artifact: str = "BENCH_NORTHSTAR.json",
         # finished measurement (round-5 verdict weak #3)
         with open(artifact, "w") as f:
             json.dump(dict(doc, partial=True, incomplete=True), f, indent=2)
+        obs_sink.write()
 
-    log(f"northstar: {n_edges} edges; 1M-edge windows...")
-    e2e = run_e2e(WINDOW)
-    assert e2e["components"] == base["components"], (
-        e2e["components"], base["components"]
-    )
-    doc["window_1m"] = e2e
-    doc["vs_baseline"] = round(e2e["eps"] / base["eps"], 2)
-    doc["vs_flink"] = round(e2e["eps"] / flink["eps"], 2)
-    _flush()
-    if device_encode:
-        # the identity-mapping variant keeps compact columns host-visible,
-        # which unlocks the window-local carries (forest/host) — at
-        # scale 23 a 1M-edge window touches ~1.7M of 8M vertices, exactly
-        # the T << V regime the forest carry exists for. Recorded
-        # alongside the device-encode number so the artifact shows both
-        # ingest contracts.
-        log("northstar: 1M-edge windows, identity mapping (windowed carry)...")
-        e2e_ident = bench_cc_e2e(
-            binp, lambda: datasets.IdentityDict(bound), n_edges,
-            window=WINDOW,
+    try:
+        log(f"northstar: {n_edges} edges; 1M-edge windows...")
+        with obs.span("bench.northstar_phase", {"phase": "window_1m"}):
+            e2e = run_e2e(WINDOW)
+        assert e2e["components"] == base["components"], (
+            e2e["components"], base["components"]
         )
-        assert e2e_ident["components"] == base["components"], (
-            e2e_ident["components"], base["components"]
-        )
-        doc["window_1m_identity"] = e2e_ident
+        doc["window_1m"] = e2e
+        doc["vs_baseline"] = round(e2e["eps"] / base["eps"], 2)
+        doc["vs_flink"] = round(e2e["eps"] / flink["eps"], 2)
         _flush()
-    else:
-        # the CPU path already runs the identity mapping as ITS e2e
-        # pipeline (the device-dict probe kernel is TPU-oriented), so
-        # window_1m IS the identity configuration; recording it under
-        # both keys keeps the schema hole-free (the committed round-5
-        # artifact shipped `"window_1m_identity": null` because this
-        # assignment was missing — round-5 verdict weak #3)
-        doc["window_1m_identity"] = e2e
-    log("northstar: one 100M-edge window...")
-    mega = run_e2e(max(n_edges, 100_000_000))
-    assert mega["components"] == base["components"], (
-        mega["components"], base["components"]
-    )
-    doc["window_100m"] = mega
-    # BASELINE.md's north-star config IS the 100M-edge window; the
-    # 1M-window series is the latency-oriented configuration
-    doc["vs_baseline_100m"] = round(mega["eps"] / base["eps"], 2)
-    doc["vs_flink_100m"] = round(mega["eps"] / flink["eps"], 2)
-    holes = [
-        key for key in ("window_1m", "window_1m_identity", "window_100m")
-        if doc.get(key) is None
-    ]
-    if holes:
-        # a hole can never be silently committed as a finished artifact
-        # again: mark it and FAIL the run so the driver sees it
-        doc["incomplete"] = True
+        if device_encode:
+            # the identity-mapping variant keeps compact columns
+            # host-visible, which unlocks the window-local carries
+            # (forest/host) — at scale 23 a 1M-edge window touches ~1.7M
+            # of 8M vertices, exactly the T << V regime the forest carry
+            # exists for. Recorded alongside the device-encode number so
+            # the artifact shows both ingest contracts.
+            log("northstar: 1M-edge windows, identity mapping "
+                "(windowed carry)...")
+            with obs.span(
+                "bench.northstar_phase", {"phase": "window_1m_identity"}
+            ):
+                e2e_ident = bench_cc_e2e(
+                    binp, lambda: datasets.IdentityDict(bound), n_edges,
+                    window=WINDOW,
+                )
+            assert e2e_ident["components"] == base["components"], (
+                e2e_ident["components"], base["components"]
+            )
+            doc["window_1m_identity"] = e2e_ident
+            _flush()
+        else:
+            # the CPU path already runs the identity mapping as ITS e2e
+            # pipeline (the device-dict probe kernel is TPU-oriented), so
+            # window_1m IS the identity configuration; recording it under
+            # both keys keeps the schema hole-free (the committed round-5
+            # artifact shipped `"window_1m_identity": null` because this
+            # assignment was missing — round-5 verdict weak #3)
+            doc["window_1m_identity"] = e2e
+        log("northstar: one 100M-edge window...")
+        with obs.span("bench.northstar_phase", {"phase": "window_100m"}):
+            mega = run_e2e(max(n_edges, 100_000_000))
+        assert mega["components"] == base["components"], (
+            mega["components"], base["components"]
+        )
+        doc["window_100m"] = mega
+        # BASELINE.md's north-star config IS the 100M-edge window; the
+        # 1M-window series is the latency-oriented configuration
+        doc["vs_baseline_100m"] = round(mega["eps"] / base["eps"], 2)
+        doc["vs_flink_100m"] = round(mega["eps"] / flink["eps"], 2)
+        holes = [
+            key for key in ("window_1m", "window_1m_identity", "window_100m")
+            if doc.get(key) is None
+        ]
+        if holes:
+            # a hole can never be silently committed as a finished
+            # artifact again: mark it and FAIL the run so the driver
+            # sees it
+            doc["incomplete"] = True
+            with open(artifact, "w") as f:
+                json.dump(doc, f, indent=2)
+            obs_sink.write()
+            log(f"northstar: INCOMPLETE (holes: {holes}) — failing the run")
+            sys.exit(1)
         with open(artifact, "w") as f:
             json.dump(doc, f, indent=2)
-        log(f"northstar: INCOMPLETE (holes: {holes}) — failing the run")
-        sys.exit(1)
-    with open(artifact, "w") as f:
-        json.dump(doc, f, indent=2)
+        obs_sink.write()
+    finally:
+        obs.detach_sink(obs_sink)
+        obs.disable()
     log(f"northstar: {json.dumps(doc)}")
     return doc
 
@@ -1751,12 +1950,22 @@ def main():
 
     if "--serving" in sys.argv:
         # query serving under concurrent ingest (ISSUE 1): p50/p99 query
-        # latency + staleness + ingest overhead vs the no-server path
-        if "--cpu" in sys.argv:
+        # latency + staleness + ingest overhead vs the no-server path.
+        # Writes a keyed JSON artifact with the obs JSONL event log next
+        # to it; the log provably replays to the reported stats snapshot
+        # (ISSUE 3 — bench_serving raises on replay mismatch, so a
+        # committed artifact ALWAYS matches its log).
+        cpu = "--cpu" in sys.argv
+        if cpu:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        out = bench_serving()
+        artifact = "BENCH_SERVING_CPU.json" if cpu else "BENCH_SERVING.json"
+        obs_log = artifact[: -len(".json")] + "_OBS.jsonl"
+        out = bench_serving(obs_log=obs_log)
+        out["platform"] = "cpu-xla" if cpu else "default"
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=2)
         log(f"serving: {json.dumps(out)}")
         print(json.dumps(out))
         return
@@ -1848,6 +2057,10 @@ def main():
             ("e2e_carry_dense",
              f"bench.bench_cc_e2e({binp!r}, "
              f"lambda: datasets.IdentityDict({bound}), {n_edges}, carry='dense')"),
+            # the ISSUE 3 acceptance bound lives on THIS backend: obs
+            # instrumentation enabled vs disabled on the 1M-edge-window
+            # CPU identity path
+            ("obs_overhead", "bench.bench_obs_overhead()"),
         ]:
             log(f"cpu run: {key}...")
             code = (
@@ -2018,6 +2231,12 @@ def main():
              "import bench, json; print(json.dumps(bench.bench_window_triangles_e2e()))"),
             ("serving_e2e",
              "import bench, json; print(json.dumps(bench.bench_serving()))"),
+            # ISSUE 3 acceptance: enabled instrumentation < 2% on the
+            # 1M-edge-window identity path, disabled ~0 — measured here
+            # so the claim lives in a committed artifact
+            ("obs_overhead",
+             "import bench, json; "
+             "print(json.dumps(bench.bench_obs_overhead()))"),
             ("pagerank_eps",
              "import bench, json; print(json.dumps(bench.bench_pagerank()))"),
             ("graphsage_eps",

@@ -52,6 +52,8 @@ from typing import Any, Callable, Iterator, Optional
 
 import numpy as np
 
+from ..obs import trace as _trace
+
 
 class _SkipStream:
     """View of a stream whose first ``skip`` windows are consumed (for
@@ -144,26 +146,40 @@ class AutoCheckpoint:
 
     # ------------------------------------------------------------------ #
     def _snapshot(self, work, vdict, windows_done: int) -> None:
-        if hasattr(work, "state_dict"):
-            kind, state = "workload", work.state_dict()
-        else:
-            import jax
+        with _trace.span(
+            "checkpoint.barrier",
+            {"windows_done": windows_done} if _trace.on() else None,
+        ) as sp:
+            # barrier_wait: capturing the state blocks on the carried
+            # summary's in-flight device work (np.asarray is the sync) —
+            # the piece of barrier cost that scales with dispatch depth,
+            # kept separate from host serialize time below
+            with _trace.span("checkpoint.barrier_wait"):
+                if hasattr(work, "state_dict"):
+                    kind, state = "workload", work.state_dict()
+                else:
+                    import jax
 
-            kind = "aggregation"
-            state = {
-                "summary": jax.tree.map(np.asarray, work.snapshot_state()),
-                "vcap": work._vcap,
+                    kind = "aggregation"
+                    state = {
+                        "summary": jax.tree.map(
+                            np.asarray, work.snapshot_state()
+                        ),
+                        "vcap": work._vcap,
+                    }
+            if sp.recording:
+                sp.set(kind=kind)
+            payload = {
+                "windows_done": windows_done,
+                "kind": kind,
+                "state": state,
+                "vdict": self._vdict_payload(vdict),
             }
-        payload = {
-            "windows_done": windows_done,
-            "kind": kind,
-            "state": state,
-            "vdict": self._vdict_payload(vdict),
-        }
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(payload, f)
-        os.replace(tmp, self.path)  # atomic barrier commit
+            with _trace.span("checkpoint.serialize"):
+                tmp = self.path + ".tmp"
+                with open(tmp, "wb") as f:
+                    pickle.dump(payload, f)
+                os.replace(tmp, self.path)  # atomic barrier commit
         # invalidate, do NOT cache: payload["state"] aliases LIVE workload
         # arrays (e.g. the degree shadow mutated by later windows); only
         # the pickled file is a true point-in-time snapshot

@@ -23,6 +23,7 @@ import jax
 import numpy as np
 
 from ..core.vertexdict import VertexDict
+from ..obs import trace as _trace
 
 
 def _keypaths(tree: Any) -> list:
@@ -38,11 +39,21 @@ def _keypaths(tree: Any) -> list:
 def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
     """Write a pytree of arrays to ``path.npz`` + ``path.json``."""
     leaves, treedef = jax.tree.flatten(tree)
-    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
-    np.savez(path + ".npz", **arrays)
-    with open(path + ".json", "w") as f:
-        json.dump({"treedef": str(treedef), "keypaths": _keypaths(tree),
-                   "n_leaves": len(leaves), "meta": meta or {}}, f)
+    # barrier_wait: np.asarray blocks on any in-flight device work that
+    # produces these leaves — the snapshot's implicit device barrier
+    with _trace.span(
+        "checkpoint.barrier_wait",
+        {"leaves": len(leaves)} if _trace.on() else None,
+    ):
+        arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    with _trace.span(
+        "checkpoint.serialize",
+        {"leaves": len(leaves)} if _trace.on() else None,
+    ):
+        np.savez(path + ".npz", **arrays)
+        with open(path + ".json", "w") as f:
+            json.dump({"treedef": str(treedef), "keypaths": _keypaths(tree),
+                       "n_leaves": len(leaves), "meta": meta or {}}, f)
 
 
 def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:

@@ -43,6 +43,8 @@ import numpy as np
 from jax import lax
 
 from ..core.edgeblock import EdgeBlock, StackedEdgeBlock
+from ..obs import trace as _trace
+from ..obs.registry import get_registry
 from ..parallel import comm
 from ..parallel.mesh import EDGE_AXIS
 from jax.sharding import PartitionSpec as P
@@ -117,6 +119,12 @@ class SummaryAggregation(abc.ABC):
         self._summary = None
         self._vcap = 0
         self._sync_ref = None  # last dispatched window state (sync target)
+        #: whether the last superbatch dispatch DONATED the carried
+        #: summary (in-place HBM update). Consumers that publish live
+        #: carry buffers (``CCServable._payload``) read this to know
+        #: they must copy — a published alias would be invalidated by
+        #: the next group's dispatch.
+        self._donated_carry = False
 
     def step_cache_key(self):
         """Hashable identity of the compiled window step (see class doc)."""
@@ -224,9 +232,17 @@ class SummaryAggregation(abc.ABC):
 
             step_fn = jax.jit(step)
             _step_cache_put(cache_key, step_fn)
-        return step_fn(
-            summary, block.src, block.dst, block.val, block.mask
-        )
+        # span measures DISPATCH (enqueue) time, not device compute —
+        # the async-dispatch contract sync() documents; compile time
+        # shows up as a fat first span, which is itself worth seeing
+        with _trace.span(
+            "engine.dispatch",
+            {"vcap": vcap, "edges_capacity": int(block.capacity)}
+            if _trace.on() else None,
+        ):
+            return step_fn(
+                summary, block.src, block.dst, block.val, block.mask
+            )
 
     def _superbatch_step(
         self, summary: Any, sblock: StackedEdgeBlock, vcap: int, mesh
@@ -245,6 +261,10 @@ class SummaryAggregation(abc.ABC):
         ``ys`` (fresh buffers), never the donated carry, and the engine
         re-aims ``_summary``/``_sync_ref`` at the new carry immediately.
         """
+        # ONE donation decision feeds the compiled donate_argnums, the
+        # instance flag consumers read (see __init__), and the obs
+        # evidence — computed once so they can never disagree
+        donated = mesh is None and jax.default_backend() != "cpu"
         cache_key = ("superbatch", self.step_cache_key(), vcap,
                      sblock.capacity, sblock.k, mesh, self._is_tree(),
                      self.transient_state)
@@ -262,16 +282,25 @@ class SummaryAggregation(abc.ABC):
 
                 return lax.scan(body, summary, (src, dst, val, mask))
 
-            donate = (
-                (0,)
-                if mesh is None and jax.default_backend() != "cpu"
-                else ()
+            step_fn = jax.jit(
+                superstep, donate_argnums=(0,) if donated else ()
             )
-            step_fn = jax.jit(superstep, donate_argnums=donate)
             _step_cache_put(cache_key, step_fn)
-        return step_fn(
-            summary, sblock.src, sblock.dst, sblock.val, sblock.mask
-        )
+        self._donated_carry = donated
+        if _trace.on():
+            if donated:
+                get_registry().counter("engine.donated_dispatches").inc()
+            sp = _trace.span(
+                "engine.superbatch_dispatch",
+                {"k": int(sblock.k), "capacity": int(sblock.capacity),
+                 "vcap": vcap, "donated": donated},
+            )
+        else:
+            sp = _trace.NOOP_SPAN
+        with sp:
+            return step_fn(
+                summary, sblock.src, sblock.dst, sblock.val, sblock.mask
+            )
 
     def _is_tree(self) -> bool:
         return False

@@ -21,7 +21,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterator, TypeVar
+
+from ..obs import trace as _trace
+from ..obs.registry import get_registry
 
 T = TypeVar("T")
 
@@ -44,23 +48,56 @@ def superbatch_prefetch_depth(superbatch: int, base: int = 2) -> int:
     return max(int(base), int(superbatch) + 1)
 
 
-def prefetch(iterator: Iterator[T], depth: int = 2) -> Iterator[T]:
+def prefetch(iterator: Iterator[T], depth: int = 2,
+             name: str = "pipeline") -> Iterator[T]:
     """Iterate ``iterator`` on a background thread, ``depth`` items ahead.
 
     If the consumer abandons the generator early (break / exception /
     garbage collection), the producer thread notices via a stop flag and
     exits instead of blocking forever on the bounded queue; the source
     iterator is closed so file handles are released.
+
+    With observability on (``obs.enable()``), the coupling itself is
+    measured into the global registry — the signals the ROADMAP auto-K
+    follow-on tunes against:
+
+    - ``<name>.queue_depth`` gauge: items ready at each consumer pull;
+    - ``<name>.producer_blocked_s`` counter: host time blocked on a FULL
+      queue (the device/consumer is the bottleneck — host idle);
+    - ``<name>.consumer_idle_s`` counter: consumer time blocked on an
+      EMPTY queue (the host/producer is the bottleneck — device idle).
+
+    Disabled, none of the extra clock reads happen (checked once per
+    item against the trace flag).
     """
     q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, depth))
     error: list = []
     stop = threading.Event()
+    # instruments resolve lazily on first enabled item so a prefetch
+    # started before obs.enable() still reports
+    inst: list = [None]
+
+    def _instruments():
+        if inst[0] is None:
+            reg = get_registry()
+            inst[0] = (
+                reg.gauge(name + ".queue_depth"),
+                reg.counter(name + ".producer_blocked_s"),
+                reg.counter(name + ".consumer_idle_s"),
+            )
+        return inst[0]
 
     def _put(item) -> bool:
         """Bounded put that gives up once the consumer is gone."""
+        obs = _trace.on()
+        t0 = time.perf_counter() if obs else 0.0
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                if obs:
+                    dt = time.perf_counter() - t0
+                    if dt > 1e-4:  # count real blocking, not put cost
+                        _instruments()[1].inc(dt)
                 return True
             except queue.Full:
                 continue
@@ -87,7 +124,16 @@ def prefetch(iterator: Iterator[T], depth: int = 2) -> Iterator[T]:
     t.start()
     try:
         while True:
-            item = q.get()
+            if _trace.on():
+                depth_g, _pw, cw = _instruments()
+                depth_g.set(q.qsize())
+                t0 = time.perf_counter()
+                item = q.get()
+                dt = time.perf_counter() - t0
+                if dt > 1e-4:  # real starvation, not get cost
+                    cw.inc(dt)
+            else:
+                item = q.get()
             if item is _SENTINEL:
                 if error:
                     raise error[0]

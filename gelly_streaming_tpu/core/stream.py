@@ -37,7 +37,13 @@ import numpy as np
 from .edgeblock import EdgeBlock, concat_blocks
 from .types import Edge, EdgeDirection, Vertex
 from .vertexdict import VertexDict
-from .window import CountWindow, EventTimeWindow, WindowPolicy, Windower
+from .window import (
+    CountWindow,
+    EventTimeWindow,
+    WindowPolicy,
+    Windower,
+    is_column_input,
+)
 
 
 class StreamContext:
@@ -150,14 +156,7 @@ class SimpleEdgeStream(GraphStream):
             windower = Windower(policy, vertex_dict)
             self._vdict = windower.vertex_dict
             edges_it = edges
-            is_cols = isinstance(edges, np.ndarray) or (
-                isinstance(edges, (tuple, list))
-                and len(edges) >= 2
-                and all(
-                    isinstance(c, np.ndarray) and c.ndim == 1 for c in edges
-                )
-            )
-            if is_cols:
+            if is_column_input(edges):
                 # numpy fast path: hand the columns straight to the
                 # Windower (iter() would hide them behind a generic
                 # iterator and fall back to per-record parsing)

@@ -32,6 +32,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import trace as _trace
 from .edgeblock import (
     EdgeBlock,
     StackedEdgeBlock,
@@ -40,6 +41,24 @@ from .edgeblock import (
     stack_host_cols,
 )
 from .vertexdict import VertexDict
+
+
+def is_column_input(edges) -> bool:
+    """True when ``edges`` is vectorized column input: an ``[N, k]``
+    ndarray or a ``(src, dst[, val][, ts])`` tuple/list of 1-D arrays.
+
+    THE shared fast-path predicate — the windower's array windows, the
+    superbatch packer, and ``SimpleEdgeStream``'s ingest dispatch must
+    always agree on which inputs take the array route (the per-window /
+    superbatch emission-equivalence contract depends on it), so the
+    rule lives in exactly one place."""
+    if isinstance(edges, np.ndarray):
+        return True
+    return (
+        isinstance(edges, (tuple, list))
+        and len(edges) >= 2
+        and all(isinstance(c, np.ndarray) and c.ndim == 1 for c in edges)
+    )
 
 
 @dataclasses.dataclass
@@ -147,20 +166,30 @@ class Windower:
         self, raw_src: np.ndarray, raw_dst: np.ndarray, val: Optional[np.ndarray]
     ) -> EdgeBlock:
         n = raw_src.shape[0]
-        # Paired encode keeps first-seen order by edge arrival (src before
-        # dst per edge), matching the reference's per-record processing.
-        src, dst = self.vertex_dict.encode_pair(raw_src, raw_dst)
-        cap = self.capacity if self.capacity is not None else bucket_capacity(n)
-        block = EdgeBlock.from_arrays(
-            src, dst, val, n_vertices=self.vertex_dict.capacity, capacity=cap,
-            val_dtype=self.val_dtype,
-        )
-        host_val = (
-            np.zeros(n, dtype=self.val_dtype)
-            if val is None
-            else np.asarray(val, self.val_dtype)
-        )
-        return block.with_host_cache(src, dst, host_val)
+        # the span covers the whole host pack: encode + pad + device put
+        # (the per-window fixed cost the superbatch path amortizes)
+        with _trace.span(
+            "window.pack",
+            {"edges": int(n)} if _trace.on() else None,
+        ):
+            # Paired encode keeps first-seen order by edge arrival (src
+            # before dst per edge), matching the reference's per-record
+            # processing.
+            src, dst = self.vertex_dict.encode_pair(raw_src, raw_dst)
+            cap = (
+                self.capacity if self.capacity is not None
+                else bucket_capacity(n)
+            )
+            block = EdgeBlock.from_arrays(
+                src, dst, val, n_vertices=self.vertex_dict.capacity,
+                capacity=cap, val_dtype=self.val_dtype,
+            )
+            host_val = (
+                np.zeros(n, dtype=self.val_dtype)
+                if val is None
+                else np.asarray(val, self.val_dtype)
+            )
+            return block.with_host_cache(src, dst, host_val)
 
     def _block_from_encoded(
         self, src: np.ndarray, dst: np.ndarray, val: Optional[np.ndarray]
@@ -168,19 +197,26 @@ class Windower:
         """Build a block from already-compact int32 columns (the fused
         native parse+encode path — the vertex dict was updated upstream)."""
         n = src.shape[0]
-        src = np.ascontiguousarray(src, np.int32)
-        dst = np.ascontiguousarray(dst, np.int32)
-        cap = self.capacity if self.capacity is not None else bucket_capacity(n)
-        block = EdgeBlock.from_arrays(
-            src, dst, val, n_vertices=self.vertex_dict.capacity, capacity=cap,
-            val_dtype=self.val_dtype,
-        )
-        host_val = (
-            np.zeros(n, dtype=self.val_dtype)
-            if val is None
-            else np.asarray(val, self.val_dtype)
-        )
-        return block.with_host_cache(src, dst, host_val)
+        with _trace.span(
+            "window.pack",
+            {"edges": int(n), "encoded": True} if _trace.on() else None,
+        ):
+            src = np.ascontiguousarray(src, np.int32)
+            dst = np.ascontiguousarray(dst, np.int32)
+            cap = (
+                self.capacity if self.capacity is not None
+                else bucket_capacity(n)
+            )
+            block = EdgeBlock.from_arrays(
+                src, dst, val, n_vertices=self.vertex_dict.capacity,
+                capacity=cap, val_dtype=self.val_dtype,
+            )
+            host_val = (
+                np.zeros(n, dtype=self.val_dtype)
+                if val is None
+                else np.asarray(val, self.val_dtype)
+            )
+            return block.with_host_cache(src, dst, host_val)
 
     def blocks(self, edges: Iterable[Tuple]) -> Iterator[EdgeBlock]:
         """Yield one EdgeBlock per tumbling window."""
@@ -199,12 +235,7 @@ class Windower:
         """
         policy = self.policy
         index = 0
-        is_col_seq = (
-            isinstance(edges, (tuple, list))
-            and len(edges) >= 2
-            and all(isinstance(c, np.ndarray) and c.ndim == 1 for c in edges)
-        )
-        if isinstance(edges, np.ndarray) or is_col_seq:
+        if is_column_input(edges):
             yield from self._array_windows(edges)
             return
         if isinstance(policy, CountWindow):
@@ -296,14 +327,7 @@ class Windower:
         if k < 1:
             raise ValueError(f"superbatch k must be >= 1, got {k}")
         policy = self.policy
-        is_col_seq = (
-            isinstance(edges, (tuple, list))
-            and len(edges) >= 2
-            and all(isinstance(c, np.ndarray) and c.ndim == 1 for c in edges)
-        )
-        if isinstance(policy, CountWindow) and (
-            isinstance(edges, np.ndarray) or is_col_seq
-        ):
+        if isinstance(policy, CountWindow) and is_column_input(edges):
             yield from self._array_superbatches(edges, k)
             return
         yield from superbatches_from_blocks(
@@ -328,26 +352,39 @@ class Windower:
         index = 0
         for g0 in range(0, n, size * k):
             g1 = min(g0 + size * k, n)
-            # paired group encode: same first-seen order as per-window
-            # encodes run back to back (concatenation in window order)
-            s_g, d_g = self.vertex_dict.encode_pair(src[g0:g1], dst[g0:g1])
-            s_g = np.asarray(s_g, np.int32)
-            d_g = np.asarray(d_g, np.int32)
-            nv = self.vertex_dict.capacity
-            win_cols = []
-            infos = []
-            for w0 in range(g0, g1, size):
-                w1 = min(w0 + size, g1)
-                a, b = w0 - g0, w1 - g0
-                win_cols.append((
-                    s_g[a:b], d_g[a:b],
-                    None if val is None else val[w0:w1],
-                ))
-                infos.append(WindowInfo(index, None, None))
-                index += 1
-            yield SuperbatchGroup(
-                infos, win_cols, nv, val_dtype=self.val_dtype
-            )
+            # span covers the whole group assembly: one group encode +
+            # per-window column views (ZERO per-window device work —
+            # exactly the cost the superbatch ingest fusion exists to
+            # amortize, so it is the one worth measuring)
+            with _trace.span(
+                "window.superbatch_pack",
+                {"k": k, "edges": int(g1 - g0), "window_index": index}
+                if _trace.on() else None,
+            ):
+                # paired group encode: same first-seen order as
+                # per-window encodes run back to back (concatenation in
+                # window order)
+                s_g, d_g = self.vertex_dict.encode_pair(
+                    src[g0:g1], dst[g0:g1]
+                )
+                s_g = np.asarray(s_g, np.int32)
+                d_g = np.asarray(d_g, np.int32)
+                nv = self.vertex_dict.capacity
+                win_cols = []
+                infos = []
+                for w0 in range(g0, g1, size):
+                    w1 = min(w0 + size, g1)
+                    a, b = w0 - g0, w1 - g0
+                    win_cols.append((
+                        s_g[a:b], d_g[a:b],
+                        None if val is None else val[w0:w1],
+                    ))
+                    infos.append(WindowInfo(index, None, None))
+                    index += 1
+                group = SuperbatchGroup(
+                    infos, win_cols, nv, val_dtype=self.val_dtype
+                )
+            yield group
 
     # ------------------------------------------------------------------ #
     # Vectorized ingest: numpy columns instead of per-record tuples
@@ -624,12 +661,20 @@ class SuperbatchGroup:
     def stacked(self) -> StackedEdgeBlock:
         if self._stacked is not None:
             return self._stacked
-        if self.cols is not None:
-            self._stacked = stack_host_cols(
-                self.cols, self.n_vertices, val_dtype=self.val_dtype
-            )
-        else:
-            self._stacked = stack_blocks(self._blocks)
+        # span covers the [K, cap] device-stack materialization (one
+        # host->device transfer per column on the cols path, a device
+        # stack of the member blocks on the fallback)
+        with _trace.span(
+            "window.stack",
+            {"k": len(self), "from_cols": self.cols is not None}
+            if _trace.on() else None,
+        ):
+            if self.cols is not None:
+                self._stacked = stack_host_cols(
+                    self.cols, self.n_vertices, val_dtype=self.val_dtype
+                )
+            else:
+                self._stacked = stack_blocks(self._blocks)
         return self._stacked
 
 

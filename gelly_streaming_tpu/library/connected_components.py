@@ -65,6 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..aggregate.summary import SummaryBulkAggregation, SummaryTreeReduce
+from ..obs import trace as _trace
 from ..summaries.forest import (
     MirrorReplay,
     TouchLog,
@@ -278,20 +279,28 @@ class _CCMixin:
         read (``Components.from_forest_replay``), so unread windows cost
         nothing and the group pays ONE vcap-sized buffer copy where the
         per-window path paid K."""
-        self._ensure_windowed(group.n_vertices)
-        windows = [(c[0], c[1]) for c in group.cols]
-        self._canon, tids_list, replay = forest_superbatch(
-            self._canon, windows, self._vcap, self._prep,
-            mesh=mesh, tree=self._is_tree(), degree=eff_degree,
-        )
-        # first-seen log advances in window order BEFORE the emissions
-        # surface; each snapshot is a count into the append-only log
-        counts = []
-        for tids in tids_list:
-            self._log.add(tids)
-            counts.append(self._log.count)
-        self._summary = {"labels": self._canon}
-        self._sync_ref = self._canon
+        # span covers the fold dispatch + log advance, NOT the lazy
+        # per-window emissions reconstructed later on first read
+        with _trace.span(
+            "cc.forest_group",
+            {"k": len(group), "n_vertices": int(group.n_vertices)}
+            if _trace.on() else None,
+        ):
+            self._ensure_windowed(group.n_vertices)
+            windows = [(c[0], c[1]) for c in group.cols]
+            self._canon, tids_list, replay = forest_superbatch(
+                self._canon, windows, self._vcap, self._prep,
+                mesh=mesh, tree=self._is_tree(), degree=eff_degree,
+            )
+            # first-seen log advances in window order BEFORE the
+            # emissions surface; each snapshot is a count into the
+            # append-only log
+            counts = []
+            for tids in tids_list:
+                self._log.add(tids)
+                counts.append(self._log.count)
+            self._summary = {"labels": self._canon}
+            self._sync_ref = self._canon
         for i, count in enumerate(counts):
             yield Components.from_forest_replay(
                 replay, i, self._log, count, vdict
@@ -307,24 +316,32 @@ class _CCMixin:
         (:class:`~gelly_streaming_tpu.summaries.forest.MirrorReplay`),
         so mid-group emissions reconstruct on first read and the group
         pays one vcap buffer copy where the per-window mirror paid K."""
-        self._ensure_windowed(group.n_vertices)
-        wins, gids, groots, gtcnt = self._uf.fold_group(
-            group.cols, self._vcap
-        )
-        ngt = int(np.sum(gtcnt))
-        counts = self._log.add_grouped(gids[:ngt], gtcnt)
-        # group commit on HOST: the union-find's truth is host-side
-        # anyway, and one numpy fancy-assign (+ two vcap memcpys) beats
-        # the XLA scatter by ~10x on the CPU backend where this carry
-        # runs; the published device canon is a fresh immutable buffer
-        # per group, same contract as mirror_update's functional scatter
-        base = np.asarray(self._canon)  # zero-copy view on CPU
-        new_np = base.copy()
-        new_np[gids] = groots
-        self._canon = jnp.asarray(new_np)
-        replay = MirrorReplay(base, wins)
-        self._summary = {"labels": self._canon}
-        self._sync_ref = self._canon
+        # span covers the native group fold + mirror commit, NOT the
+        # lazy per-window emissions reconstructed later on first read
+        with _trace.span(
+            "cc.host_group",
+            {"k": len(group), "n_vertices": int(group.n_vertices)}
+            if _trace.on() else None,
+        ):
+            self._ensure_windowed(group.n_vertices)
+            wins, gids, groots, gtcnt = self._uf.fold_group(
+                group.cols, self._vcap
+            )
+            ngt = int(np.sum(gtcnt))
+            counts = self._log.add_grouped(gids[:ngt], gtcnt)
+            # group commit on HOST: the union-find's truth is host-side
+            # anyway, and one numpy fancy-assign (+ two vcap memcpys)
+            # beats the XLA scatter by ~10x on the CPU backend where
+            # this carry runs; the published device canon is a fresh
+            # immutable buffer per group, same contract as
+            # mirror_update's functional scatter
+            base = np.asarray(self._canon)  # zero-copy view on CPU
+            new_np = base.copy()
+            new_np[gids] = groots
+            self._canon = jnp.asarray(new_np)
+            replay = MirrorReplay(base, wins)
+            self._summary = {"labels": self._canon}
+            self._sync_ref = self._canon
         for i, count in enumerate(counts):
             yield Components.from_forest_replay(
                 replay, i, self._log, count, vdict
@@ -473,6 +490,14 @@ class CCServable:
             labels = agg._canon
         elif agg._summary is not None and "labels" in agg._summary:
             labels = agg._summary["labels"]
+            if agg._donated_carry:
+                # the dense superbatch carry is DONATED to the next
+                # group's dispatch (in-place HBM update) — publishing
+                # the live buffer would hand queries an alias that the
+                # dispatch invalidates. Snapshots must own their
+                # buffer; one vcap copy per publish is the price of
+                # donation on serving streams.
+                labels = jnp.array(labels)
         else:
             return None
         return {"labels": labels, "vdict": vdict}

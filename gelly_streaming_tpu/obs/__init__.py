@@ -1,0 +1,123 @@
+"""Unified observability: metric registry + pipeline spans + exporters.
+
+The reference has no metrics layer at all (one example prints
+``getNetRuntime()``); SURVEY.md §5 directs building per-window timing
+from day one while keeping the reference's design stance that metrics
+are ordinary OUTPUT STREAMS, never a side server. After the serving
+(PR 1) and superbatch (PR 2) layers, telemetry lived in two
+disconnected ad-hoc modules; this package is the one coherent layer the
+ROADMAP follow-ons (auto-K from measured window cost, multi-host
+fan-out) read from:
+
+- :mod:`registry` — process-wide thread-safe counters/gauges/bounded
+  histograms; :func:`~gelly_streaming_tpu.obs.registry.nearest_rank`
+  is THE shared percentile rule.
+- :mod:`trace` — ``span("pack")`` structured spans, nested per thread,
+  near-zero when disabled, optional ``jax.profiler`` annotation.
+- :mod:`export` — JSONL event log (replayable:
+  :func:`~gelly_streaming_tpu.obs.export.replay` reconstructs an
+  identical registry), Prometheus text renderer, periodic snapshots
+  composable with any emission stream.
+
+Usage::
+
+    from gelly_streaming_tpu import obs
+
+    obs.enable()                      # spans + hot-path gauges on
+    sink = obs.JsonlSink("run.jsonl")
+    obs.attach_sink(sink)             # event log: spans + metric events
+    ... run the pipeline ...
+    obs.get_registry().snapshot()     # plain-dict metrics
+    sink.write()                      # span/metric evidence to disk
+    obs.detach_sink(sink); obs.disable()
+
+Instrumented stages (all gated on ``obs.enable()`` except the serving
+stats, which are part of the serving API and always on):
+``window.pack`` / ``window.superbatch_pack`` / ``window.stack`` host
+packing, ``engine.dispatch`` / ``engine.superbatch_dispatch`` device
+dispatch (+ ``engine.donated_dispatches`` counter),
+``pipeline.queue_depth`` / ``producer_blocked_s`` / ``consumer_idle_s``
+prefetch coupling, ``checkpoint.barrier`` / ``barrier_wait`` /
+``serialize``, and the ``serving.*`` admission/batch/drain surface.
+"""
+
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    format_key,
+    get_registry,
+    nearest_rank,
+    set_registry,
+)
+from .trace import (
+    NOOP_SPAN,
+    Span,
+    current_span,
+    disable,
+    enable,
+    enabled,
+    on,
+    span,
+)
+from . import trace as _trace
+from .export import (
+    JsonlSink,
+    prometheus_text,
+    read_jsonl,
+    replay,
+    snapshot_stream,
+    write_jsonl,
+)
+
+
+def attach_sink(sink) -> None:
+    """Attach one sink to BOTH event sources: finished spans (tracer)
+    and metric mutations (the global registry). One call gives one
+    unified chronological event log."""
+    _trace.add_sink(sink)
+    get_registry().add_sink(sink)
+
+
+def detach_sink(sink) -> None:
+    _trace.remove_sink(sink)
+    get_registry().remove_sink(sink)
+
+
+def reset() -> None:
+    """Test/bench hygiene: disable tracing, drop all tracer sinks, and
+    install a fresh global registry."""
+    disable()
+    for s in _trace.sinks():
+        _trace.remove_sink(s)
+    set_registry(None)
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "MetricRegistry",
+    "NOOP_SPAN",
+    "Span",
+    "attach_sink",
+    "current_span",
+    "detach_sink",
+    "disable",
+    "enable",
+    "enabled",
+    "format_key",
+    "get_registry",
+    "nearest_rank",
+    "on",
+    "prometheus_text",
+    "read_jsonl",
+    "replay",
+    "reset",
+    "set_registry",
+    "snapshot_stream",
+    "span",
+    "write_jsonl",
+]

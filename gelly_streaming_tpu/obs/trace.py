@@ -1,0 +1,212 @@
+"""Lightweight structured spans over the pipeline's host-side stages.
+
+``span("pack")`` wraps a stage; nested spans form a tree via a
+thread-local stack (each pipeline thread — windower, prefetch producer,
+serving worker — gets its own lineage). A finished span becomes ONE
+event dict pushed to the attached sinks and one observation in the
+global registry's ``trace.span_seconds{span=...}`` histogram, so span
+timing shows up in the same snapshot/Prometheus surface as every other
+metric. Optionally (``enable(jax_annotations=True)``) each span also
+opens a ``jax.profiler.TraceAnnotation`` so host stages line up against
+device ops in TensorBoard traces.
+
+DISABLED COST IS THE DESIGN CONSTRAINT: instrumentation is threaded
+through per-window hot paths (``core/window.py`` pack,
+``aggregate/summary.py`` dispatch, ``core/pipeline.py`` prefetch), so
+``span()`` with tracing off must be near-free. The disabled path is one
+attribute check and returns a SHARED no-op singleton — no object, no
+dict, no clock read is allocated or taken (the zero-allocation property
+``tests/test_obs.py`` pins). Hot sites that would pay even for building
+an attrs dict guard on :func:`on` first.
+
+Timing semantics: spans measure HOST wall time between ``__enter__`` and
+``__exit__``. Around an async device dispatch that is enqueue time, not
+compute time — the same contract as ``SummaryAggregation.sync()``
+documents for throughput measurement.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Optional
+
+
+class _Config:
+    __slots__ = ("enabled", "annotate_jax", "registry_spans")
+
+    def __init__(self):
+        self.enabled = False
+        self.annotate_jax = False
+        self.registry_spans = True
+
+
+_CFG = _Config()
+_SINKS: list = []
+_LOCAL = threading.local()
+_IDS = itertools.count(1)
+
+
+def on() -> bool:
+    """True when tracing is enabled (the hot-path guard)."""
+    return _CFG.enabled
+
+
+enabled = on  # alias; both read naturally at call sites
+
+
+def enable(*, jax_annotations: bool = False,
+           registry_spans: bool = True) -> None:
+    """Turn span recording on.
+
+    ``jax_annotations`` additionally opens a
+    ``jax.profiler.TraceAnnotation`` per span (device-trace alignment;
+    requires jax, imported lazily). ``registry_spans`` mirrors span
+    durations into the global registry's ``trace.span_seconds``
+    histogram (on by default — it is what makes span timing visible to
+    the Prometheus/snapshot exporters).
+    """
+    _CFG.annotate_jax = bool(jax_annotations)
+    _CFG.registry_spans = bool(registry_spans)
+    _CFG.enabled = True
+
+
+def disable() -> None:
+    _CFG.enabled = False
+    _CFG.annotate_jax = False
+
+
+def add_sink(sink) -> None:
+    """Attach a span-event sink (``sink.emit(event_dict)``)."""
+    if sink not in _SINKS:
+        _SINKS.append(sink)
+
+
+def remove_sink(sink) -> None:
+    if sink in _SINKS:
+        _SINKS.remove(sink)
+
+
+def sinks() -> list:
+    return list(_SINKS)
+
+
+class _NoopSpan:
+    """The disabled-mode singleton: every method is a no-op, entering
+    returns the singleton itself. ``recording`` lets call sites skip
+    building expensive attributes."""
+
+    __slots__ = ()
+    recording = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One recorded stage. Use via ``with span("pack", {...}):``."""
+
+    __slots__ = ("name", "attrs", "sid", "parent", "depth", "t0",
+                 "dur_s", "_ann")
+    recording = True
+
+    def __init__(self, name: str, attrs: Optional[dict] = None):
+        self.name = name
+        self.attrs = attrs
+        self.sid = 0
+        self.parent = None
+        self.depth = 0
+        self.t0 = 0.0
+        self.dur_s = 0.0
+        self._ann = None
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes after entry (lets call sites add values
+        computed inside the span without paying for them when tracing
+        is off — guard on ``.recording``)."""
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        stack = getattr(_LOCAL, "stack", None)
+        if stack is None:
+            stack = _LOCAL.stack = []
+        self.sid = next(_IDS)
+        self.depth = len(stack)
+        self.parent = stack[-1].sid if stack else None
+        stack.append(self)
+        if _CFG.annotate_jax:
+            try:
+                import jax
+
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.dur_s = time.perf_counter() - self.t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+        stack = getattr(_LOCAL, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif stack and self in stack:  # mis-nested exit: drop through it
+            del stack[stack.index(self):]
+        event = {
+            "kind": "span",
+            "name": self.name,
+            "ts": time.time(),
+            "dur_s": self.dur_s,
+            "sid": self.sid,
+            "depth": self.depth,
+        }
+        if self.parent is not None:
+            event["parent"] = self.parent
+        if self.attrs:
+            event["attrs"] = self.attrs
+        for s in _SINKS:
+            s.emit(event)
+        if _CFG.registry_spans:
+            from .registry import get_registry
+
+            get_registry().histogram(
+                "trace.span_seconds", span=self.name
+            ).observe(self.dur_s)
+        return False
+
+
+def span(name: str, attrs: Optional[dict] = None):
+    """A context manager timing one named stage (no-op when disabled).
+
+    ``attrs`` is an optional plain dict of span attributes (window
+    index, superbatch K, block edges, ...). Truly hot call sites guard
+    with :func:`on` before building the dict; everywhere else the dict
+    literal's cost is negligible next to the stage it measures.
+    """
+    if not _CFG.enabled:
+        return NOOP_SPAN
+    return Span(name, attrs)
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span on this thread (None outside any span)."""
+    stack = getattr(_LOCAL, "stack", None)
+    return stack[-1] if stack else None

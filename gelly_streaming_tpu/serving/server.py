@@ -30,6 +30,7 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Iterator, Optional, Tuple
 
+from ..obs import trace as _trace
 from .query import Answer, Query, QueryEngine
 from .snapshot_store import PublishedSnapshot, SnapshotStore
 from .stats import ServingStats
@@ -216,6 +217,7 @@ class StreamServer:
                     f"(max_pending={self.max_pending})"
                 )
             self._pending.append((query, f, time.perf_counter()))
+            self.stats.set_pending(admitted + 1)  # admission gauge
         self._wake.set()
         return f
 
@@ -235,11 +237,20 @@ class StreamServer:
             batch = list(self._pending)
             self._pending.clear()
             self._inflight = len(batch)
+        if batch:
+            # coalescing evidence: how many concurrent queries one
+            # vectorized sweep absorbed (empty sweeps are not recorded —
+            # the idle poll would drown the signal)
+            self.stats.record_drain(len(batch))
         return batch
 
     def _settle(self) -> None:
         with self._lock:
             self._inflight = 0
+            # the answered batch left flight: the admission gauge must
+            # fall back to what is actually still waiting, or an idle
+            # server reports the last burst as a phantom backlog forever
+            self.stats.set_pending(len(self._pending))
 
     def _answer(self, batch: list) -> None:
         # during live ingest, trade bounded staleness (READY_LOOKBACK
@@ -263,9 +274,14 @@ class StreamServer:
             return
         queries = [q for q, _, _ in batch]
         try:
-            answers = self.engine.answer_batch(
-                snap, queries, head_window=self.store.head_window()
-            )
+            with _trace.span(
+                "serving.answer",
+                {"batch": len(batch), "window": snap.window}
+                if _trace.on() else None,
+            ):
+                answers = self.engine.answer_batch(
+                    snap, queries, head_window=self.store.head_window()
+                )
         except Exception as e:
             for _, f, _ in batch:
                 if not f.done():
@@ -337,26 +353,28 @@ class StreamServer:
         threads. Idempotent."""
         if self._closed:
             return
-        self._closing = True
-        self._stop_ingest.set()
-        self._wake.set()
-        if self._ingest_thread is not None:
-            self._ingest_thread.join(timeout)
-        if self._worker_thread is not None:
-            self._worker_thread.join(timeout)
-        # a submit racing the closing flag can slip one entry past the
-        # worker's exit check; answer stragglers here so no future hangs
-        leftovers = self._drain()
-        if leftovers:
-            try:
-                self._answer(leftovers)
-            except BaseException as e:
-                for _, f, _ in leftovers:
-                    if not f.done():
-                        f.set_exception(e)
-            finally:
-                self._settle()
-        self.store.close()
-        self._closed = True
+        with _trace.span("serving.drain"):
+            self._closing = True
+            self._stop_ingest.set()
+            self._wake.set()
+            if self._ingest_thread is not None:
+                self._ingest_thread.join(timeout)
+            if self._worker_thread is not None:
+                self._worker_thread.join(timeout)
+            # a submit racing the closing flag can slip one entry past
+            # the worker's exit check; answer stragglers here so no
+            # future hangs
+            leftovers = self._drain()
+            if leftovers:
+                try:
+                    self._answer(leftovers)
+                except BaseException as e:
+                    for _, f, _ in leftovers:
+                        if not f.done():
+                            f.set_exception(e)
+                finally:
+                    self._settle()
+            self.store.close()
+            self._closed = True
         if self._ingest_error is not None:
             raise self._ingest_error

@@ -2,81 +2,94 @@
 
 The reference's design stance is that metrics are ordinary output
 streams (``utils/profiling.py`` docstring); the serving tier keeps it:
-no metrics server, no registry — :meth:`ServingStats.snapshot` returns a
-plain dict and :meth:`ServingStats.stream` yields those dicts like any
-other emission iterator. Percentiles reuse
-:class:`~gelly_streaming_tpu.utils.profiling.StreamProfiler` (one per
-query class; each answered query records as one "window").
+no metrics server — :meth:`ServingStats.snapshot` returns a plain dict
+and :meth:`ServingStats.stream` yields those dicts like any other
+emission iterator. Since ISSUE 3 the class is a VIEW over a
+:class:`~gelly_streaming_tpu.obs.registry.MetricRegistry` rather than a
+private dict-of-lists: the same counters/histograms surface through the
+obs exporters (Prometheus text, JSONL event log), and a recorded event
+log replays to an identical snapshot
+(:func:`~gelly_streaming_tpu.obs.export.replay` +
+:meth:`ServingStats.from_events` — the serving bench's honesty check).
+
+Each ``ServingStats`` owns a PRIVATE registry by default so two servers
+in one process never blend their counts; pass ``registry=`` to share or
+to wrap a replayed one. Percentiles are the repo-wide nearest-rank rule
+(:func:`~gelly_streaming_tpu.obs.registry.nearest_rank`) over a bounded
+recent sample window (``MAX_SAMPLES``, drop-oldest-half — a long-lived
+server must not grow a list per query forever); counts and staleness
+sum/max stay exact over the full lifetime.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, Iterator
+from typing import Iterable, Iterator, Optional
 
-from ..utils.profiling import StreamProfiler, WindowStats
-
-
-def _pct(sorted_xs: list, q: float) -> float:
-    """Percentile over an ALREADY-SORTED sample list (the same
-    nearest-rank rule as ``StreamProfiler.latency_percentile``)."""
-    if not sorted_xs:
-        return 0.0
-    k = min(
-        len(sorted_xs) - 1,
-        max(0, int(round(q / 100 * (len(sorted_xs) - 1)))),
-    )
-    return sorted_xs[k]
+from ..obs.registry import MetricRegistry
 
 
 class ServingStats:
-    """Aggregates per-query-class latency histograms and staleness
-    gauges. Thread-safe: the query worker records, any thread reads.
-
-    Latency samples are bounded per class (``MAX_SAMPLES``; the oldest
-    half drops when full, so percentiles describe the recent window) —
-    a long-lived server must not grow a list per query forever. The
-    staleness gauges and counts stay exact over the full lifetime."""
+    """Per-query-class latency histograms + staleness gauges, backed by
+    a metric registry. Thread-safe: the query worker records, any
+    thread reads (instrument-level locks; snapshot sorts copies outside
+    them, so reading percentiles never stalls ``record``)."""
 
     #: per-class latency sample cap (drop-oldest-half on overflow)
     MAX_SAMPLES = 1 << 16
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._lat: Dict[str, StreamProfiler] = {}
-        self._counts: Dict[str, int] = {}  # lifetime (samples are capped)
-        self._stale_sum: Dict[str, int] = {}
-        self._stale_max: Dict[str, int] = {}
-        self._rejected = 0
-        self._batches = 0
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        self.registry = registry if registry is not None else MetricRegistry()
+        self._rejected = self.registry.counter("serving.rejected")
+        self._batches = self.registry.counter("serving.batches")
 
-    # -- write side (query worker) ------------------------------------- #
+    # -- write side (query worker / server) ----------------------------- #
     def record(self, qclass: str, seconds: float, staleness: int) -> None:
         """One answered query: wall seconds from submit to answer, and
         the answer's windows-behind-head staleness."""
-        with self._lock:
-            prof = self._lat.get(qclass)
-            if prof is None:
-                prof = self._lat[qclass] = StreamProfiler()
-                self._stale_sum[qclass] = 0
-                self._stale_max[qclass] = 0
-                self._counts[qclass] = 0
-            if len(prof.stats) >= self.MAX_SAMPLES:
-                prof.stats = prof.stats[self.MAX_SAMPLES // 2 :]
-            prof.record(WindowStats(len(prof.stats), seconds, None))
-            self._counts[qclass] += 1
-            self._stale_sum[qclass] += staleness
-            self._stale_max[qclass] = max(
-                self._stale_max[qclass], staleness
-            )
+        self.registry.histogram(
+            "serving.query_seconds", max_samples=self.MAX_SAMPLES,
+            cls=qclass,
+        ).observe(seconds)
+        self.registry.histogram(
+            "serving.staleness_windows", max_samples=self.MAX_SAMPLES,
+            cls=qclass,
+        ).observe(staleness)
 
     def record_batch(self) -> None:
-        with self._lock:
-            self._batches += 1
+        self._batches.inc()
 
     def record_rejected(self) -> None:
-        with self._lock:
-            self._rejected += 1
+        self._rejected.inc()
+
+    def set_pending(self, n: int) -> None:
+        """Admission gauge: queries admitted but not yet answered."""
+        self.registry.gauge("serving.pending").set(n)
+
+    def record_drain(self, batch_size: int) -> None:
+        """One worker sweep: how many pending queries coalesced into a
+        single vectorized answer batch."""
+        self.registry.histogram(
+            "serving.batch_size", max_samples=self.MAX_SAMPLES
+        ).observe(batch_size)
+
+    # -- event-log plumbing --------------------------------------------- #
+    def attach_sink(self, sink) -> None:
+        """Mirror every stat mutation to ``sink.emit(event)`` (a
+        :class:`~gelly_streaming_tpu.obs.export.JsonlSink` makes the
+        stats replayable from their own log)."""
+        self.registry.add_sink(sink)
+
+    def detach_sink(self, sink) -> None:
+        self.registry.remove_sink(sink)
+
+    @classmethod
+    def from_events(cls, events: Iterable[dict]) -> "ServingStats":
+        """Rebuild stats from a recorded event log (see
+        :func:`~gelly_streaming_tpu.obs.export.replay`); the returned
+        view's :meth:`snapshot` equals the live run's."""
+        from ..obs.export import replay
+
+        return cls(registry=replay(events))
 
     # -- read side ------------------------------------------------------ #
     def snapshot(self) -> dict:
@@ -87,38 +100,29 @@ class ServingStats:
                  "count": 10000, "p50_ms": 0.8, "p99_ms": 3.1,
                  "staleness_mean": 0.2, "staleness_max": 2}}}
         """
-        # copy under the lock, sort OUTSIDE it: sorting 64k samples per
-        # class while holding the lock would block the query worker's
-        # record() (futures settle after it) for milliseconds — tail
-        # latency injected by the act of measuring it
-        with self._lock:
-            out = {
-                "rejected": self._rejected,
-                "batches": self._batches,
-                "queries": {},
-            }
-            copied = {
-                qclass: (
-                    [s.wall_seconds for s in prof.stats],
-                    self._counts[qclass],
-                    self._stale_sum[qclass],
-                    self._stale_max[qclass],
-                )
-                for qclass, prof in self._lat.items()
-            }
-        for qclass, (xs, n, ssum, smax) in copied.items():
-            xs.sort()  # one sort serves both percentiles
+        out = {
+            "rejected": int(self._rejected.value),
+            "batches": int(self._batches.value),
+            "queries": {},
+        }
+        for labels, lat in self.registry.find("serving.query_seconds"):
+            qclass = labels["cls"]
+            stal = self.registry.histogram(
+                "serving.staleness_windows", max_samples=self.MAX_SAMPLES,
+                cls=qclass,
+            )
+            n = lat.count
             out["queries"][qclass] = {
                 "count": n,
-                "p50_ms": _pct(xs, 50) * 1e3,
-                "p99_ms": _pct(xs, 99) * 1e3,
-                "staleness_mean": ssum / n if n else 0.0,
-                "staleness_max": smax,
+                "p50_ms": lat.percentile(50) * 1e3,
+                "p99_ms": lat.percentile(99) * 1e3,
+                "staleness_mean": stal.sum / n if n else 0.0,
+                "staleness_max": int(stal.max),
             }
         return out
 
     def stream(self) -> Iterator[dict]:
         """Unbounded metrics stream: each ``next()`` yields the current
-        snapshot dict (pull-based, like every other emission stream)."""
+        snapshot dict (pull-based, like any other emission iterator)."""
         while True:
             yield self.snapshot()

@@ -10,7 +10,12 @@ reference's design stance that metrics are ordinary output streams
 - :func:`profiled` wraps any per-window emission iterator and yields
   ``(result, WindowStats)`` pairs — the metrics ARE a stream.
 - :class:`StreamProfiler` aggregates those stats (edges/sec, p50/p95
-  window latency).
+  window latency). Since ISSUE 3 it is also a VIEW over the obs metric
+  registry: with observability enabled (or a registry passed), every
+  recorded window mirrors into ``profiler.window_seconds`` /
+  ``profiler.window_edges`` so the same numbers surface through the
+  Prometheus/JSONL exporters; percentiles use the repo-wide
+  :func:`~gelly_streaming_tpu.obs.registry.nearest_rank` rule.
 - :func:`device_trace` wraps ``jax.profiler.trace`` for TensorBoard-
   readable TPU traces.
 """
@@ -18,8 +23,12 @@ reference's design stance that metrics are ordinary output streams
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 from typing import Any, Iterator, List, NamedTuple, Optional, Tuple
+
+from ..obs import trace as _trace
+from ..obs.registry import get_registry, nearest_rank
 
 
 class WindowStats(NamedTuple):
@@ -31,13 +40,31 @@ class WindowStats(NamedTuple):
 
 
 class StreamProfiler:
-    """Aggregate window stats; exposes throughput and latency percentiles."""
+    """Aggregate window stats; exposes throughput and latency percentiles.
 
-    def __init__(self):
+    ``registry`` (optional) pins where mirrored metrics go; by default
+    they go to the global obs registry ONLY while observability is
+    enabled, so a bare profiler stays a private list like it always was.
+    ``name`` prefixes the mirrored instrument names (one profiler per
+    pipeline stage stays distinguishable).
+    """
+
+    def __init__(self, registry=None, name: str = "profiler"):
         self.stats: List[WindowStats] = []
+        self._registry = registry
+        self._name = name
 
     def record(self, s: WindowStats) -> None:
         self.stats.append(s)
+        reg = self._registry
+        if reg is None and _trace.on():
+            reg = get_registry()
+        if reg is not None:
+            reg.histogram(self._name + ".window_seconds").observe(
+                s.wall_seconds
+            )
+            if s.edges:
+                reg.counter(self._name + ".window_edges").inc(s.edges)
 
     # ------------------------------------------------------------------ #
     def total_edges(self) -> int:
@@ -51,12 +78,10 @@ class StreamProfiler:
         return self.total_edges() / t if t > 0 else 0.0
 
     def latency_percentile(self, q: float) -> float:
-        """q in [0, 100]: percentile of per-window wall time (seconds)."""
-        if not self.stats:
-            return 0.0
-        xs = sorted(s.wall_seconds for s in self.stats)
-        k = min(len(xs) - 1, max(0, int(round(q / 100 * (len(xs) - 1)))))
-        return xs[k]
+        """q in [0, 100]: percentile of per-window wall time (seconds).
+        Nearest-rank, via the shared obs helper (previously duplicated
+        here and in ``serving/stats._pct``)."""
+        return nearest_rank(sorted(s.wall_seconds for s in self.stats), q)
 
     def summary(self) -> dict:
         return {
@@ -125,13 +150,8 @@ _CHIP_PEAKS = {
 }
 
 
-import functools
-
-
 @functools.lru_cache(maxsize=1)
-def chip_spec() -> dict:
-    """Peak numbers for the attached device (fuzzy device_kind match;
-    cached — every roofline entry reads it)."""
+def _chip_spec_cached() -> dict:
     import jax
 
     kind = jax.devices()[0].device_kind.lower()
@@ -144,6 +164,28 @@ def chip_spec() -> dict:
     # unknown accelerator: assume a v4-class chip and say so
     return {"kind": kind + " (assumed v4-class)",
             "peak_bf16_flops": 275e12, "hbm_bytes_s": 1.2e12}
+
+
+def chip_spec() -> dict:
+    """Peak numbers for the attached device (fuzzy device_kind match;
+    cached — every roofline entry reads it).
+
+    Degrades to the nominal CPU peaks when ``jax.devices()`` itself
+    fails (backend down / tunnel gone): a roofline ANNOTATION must never
+    crash the measurement it annotates. The failure is recorded in the
+    returned ``kind`` and NOT cached, so a recovered backend gets its
+    real spec on the next call.
+    """
+    try:
+        return _chip_spec_cached()
+    except Exception as e:  # jax.devices() raising = no backend reachable
+        flops, bw = _CHIP_PEAKS["cpu"]
+        return {
+            "kind": f"unavailable (jax.devices failed: {e}); "
+                    "assuming nominal cpu peaks",
+            "peak_bf16_flops": flops,
+            "hbm_bytes_s": bw,
+        }
 
 
 def roofline_entry(

@@ -1,0 +1,538 @@
+"""Tests for the unified observability subsystem (ISSUE 3).
+
+Covers: span nesting/attributes, histogram percentiles vs a numpy
+oracle, Prometheus/JSONL exporter round-trip (the event log replays to
+an identical registry snapshot — live serving run included),
+disabled-mode zero-allocation fast path, the prefetch coupling gauges,
+and the overhead guard on a 1M-edge CPU run.
+"""
+
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import obs
+from gelly_streaming_tpu.obs.export import (
+    JsonlSink,
+    prometheus_text,
+    read_jsonl,
+    replay,
+    snapshot_stream,
+)
+from gelly_streaming_tpu.obs.registry import (
+    MetricRegistry,
+    nearest_rank,
+)
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    """Every test starts and ends with observability fully reset: no
+    global-state leakage between tests (or into the rest of the suite)."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# --------------------------------------------------------------------- #
+# Registry
+# --------------------------------------------------------------------- #
+def test_counter_gauge_basic():
+    reg = MetricRegistry()
+    c = reg.counter("ingest.edges")
+    c.inc()
+    c.inc(41.5)
+    assert c.value == 42.5
+    g = reg.gauge("queue.depth")
+    g.set(7)
+    g.inc(-2)
+    assert g.value == 5.0
+    snap = reg.snapshot()
+    assert snap["counters"]["ingest.edges"] == 42.5
+    assert snap["gauges"]["queue.depth"] == 5.0
+
+
+def test_labeled_instruments_and_find():
+    reg = MetricRegistry()
+    reg.counter("q", cls="A").inc(1)
+    reg.counter("q", cls="B").inc(2)
+    assert reg.counter("q", cls="A") is reg.counter("q", cls="A")
+    found = dict(
+        (labels["cls"], m.value) for labels, m in reg.find("q")
+    )
+    assert found == {"A": 1.0, "B": 2.0}
+    assert "q{cls=A}" in reg.snapshot()["counters"]
+
+
+def test_kind_conflict_raises():
+    reg = MetricRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_histogram_percentiles_vs_numpy_oracle():
+    reg = MetricRegistry()
+    h = reg.histogram("lat")
+    rng = np.random.default_rng(7)
+    xs = rng.lognormal(size=2001)
+    for v in xs:
+        h.observe(v)
+    s = np.sort(xs)
+    for q in (0.0, 1.0, 25.0, 50.0, 90.0, 95.0, 99.0, 100.0):
+        # the exact nearest-rank definition, indexed on the numpy sort
+        k = min(len(s) - 1, max(0, int(round(q / 100 * (len(s) - 1)))))
+        assert h.percentile(q) == s[k]
+        # and sanity vs numpy's own percentile (any interpolation lands
+        # within one sample of nearest-rank on a dense sample set)
+        assert abs(h.percentile(q) - np.percentile(xs, q)) <= (
+            np.percentile(xs, min(100.0, q + 1)) -
+            np.percentile(xs, max(0.0, q - 1)) + 1e-12
+        )
+    assert h.count == len(xs)
+    assert h.sum == pytest.approx(float(xs.sum()))
+    assert h.min == s[0] and h.max == s[-1]
+
+
+def test_histogram_bounded_eviction_keeps_lifetime_exact():
+    reg = MetricRegistry()
+    h = reg.histogram("lat", max_samples=8)
+    for i in range(20):
+        h.observe(float(i))
+    assert h.count == 20
+    assert h.sum == float(sum(range(20)))
+    assert h.max == 19.0 and h.min == 0.0
+    # drop-oldest-half: the sample window only holds recent values
+    assert len(h.samples()) <= 8
+    assert min(h.samples()) > 0.0
+
+
+def test_nearest_rank_is_the_shared_percentile():
+    """The dedup satellite: both historical implementations now route
+    through obs.registry.nearest_rank and agree with it exactly."""
+    from gelly_streaming_tpu.serving.stats import ServingStats
+    from gelly_streaming_tpu.utils.profiling import (
+        StreamProfiler,
+        WindowStats,
+    )
+
+    xs = [0.5, 0.1, 0.9, 0.3, 0.7]
+    prof = StreamProfiler()
+    for i, v in enumerate(xs):
+        prof.record(WindowStats(i, v, None))
+    st = ServingStats()
+    for v in xs:
+        st.record("Q", v, 0)
+    for q in (0, 10, 50, 95, 100):
+        want = nearest_rank(sorted(xs), q)
+        assert prof.latency_percentile(q) == want
+        got_ms = st.snapshot()["queries"]["Q"] if q == 50 else None
+        if got_ms is not None:
+            assert got_ms["p50_ms"] == want * 1e3
+
+
+# --------------------------------------------------------------------- #
+# Spans
+# --------------------------------------------------------------------- #
+def test_span_nesting_and_attributes():
+    sink = JsonlSink()
+    obs.enable()
+    obs.attach_sink(sink)
+    with obs.span("outer", {"window_index": 3}):
+        with obs.span("inner", {"k": 4, "edges": 1024}) as sp:
+            time.sleep(0.002)
+            sp.set(donated=True)
+    spans = [e for e in sink.events if e["kind"] == "span"]
+    # completion order: inner closes first
+    inner, outer = spans[0], spans[1]
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert inner["parent"] == outer["sid"]
+    assert "parent" not in outer
+    assert inner["attrs"] == {"k": 4, "edges": 1024, "donated": True}
+    assert outer["attrs"] == {"window_index": 3}
+    assert inner["dur_s"] >= 0.002
+    assert outer["dur_s"] >= inner["dur_s"]
+    # span durations also land in the registry histogram, labeled
+    hist = {
+        labels["span"]: m
+        for labels, m in obs.get_registry().find("trace.span_seconds")
+    }
+    assert hist["inner"].count == 1 and hist["outer"].count == 1
+
+
+def test_span_stacks_are_per_thread():
+    sink = JsonlSink()
+    obs.enable()
+    obs.attach_sink(sink)
+    barrier = threading.Barrier(2)
+
+    def work(name):
+        with obs.span(name):
+            barrier.wait(5)  # both spans open concurrently
+            with obs.span(name + ".child"):
+                pass
+
+    ts = [threading.Thread(target=work, args=(n,)) for n in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    spans = {e["name"]: e for e in sink.events if e["kind"] == "span"}
+    # each child nests under ITS thread's root, never the other's
+    assert spans["a.child"]["parent"] == spans["a"]["sid"]
+    assert spans["b.child"]["parent"] == spans["b"]["sid"]
+    assert spans["a"]["depth"] == spans["b"]["depth"] == 0
+
+
+def test_disabled_span_is_zero_allocation_noop():
+    assert not obs.enabled()
+    s1 = obs.span("pack")
+    s2 = obs.span("dispatch")
+    # one shared singleton: nothing allocated per disabled call
+    assert s1 is s2 is obs.NOOP_SPAN
+    with s1 as sp:
+        assert sp is obs.NOOP_SPAN
+        sp.set(anything=1)  # no-op, no state
+    tracemalloc.start()
+    for _ in range(1000):
+        with obs.span("hot"):
+            pass
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    # the loop itself must not allocate per iteration (tracemalloc's own
+    # bookkeeping costs a few hundred bytes; 1000 spans of even one
+    # small object each would be tens of KB)
+    assert peak < 8192, f"disabled span loop allocated {peak} bytes"
+
+
+def test_enable_disable_roundtrip_and_instrumented_pipeline():
+    """End-to-end: a real aggregation run with obs enabled produces the
+    hot-path spans, and the same run disabled produces none."""
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    rng = np.random.default_rng(5)
+    src = rng.integers(0, 64, 600).astype(np.int32)
+    dst = rng.integers(0, 64, 600).astype(np.int32)
+
+    def run():
+        stream = SimpleEdgeStream((src, dst), window=CountWindow(100))
+        return list(stream.aggregate(ConnectedComponents()))
+
+    sink = JsonlSink()
+    obs.enable()
+    obs.attach_sink(sink)
+    run()
+    names = {e["name"] for e in sink.events if e["kind"] == "span"}
+    assert "window.pack" in names
+    obs.reset()
+
+    sink2 = JsonlSink()
+    obs.attach_sink(sink2)  # sink attached but tracing DISABLED
+    run()
+    assert not [e for e in sink2.events if e["kind"] == "span"]
+
+
+# --------------------------------------------------------------------- #
+# Exporters
+# --------------------------------------------------------------------- #
+def test_jsonl_roundtrip_replays_to_identical_snapshot(tmp_path):
+    reg = MetricRegistry()
+    sink = JsonlSink()
+    reg.add_sink(sink)
+    rng = np.random.default_rng(11)
+    lat = reg.histogram("lat", max_samples=64, cls="Q")
+    for v in rng.random(500):
+        lat.observe(float(v))
+    reg.counter("served").inc(500)
+    reg.gauge("pending").set(12)
+    reg.gauge("pending").set(3)  # last write wins through replay too
+    path = str(tmp_path / "events.jsonl")
+    sink.write(path)
+    events = read_jsonl(path)
+    assert len(events) == 503
+    replayed = replay(events)
+    assert replayed.snapshot() == reg.snapshot()
+    # eviction-dependent percentiles included: same bounded window
+    assert (
+        replayed.histogram("lat", max_samples=64, cls="Q").samples()
+        == lat.samples()
+    )
+
+
+def test_replay_skips_span_and_meta_events():
+    events = [
+        {"kind": "meta", "bench": "x"},
+        {"kind": "span", "name": "pack", "dur_s": 0.1, "sid": 1,
+         "depth": 0, "ts": 0.0},
+        {"kind": "counter", "name": "c", "v": 2},
+    ]
+    reg = replay(events)
+    assert reg.snapshot()["counters"] == {"c": 2.0}
+
+
+def test_prometheus_text_renderer():
+    reg = MetricRegistry()
+    reg.counter("serving.rejected").inc(3)
+    reg.gauge("pipeline.queue_depth").set(2)
+    h = reg.histogram("serving.query_seconds", cls="ConnectedQuery")
+    for v in (0.001, 0.002, 0.003, 0.004):
+        h.observe(v)
+    text = prometheus_text(reg)
+    assert "# TYPE serving_rejected counter" in text
+    assert "serving_rejected 3" in text
+    assert "# TYPE pipeline_queue_depth gauge" in text
+    assert "pipeline_queue_depth 2" in text
+    assert "# TYPE serving_query_seconds summary" in text
+    # nearest-rank p50 over 4 samples: index round(0.5 * 3) = 2
+    assert (
+        'serving_query_seconds{cls="ConnectedQuery",quantile="0.5"} 0.003'
+        in text
+    )
+    assert 'serving_query_seconds_sum{cls="ConnectedQuery"} 0.01' in text
+    assert 'serving_query_seconds_count{cls="ConnectedQuery"} 4' in text
+
+
+def test_snapshot_stream_composes_with_emissions():
+    reg = MetricRegistry()
+    c = reg.counter("windows")
+
+    def emissions():
+        for i in range(7):
+            c.inc()
+            yield i
+
+    out = list(snapshot_stream(emissions(), every=3, registry=reg))
+    assert [item for item, _ in out] == list(range(7))
+    snaps = [(i, s) for i, (_, s) in enumerate(out) if s is not None]
+    assert [i for i, _ in snaps] == [2, 5]  # every 3rd item
+    assert snaps[0][1]["counters"]["windows"] == 3.0
+    assert snaps[1][1]["counters"]["windows"] == 6.0
+
+
+# --------------------------------------------------------------------- #
+# ServingStats as a registry view + live server replay
+# --------------------------------------------------------------------- #
+def test_serving_stats_event_log_replay_unit():
+    from gelly_streaming_tpu.serving.stats import ServingStats
+
+    st = ServingStats()
+    sink = JsonlSink()
+    st.attach_sink(sink)
+    rng = np.random.default_rng(3)
+    for i in range(200):
+        st.record("ConnectedQuery", float(rng.random()) * 1e-3, i % 3)
+    for _ in range(5):
+        st.record_batch()
+    st.record_rejected()
+    st.set_pending(4)
+    st.record_drain(40)
+    live = st.snapshot()
+    assert live["queries"]["ConnectedQuery"]["count"] == 200
+    assert ServingStats.from_events(sink.events).snapshot() == live
+
+
+def test_live_server_event_log_replays_to_reported_snapshot():
+    """The ISSUE 3 acceptance shape, in-miniature: a real StreamServer
+    run with an attached event sink; the JSONL log replays to the exact
+    ``snapshot()`` dict the live run reported."""
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+    from gelly_streaming_tpu.serving import ConnectedQuery, StreamServer
+    from gelly_streaming_tpu.serving.stats import ServingStats
+
+    rng = np.random.default_rng(9)
+    n_vertices = 64
+    src = rng.integers(0, n_vertices, 800).astype(np.int32)
+    dst = rng.integers(0, n_vertices, 800).astype(np.int32)
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(100))
+    agg = ConnectedComponents()
+    server = StreamServer(agg.servable(), stream, max_pending=4096)
+    sink = JsonlSink()
+    server.stats.attach_sink(sink)
+    server.start()
+    futures = [
+        server.submit(
+            ConnectedQuery(int(a), int(b))
+        )
+        for a, b in zip(
+            rng.integers(0, n_vertices, 300),
+            rng.integers(0, n_vertices, 300),
+        )
+    ]
+    for f in futures:
+        f.result(60)
+    server.join(60)
+    server.close()
+    live = server.stats.snapshot()  # after close: the log is complete
+    assert live["queries"]["ConnectedQuery"]["count"] == 300
+    replayed = ServingStats.from_events(sink.events).snapshot()
+    assert replayed == live
+
+
+# --------------------------------------------------------------------- #
+# Prefetch coupling metrics
+# --------------------------------------------------------------------- #
+def test_prefetch_records_coupling_metrics():
+    from gelly_streaming_tpu.core.pipeline import prefetch
+
+    obs.enable()
+
+    def slow_producer():
+        for i in range(5):
+            time.sleep(0.01)
+            yield i
+
+    assert list(prefetch(slow_producer(), depth=2)) == list(range(5))
+    reg = obs.get_registry()
+    # slow producer, fast consumer: the consumer starved measurably
+    assert reg.counter("pipeline.consumer_idle_s").value > 0.0
+
+    obs.reset()
+    obs.enable()
+
+    def fast_producer():
+        yield from range(5)
+
+    slow_out = []
+    for x in prefetch(fast_producer(), depth=1):
+        time.sleep(0.01)
+        slow_out.append(x)
+    assert slow_out == list(range(5))
+    assert obs.get_registry().counter(
+        "pipeline.producer_blocked_s"
+    ).value > 0.0
+
+
+# --------------------------------------------------------------------- #
+# Overhead guard (acceptance: enabled < 2% on the 1M-edge CPU identity
+# path; this guard uses a CI-noise-tolerant bound and the precise number
+# is recorded by bench.py's obs_overhead artifact entry)
+# --------------------------------------------------------------------- #
+def test_overhead_guard_1m_edge_cpu_run():
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    n_vertices, window = 1 << 16, 1 << 20
+    rng = np.random.default_rng(13)
+    src = rng.integers(0, n_vertices, window).astype(np.int32)
+    dst = rng.integers(0, n_vertices, window).astype(np.int32)
+
+    def one_pass():
+        stream = SimpleEdgeStream(
+            (src, dst), window=CountWindow(window),
+            vertex_dict=IdentityDict(n_vertices),
+        )
+        agg = ConnectedComponents()
+        t0 = time.perf_counter()
+        for _ in stream.aggregate(agg):
+            pass
+        agg.sync()
+        return time.perf_counter() - t0
+
+    def enabled_pass():
+        obs.enable()
+        sink = JsonlSink()
+        obs.attach_sink(sink)
+        try:
+            return one_pass(), len(sink)
+        finally:
+            obs.detach_sink(sink)
+            obs.disable()
+
+    one_pass()  # warm (jit compile)
+    enabled_pass()
+    dis, en = [], []
+    n_events = 0
+    for i in range(5):
+        # alternate order per rep: shared-host drift over the run must
+        # not systematically favor whichever mode runs second
+        if i % 2 == 0:
+            dis.append(one_pass())
+            t, ne = enabled_pass()
+        else:
+            t, ne = enabled_pass()
+            dis.append(one_pass())
+        en.append(t)
+        n_events = max(n_events, ne)
+    # best-of-N per mode: additive noise (preemption, frequency drift)
+    # only ever makes a pass SLOWER, so the minima are the comparable
+    # unhindered runtimes
+    d, e = min(dis), min(en)
+    overhead = (e - d) / d
+    # instrumentation DID run (events were recorded)...
+    assert n_events > 0
+    # ...and its cost is in the noise. Design bound is < 2%; the guard
+    # asserts < 10% so shared-CI timing jitter cannot flake the suite —
+    # a real per-window instrumentation regression (anything per-edge,
+    # or an accidental sync) lands far above this.
+    assert overhead < 0.10, (
+        f"enabled observability cost {overhead * 100:.1f}% "
+        f"(disabled {d:.4f}s, enabled {e:.4f}s)"
+    )
+
+
+def test_bench_serving_writes_replayable_obs_log(tmp_path):
+    """The ISSUE 3 acceptance end-to-end, at test scale: a --serving
+    bench run produces a JSONL event log that replays to the same
+    ``ServingStats.snapshot()`` dict the live run reported (the bench
+    itself asserts replay equality and would raise otherwise)."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench
+    from gelly_streaming_tpu.serving.stats import ServingStats
+
+    log_path = str(tmp_path / "serving_obs.jsonl")
+    out = bench.bench_serving(
+        n_vertices=1 << 10, window=1 << 12, n_win=3, burst=32,
+        pace_s=0.0, obs_log=log_path,
+    )
+    assert out["obs"]["replay_ok"] is True
+    assert out["obs"]["log"] == log_path
+    events = read_jsonl(log_path)
+    assert events[0]["kind"] == "meta"
+    replayed = ServingStats.from_events(events).snapshot()
+    assert replayed == out["serving"]["stats"]
+    # on a tiny stream the paced client can race ingest completion and
+    # answer zero queries; when any were answered the replayed count
+    # must match the live report exactly
+    if out["serving"]["queries_answered"]:
+        assert (
+            replayed["queries"]["ConnectedQuery"]["count"]
+            == out["serving"]["queries_answered"]
+        )
+
+
+def test_stream_profiler_mirrors_into_registry():
+    from gelly_streaming_tpu.utils.profiling import (
+        StreamProfiler,
+        WindowStats,
+    )
+
+    # explicit registry: mirrored regardless of the global enable flag
+    reg = MetricRegistry()
+    prof = StreamProfiler(registry=reg, name="ingest")
+    prof.record(WindowStats(0, 0.5, 100))
+    prof.record(WindowStats(1, 0.25, 50))
+    assert reg.histogram("ingest.window_seconds").count == 2
+    assert reg.counter("ingest.window_edges").value == 150.0
+    # legacy list surface is unchanged
+    assert prof.summary()["windows"] == 2
+    assert prof.summary()["edges"] == 150
+
+    # no registry + obs disabled: stays private, global registry clean
+    prof2 = StreamProfiler()
+    prof2.record(WindowStats(0, 0.1, 10))
+    assert obs.get_registry().find("profiler.window_seconds") == []

@@ -389,3 +389,47 @@ def test_cuf_fold_window_validates_before_mutating():
     # the carry keeps working after the rejected window
     uf.fold(np.asarray([2], np.int32), np.asarray([3], np.int32), 4)
     assert uf.flatten(4).tolist() == [0, 0, 0, 0]
+
+
+def test_pending_gauge_clears_after_settle():
+    """The serving.pending admission gauge must fall back to the real
+    backlog once a drained batch answers — an idle server reporting the
+    last burst as phantom backlog would mislead every reader of the
+    registry (and its replayed event log)."""
+    rng = np.random.default_rng(21)
+    src = rng.integers(0, 32, 200).astype(np.int32)
+    dst = rng.integers(0, 32, 200).astype(np.int32)
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(50))
+    agg = ConnectedComponents()
+    server = StreamServer(agg.servable(), stream, max_pending=1024)
+    server.start()
+    futs = [server.submit(ConnectedQuery(int(a), int(b)))
+            for a, b in zip(rng.integers(0, 32, 40),
+                            rng.integers(0, 32, 40))]
+    for f in futs:
+        f.result(60)
+    server.join(60)
+    server.close()
+    assert server.stats.registry.gauge("serving.pending").value == 0.0
+
+
+def test_cc_payload_copies_labels_when_carry_donated():
+    """A donating superbatch dispatch updates the carried summary's HBM
+    buffer in place; the servable must publish an OWNED copy, never an
+    alias the next group's dispatch would invalidate. (Donation only
+    happens on non-CPU backends, so the flag is forced here.)"""
+    rng = np.random.default_rng(22)
+    src = rng.integers(0, 32, 100).astype(np.int32)
+    dst = rng.integers(0, 32, 100).astype(np.int32)
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(50))
+    agg = ConnectedComponents(carry="dense")
+    for _ in stream.aggregate(agg):
+        pass
+    servable = agg.servable(vdict=stream.vertex_dict)
+    live = agg._summary["labels"]
+    agg._donated_carry = False
+    assert servable._payload(stream.vertex_dict)["labels"] is live
+    agg._donated_carry = True
+    published = servable._payload(stream.vertex_dict)["labels"]
+    assert published is not live
+    assert np.array_equal(np.asarray(published), np.asarray(live))

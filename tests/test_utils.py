@@ -182,3 +182,30 @@ def test_sorted_run_set_matches_naive():
         assert got.tolist() == [int(p) in ref for p in probe]
     assert len(s._runs) <= 12  # geometric merging keeps runs logarithmic
     assert s.to_array().tolist() == sorted(ref)
+
+
+def test_chip_spec_degrades_when_jax_devices_raises(monkeypatch):
+    """ISSUE 3 satellite: a dead backend must not crash the roofline
+    annotation path — chip_spec falls back to nominal CPU peaks, says
+    so in ``kind``, and does NOT cache the failure."""
+    import jax
+
+    from gelly_streaming_tpu.utils import profiling
+
+    profiling._chip_spec_cached.cache_clear()
+
+    def boom():
+        raise RuntimeError("tunnel down")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    spec = profiling.chip_spec()
+    assert "tunnel down" in spec["kind"]
+    assert spec["peak_bf16_flops"] == profiling._CHIP_PEAKS["cpu"][0]
+    assert spec["hbm_bytes_s"] == profiling._CHIP_PEAKS["cpu"][1]
+    # roofline_entry keeps working on the fallback spec
+    entry = profiling.roofline_entry(0.5, flops=1e9, model="test")
+    assert entry["mfu_pct"] > 0
+    # failure was not cached: a recovered backend gets its real spec
+    monkeypatch.undo()
+    profiling._chip_spec_cached.cache_clear()
+    assert "tunnel down" not in profiling.chip_spec()["kind"]
