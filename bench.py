@@ -1922,6 +1922,40 @@ def main():
             json.dump(info, f)
         return
 
+    if "--chaos" in sys.argv:
+        # ISSUE 4 acceptance: kill-at-every-window sweep over the CC
+        # superbatch pipeline. Every kill point must recover to
+        # oracle-identical emissions (full window coverage,
+        # value-identical replays); two points additionally corrupt the
+        # committed barrier head (flip-byte / truncate) and must fall
+        # back to the previous valid barrier with the rejection visible
+        # as resilience.ckpt_rejected in the worker's obs event log.
+        # CPU-pinned by construction (every worker subprocess pins
+        # jax_platforms=cpu): the harness measures recovery
+        # correctness + restore cost, not device throughput.
+        from gelly_streaming_tpu.resilience import chaos
+
+        doc = chaos.run_sweep(log=log)
+        doc["platform"] = "cpu-xla"
+        artifact = "BENCH_CHAOS_CPU.json"
+        with open(artifact, "w") as f:
+            json.dump(doc, f, indent=2)
+        log(f"chaos: ok={doc['ok']} kill_points={doc['kill_points']} "
+            f"rejected={doc['ckpt_rejected_total']} "
+            f"recovery_p50={doc['recovery_s']['p50']}s")
+        print(json.dumps({
+            "metric": "chaos_kill_sweep_recovery_p50_s",
+            "value": doc["recovery_s"]["p50"],
+            "unit": "seconds",
+            "kill_points": doc["kill_points"],
+            "restarts_total": doc["restarts_total"],
+            "ok": doc["ok"],
+            "artifact": artifact,
+        }))
+        if not doc["ok"]:
+            sys.exit(1)
+        return
+
     if "--latency-curve" in sys.argv:
         # window-size sweep 1k -> 16M, per-window vs superbatch, to a
         # keyed artifact (ISSUE 2 satellite: track the cliff per round)

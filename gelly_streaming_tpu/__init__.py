@@ -30,6 +30,7 @@ from .core.stream import GraphStream, SimpleEdgeStream, StreamContext
 from .core.snapshot import SnapshotStream
 from .core.sources import GeneratorSource, SocketEdgeSource
 from .aggregate.autockpt import AutoCheckpoint
+from .resilience import FaultPlan, RetryPolicy, Supervisor
 
 __version__ = "0.1.0"
 
@@ -54,4 +55,7 @@ __all__ = [
     "SocketEdgeSource",
     "GeneratorSource",
     "AutoCheckpoint",
+    "FaultPlan",
+    "RetryPolicy",
+    "Supervisor",
 ]

@@ -53,6 +53,8 @@ from typing import Any, Callable, Iterator, Optional
 import numpy as np
 
 from ..obs import trace as _trace
+from ..resilience import faults as _faults
+from ..resilience import integrity as _integrity
 
 
 class _SkipStream:
@@ -88,11 +90,30 @@ class AutoCheckpoint:
     ``make_stream(vdict)`` must build the stream over the SAME source,
     with ``vdict`` (restored; None on a fresh start) as its vertex
     dictionary when given.
+
+    INTEGRITY + ROTATION (resilience layer): each barrier commits as a
+    checksummed container (CRC32 over the pickled payload) via temp +
+    ``os.replace``, and the previous ``keep - 1`` barriers rotate to
+    ``path.1``, ``path.2``, ... (renames only — a kill mid-rotation
+    loses nothing). Loading scans head-first and falls back to the
+    NEWEST VALID barrier when the head is torn, truncated, or corrupt;
+    every rejected artifact is recorded as ``resilience.ckpt_rejected``
+    in the obs registry and warned. If every barrier is invalid the run
+    restarts from scratch (a full replay is still correct under the
+    at-least-once emission contract above) after recording each
+    rejection — recovery never silently loads damage.
     """
 
-    def __init__(self, path: str, every: int = 8):
+    def __init__(self, path: str, every: int = 8, keep: int = 2):
         self.path = path
         self.every = int(every)
+        self.keep = max(1, int(keep))
+        #: artifacts already rejected, keyed by (path, mtime_ns, size):
+        #: repeated _load scans (every windows_done() while all barriers
+        #: are invalid) must not re-inflate resilience.ckpt_rejected for
+        #: the SAME damaged bytes; an externally replaced file gets a
+        #: new key and re-validates
+        self._rejected_seen: set = set()
         self._cache = None  # loaded payload (invalidated on snapshot)
         #: vertex dictionary restored by the last :meth:`run` (None on a
         #: fresh start) — the public surface for consumers that need to
@@ -176,26 +197,114 @@ class AutoCheckpoint:
                 "vdict": self._vdict_payload(vdict),
             }
             with _trace.span("checkpoint.serialize"):
+                data = _integrity.wrap_checksummed(pickle.dumps(payload))
                 tmp = self.path + ".tmp"
                 with open(tmp, "wb") as f:
-                    pickle.dump(payload, f)
+                    f.write(data)
+                self._rotate()
                 os.replace(tmp, self.path)  # atomic barrier commit
         # invalidate, do NOT cache: payload["state"] aliases LIVE workload
         # arrays (e.g. the degree shadow mutated by later windows); only
         # the pickled file is a true point-in-time snapshot
         self._cache = None
+        if _faults.active():  # chaos hook: corrupt-the-barrier-just-written
+            _faults.fire(
+                "checkpoint.committed", index=windows_done, path=self.path
+            )
+
+    def _rotate(self) -> None:
+        """Shift committed barriers one slot down (``path`` -> ``path.1``
+        -> ... -> dropped past ``keep - 1``) ahead of a new head commit.
+        Renames only: a kill between any two steps leaves every barrier
+        intact under some scanned name. A head this instance already
+        REJECTED is unlinked instead of rotated — shifting corrupt
+        bytes over ``path.1`` would overwrite the good fallback those
+        bytes forced us onto (fatal at ``keep=2`` if the process then
+        dies before the new head commits)."""
+        if self.keep <= 1:
+            return
+        try:
+            st = os.stat(self.path)
+            if (self.path, st.st_mtime_ns, st.st_size) in self._rejected_seen:
+                os.remove(self.path)
+        except OSError:
+            pass
+        for i in range(self.keep - 1, 0, -1):
+            src = self.path if i == 1 else f"{self.path}.{i - 1}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i}")
+
+    #: keys a valid barrier payload must carry (anything less is a torn
+    #: or foreign file, not a barrier)
+    _PAYLOAD_KEYS = frozenset(("windows_done", "kind", "state", "vdict"))
 
     def _load(self) -> Optional[dict]:
-        """Read (and cache) the barrier payload: the label table + vertex
-        dict can be multi-MB, so repeated ``windows_done()`` calls must
-        not re-unpickle the file each time."""
+        """Read (and cache) the NEWEST VALID barrier payload: the label
+        table + vertex dict can be multi-MB, so repeated
+        ``windows_done()`` calls must not re-unpickle the file each
+        time. Scans head-first, then the rotation slots; invalid
+        artifacts are rejected (recorded + warned) and the scan falls
+        through to the previous barrier."""
         if self._cache is not None:
             return self._cache
-        if not os.path.exists(self.path):
+        for cand in self._candidates():
+            payload = self._read_barrier(cand)
+            if payload is not None:
+                self._cache = payload
+                return payload
+        return None
+
+    def _candidates(self) -> list:
+        """Barrier files newest-first: the head plus every rotation
+        slot on disk. The scan TOLERATES GAPS (a kill between two
+        rotation renames leaves e.g. ``path`` and ``path.2`` with no
+        ``path.1``) and runs past ``self.keep`` with slack, so a
+        reader configured with a smaller ``keep`` than the writer's
+        still sees the deeper history."""
+        out = [self.path]
+        for i in range(1, max(self.keep + 1, 9)):
+            p = f"{self.path}.{i}"
+            if os.path.exists(p):
+                out.append(p)
+        return out
+
+    def _read_barrier(self, path: str) -> Optional[dict]:
+        """One candidate: unwrap + checksum + unpickle + shape-check.
+        Returns None (after recording the rejection ONCE per damaged
+        file version) on any damage — the caller falls back to the
+        next-newest barrier."""
+        try:
+            st = os.stat(path)
+        except FileNotFoundError:
             return None
-        with open(self.path, "rb") as f:
-            self._cache = pickle.load(f)
-        return self._cache
+        except OSError as e:
+            # EACCES/EIO is damage the operator must see, not a gap in
+            # the rotation — record it (once per error shape) before
+            # falling back
+            key = (path, "stat", type(e).__name__)
+            if key not in self._rejected_seen:
+                self._rejected_seen.add(key)
+                _integrity.record_rejection(path, f"unstatable: {e!r}")
+            return None
+        key = (path, st.st_mtime_ns, st.st_size)
+        if key in self._rejected_seen:
+            return None
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            payload = pickle.loads(
+                _integrity.unwrap_checksummed(data, origin=path)
+            )
+            if (
+                not isinstance(payload, dict)
+                or not self._PAYLOAD_KEYS <= payload.keys()
+            ):
+                raise ValueError("barrier payload missing required keys")
+            return payload
+        except Exception as e:
+            self._rejected_seen.add(key)
+            _integrity.record_rejection(path, repr(e))
+            return None
 
     def _restore_work(self, work, payload: dict) -> None:
         if payload["kind"] == "workload":

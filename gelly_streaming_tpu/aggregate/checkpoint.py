@@ -24,6 +24,8 @@ import numpy as np
 
 from ..core.vertexdict import VertexDict
 from ..obs import trace as _trace
+from ..resilience import integrity as _integrity
+from ..resilience.errors import CheckpointCorrupt
 
 
 def _keypaths(tree: Any) -> list:
@@ -36,8 +38,49 @@ def _keypaths(tree: Any) -> list:
     return [jax.tree_util.keystr(path) for path, _ in flat]
 
 
+def _generation_files(path: str) -> list:
+    """Every on-disk generation-named array file for ``path``."""
+    import glob as _glob
+
+    return sorted(_glob.glob(_glob.escape(path) + ".g*.npz"))
+
+
+def _next_generation(path: str) -> int:
+    """One past the highest array-file generation on disk for ``path``
+    (crash leftovers included, so a new save never overwrites a file
+    any sidecar — committed or torn — might reference)."""
+    import re
+
+    best = -1
+    for p in _generation_files(path):
+        m = re.search(r"\.g(\d+)\.npz$", p)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best + 1
+
+
+def _npz_path(path: str, info: dict) -> str:
+    """The array file a sidecar references: generation-named for
+    post-resilience checkpoints, the legacy fixed ``path.npz`` before."""
+    name = info.get("npz")
+    if name is None:
+        return path + ".npz"
+    return os.path.join(os.path.dirname(path) or ".", name)
+
+
 def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
-    """Write a pytree of arrays to ``path.npz`` + ``path.json``."""
+    """Write a pytree of arrays to ``path.g<N>.npz`` + ``path.json``.
+
+    ATOMIC COMMIT: the arrays land under a GENERATION-UNIQUE name (never
+    overwriting the file the committed sidecar references), then the
+    JSON sidecar — naming that file and carrying a CRC32 over the leaf
+    content — commits via temp + ``os.replace``. A kill at any byte
+    leaves the previous pair fully intact (at worst plus one orphaned
+    new-generation array file, swept by the next successful save);
+    :func:`load_pytree` validates the named file against the sidecar's
+    leaf count and checksum, so a torn or bit-rotted checkpoint never
+    loads.
+    """
     leaves, treedef = jax.tree.flatten(tree)
     # barrier_wait: np.asarray blocks on any in-flight device work that
     # produces these leaves — the snapshot's implicit device barrier
@@ -50,10 +93,36 @@ def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
         "checkpoint.serialize",
         {"leaves": len(leaves)} if _trace.on() else None,
     ):
-        np.savez(path + ".npz", **arrays)
-        with open(path + ".json", "w") as f:
-            json.dump({"treedef": str(treedef), "keypaths": _keypaths(tree),
-                       "n_leaves": len(leaves), "meta": meta or {}}, f)
+        gen = _next_generation(path)
+        npz = f"{path}.g{gen}.npz"
+        npz_tmp = npz + ".tmp"
+        # savez appends .npz to names without it; write with the real
+        # suffix inside the temp name, then rename
+        with open(npz_tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(npz_tmp, npz)
+        # content checksum over the in-memory leaves (leaf order), NOT a
+        # re-read of the file just written — the barrier must not pay a
+        # second pass over a potentially multi-GB .npz
+        crc = _integrity.arrays_crc32(
+            arrays[f"leaf_{i}"] for i in range(len(leaves))
+        )
+        doc = {"treedef": str(treedef), "keypaths": _keypaths(tree),
+               "n_leaves": len(leaves), "meta": meta or {},
+               "npz": os.path.basename(npz), "leaves_crc32": crc}
+        json_tmp = path + ".json.tmp"
+        with open(json_tmp, "w") as f:
+            json.dump(doc, f)
+        _integrity.replace_atomic(json_tmp, path + ".json")  # commit
+        # sweep superseded generations (and the legacy fixed name) only
+        # AFTER the new sidecar committed; best-effort — leftovers are
+        # orphans, never referenced
+        for stale in _generation_files(path) + [path + ".npz"]:
+            if stale != npz and os.path.exists(stale):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
 
 
 def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:
@@ -65,11 +134,61 @@ def load_pytree(path: str, like: Any) -> Tuple[Any, dict]:
     time, not corrupt state silently. Structure is compared via leaf key
     paths (stable across JAX versions), not ``str(treedef)`` (which is not);
     for pre-keypath checkpoints the treedef string downgrades to a warning.
+
+    INTEGRITY: before any structural comparison the ``.npz`` is checked
+    against its sidecar — stored leaf count vs. the arrays actually
+    present (a torn or swapped ``.npz`` fails HERE with a clear
+    :class:`~gelly_streaming_tpu.resilience.errors.CheckpointCorrupt`,
+    not an opaque numpy KeyError), and content checksum when the
+    sidecar carries one (post-resilience checkpoints always do). Every
+    rejection is recorded as ``resilience.ckpt_rejected``.
     """
     with open(path + ".json") as f:
         info = json.load(f)
-    data = np.load(path + ".npz")
-    leaves = [data[f"leaf_{i}"] for i in range(info["n_leaves"])]
+    npz = _npz_path(path, info)
+    try:
+        data = np.load(npz)
+        stored = {k for k in data.files if k.startswith("leaf_")}
+    except Exception as e:
+        _integrity.record_rejection(npz, f"unreadable: {e!r}")
+        raise CheckpointCorrupt(
+            f"checkpoint array file {npz} is unreadable ({e!r}); the "
+            "sidecar committed but the array file is torn, corrupt, or "
+            "missing"
+        ) from e
+    if len(stored) != info["n_leaves"]:
+        _integrity.record_rejection(
+            npz,
+            f"{len(stored)} leaf arrays vs sidecar n_leaves="
+            f"{info['n_leaves']}",
+        )
+        raise CheckpointCorrupt(
+            f"checkpoint array file {npz} holds {len(stored)} leaf "
+            f"arrays but its sidecar committed n_leaves="
+            f"{info['n_leaves']}; the pair is torn (mismatched save "
+            "generations)"
+        )
+    try:
+        leaves = [data[f"leaf_{i}"] for i in range(info["n_leaves"])]
+    except Exception as e:
+        _integrity.record_rejection(npz, f"torn archive: {e!r}")
+        raise CheckpointCorrupt(
+            f"checkpoint array file {npz} failed to decompress its "
+            f"leaf arrays ({e!r}); the file is torn or corrupt"
+        ) from e
+    want_crc = info.get("leaves_crc32")
+    if want_crc is not None:
+        got_crc = _integrity.arrays_crc32(leaves)
+        if got_crc != want_crc:
+            _integrity.record_rejection(
+                npz,
+                f"content crc32 {got_crc:#x} != sidecar {want_crc:#x}",
+            )
+            raise CheckpointCorrupt(
+                f"checkpoint array file {npz} leaf content checksum "
+                f"{got_crc:#x} does not match its sidecar's "
+                f"{want_crc:#x} (torn pair or bit rot)"
+            )
     like_leaves, treedef = jax.tree.flatten(like)
     if treedef.num_leaves != len(leaves):
         raise ValueError(

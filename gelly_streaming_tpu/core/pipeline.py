@@ -22,10 +22,13 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Any, Iterator, TypeVar
+import warnings
+from typing import Any, Iterator, Optional, TypeVar
 
 from ..obs import trace as _trace
 from ..obs.registry import get_registry
+from ..resilience import faults as _faults
+from ..resilience.errors import StallError
 
 T = TypeVar("T")
 
@@ -49,13 +52,31 @@ def superbatch_prefetch_depth(superbatch: int, base: int = 2) -> int:
 
 
 def prefetch(iterator: Iterator[T], depth: int = 2,
-             name: str = "pipeline") -> Iterator[T]:
+             name: str = "pipeline", *,
+             stall_timeout_s: Optional[float] = None,
+             join_timeout_s: float = 10.0) -> Iterator[T]:
     """Iterate ``iterator`` on a background thread, ``depth`` items ahead.
 
     If the consumer abandons the generator early (break / exception /
     garbage collection), the producer thread notices via a stop flag and
     exits instead of blocking forever on the bounded queue; the source
-    iterator is closed so file handles are released.
+    iterator is closed so file handles are released. If the producer
+    does NOT exit within ``join_timeout_s`` (wedged in a device op or a
+    blocking read), the leak is no longer silent: a warning fires and
+    ``<name>.producer_leaked`` increments in the obs registry.
+
+    ``stall_timeout_s`` arms a consumer-side stall watchdog: when the
+    queue stays empty that long, a
+    :class:`~gelly_streaming_tpu.resilience.errors.StallError` is
+    raised (``<name>.stalls`` counts it) so a supervisor can restart
+    the pipeline instead of waiting forever. The timeout is a BUDGET
+    on inter-item gaps, whatever their cause — the consumer cannot
+    distinguish a wedged producer from one inside a long legitimate
+    stage, so set it above the worst-case honest gap (a mid-stream
+    recompile, a slow corpus read). The FIRST item is exempt: its gap
+    legitimately includes jit compilation of the whole window step.
+    Off (None) by default: bounded sources legitimately pause (a
+    socket between bursts).
 
     With observability on (``obs.enable()``), the coupling itself is
     measured into the global registry — the signals the ROADMAP auto-K
@@ -120,28 +141,62 @@ def prefetch(iterator: Iterator[T], depth: int = 2,
                         pass
             _put(_SENTINEL)
 
+    def _blocking_get():
+        """One queue pull, stall-watched when armed: an empty queue
+        past the ``stall_timeout_s`` budget fails loudly rather than
+        waiting forever. The first item is exempt (its gap includes
+        jit compile); a dead producer always leaves the sentinel, so
+        a timeout means no progress, not a clean end."""
+        if stall_timeout_s is None or n == 0:
+            return q.get()
+        try:
+            return q.get(timeout=stall_timeout_s)
+        except queue.Empty:
+            get_registry().counter(name + ".stalls").inc()
+            raise StallError(
+                f"{name}: no item for {stall_timeout_s}s with the "
+                "producer thread "
+                + ("alive" if t.is_alive() else "gone")
+            ) from None
+
     t = threading.Thread(target=produce, daemon=True)
     t.start()
+    n = 0
     try:
         while True:
             if _trace.on():
                 depth_g, _pw, cw = _instruments()
                 depth_g.set(q.qsize())
                 t0 = time.perf_counter()
-                item = q.get()
+                item = _blocking_get()
                 dt = time.perf_counter() - t0
                 if dt > 1e-4:  # real starvation, not get cost
                     cw.inc(dt)
             else:
-                item = q.get()
+                item = _blocking_get()
             if item is _SENTINEL:
                 if error:
                     raise error[0]
                 return
+            if _faults.active():  # chaos hook: kill/stall at item n
+                _faults.fire("pipeline.item", index=n)
+            n += 1
             yield item
     finally:
         stop.set()
         # wait for the producer to leave its current item: a daemon thread
         # killed at interpreter teardown MID-DEVICE-OP aborts the process
         # (libc terminate), so hand-off must complete before shutdown
-        t.join(timeout=10.0)
+        t.join(timeout=join_timeout_s)
+        if t.is_alive():
+            # the silent leak (round-4 shape): a producer that never
+            # honored the stop flag is still holding its iterator (and
+            # possibly a device); surface it instead of quietly leaking
+            get_registry().counter(name + ".producer_leaked").inc()
+            warnings.warn(
+                f"{name}: prefetch producer thread did not exit within "
+                f"{join_timeout_s}s of consumer shutdown; thread (and "
+                "its source iterator) leaked",
+                RuntimeWarning,
+                stacklevel=2,
+            )

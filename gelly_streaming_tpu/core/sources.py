@@ -16,24 +16,67 @@ Both yield ``None`` ticks while idle so a
 :class:`~gelly_streaming_tpu.core.window.ProcessingTimeWindow` can close
 an open window on schedule even when no records arrive — the windower's
 records-driven analog of Flink's processing-time timers.
+
+RESILIENCE (ISSUE 4): a live socket must survive the network. Connection
+errors — refused connects, resets mid-stream, injected disconnects —
+trigger RECONNECT with bounded exponential backoff (``reconnect``
+attempts, ``source.reconnects`` counted in the obs registry) instead of
+killing the pipeline; only an exhausted budget raises
+:class:`~gelly_streaming_tpu.resilience.errors.TransientSourceError`
+(which a :class:`~gelly_streaming_tpu.resilience.Supervisor` classifies
+as restartable). A CLEAN peer close still ends iteration — that is the
+bounded-stream test contract, not a failure. Malformed lines are counted
+(``source.malformed_lines``) rather than silently discarded, and both
+sources honor an installed
+:class:`~gelly_streaming_tpu.resilience.FaultPlan` (record
+drop/duplicate/reorder, disconnect-at-record-n) for deterministic chaos
+testing.
 """
 
 from __future__ import annotations
 
 import socket
+import time
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
+
+from ..obs.registry import get_registry
+from ..resilience import faults as _faults
+from ..resilience.errors import TransientSourceError
+from ..resilience.retry import exp_backoff
+
+
+def _perturbed(records: Iterator) -> Iterator:
+    """Route a record iterator through the installed fault plan's
+    drop/duplicate/reorder schedule (no-op — and no wrapper generator —
+    when no plan with record perturbations is installed)."""
+    plan = _faults.plan()
+    if plan is not None and plan.perturbs_records():
+        return plan.perturb_records(records)
+    return records
 
 
 class SocketEdgeSource:
     """Unbounded edge records over TCP (``env.socketTextStream`` parity).
 
     Lines are whitespace- or tab-separated ``src dst [val]``; malformed
-    lines and ``#`` comments are skipped, like the file parser. Iteration
-    ends when the peer closes the connection (a live deployment would
-    simply never close). ``tick_s``: receive timeout after which a
-    ``None`` time tick is yielded instead of a record.
+    lines are counted into the obs registry (``source.malformed_lines``)
+    and skipped, ``#`` comments and blank lines are skipped silently,
+    like the file parser. Iteration ends when the peer closes the
+    connection CLEANLY (a live deployment would simply never close).
+    ``tick_s``: receive timeout after which a ``None`` time tick is
+    yielded instead of a record.
+
+    Connection ERRORS (refused, reset, timeout at connect) reconnect
+    with bounded exponential backoff: up to ``reconnect`` consecutive
+    failed attempts, each waiting ``reconnect_base_s * 2**attempt``
+    capped at ``reconnect_max_s`` — waited out in ``tick_s`` slices
+    with a ``None`` tick yielded per slice, so processing-time windows
+    keep closing on schedule all the way through an outage. The budget
+    resets whenever data arrives; exhausting it raises
+    :class:`TransientSourceError`. ``reconnect=0`` restores the
+    fail-fast behavior.
     """
 
     def __init__(
@@ -42,38 +85,99 @@ class SocketEdgeSource:
         port: int,
         tick_s: float = 0.05,
         weighted: bool = False,
+        reconnect: int = 5,
+        reconnect_base_s: float = 0.05,
+        reconnect_max_s: float = 2.0,
     ):
         self.host = host
         self.port = port
         self.tick_s = tick_s
         self.weighted = weighted
+        self.reconnect = int(reconnect)
+        self.reconnect_base_s = float(reconnect_base_s)
+        self.reconnect_max_s = float(reconnect_max_s)
+        self._malformed = None  # lazy counter (registry may be swapped)
 
     def __iter__(self) -> Iterator[Optional[Tuple]]:
-        sock = socket.create_connection((self.host, self.port))
-        sock.settimeout(self.tick_s)
-        buf = b""
-        try:
-            while True:
-                try:
-                    data = sock.recv(1 << 16)
-                except socket.timeout:
-                    yield None  # idle tick: lets time windows close
-                    continue
-                if not data:  # peer closed: the stream's (test-only) end
-                    break
-                buf += data
-                if b"\n" not in buf:
-                    continue
-                lines, buf = buf.rsplit(b"\n", 1)
-                for line in lines.split(b"\n"):
-                    rec = self._parse(line)
-                    if rec is not None:
-                        yield rec
-            rec = self._parse(buf)
-            if rec is not None:
-                yield rec
-        finally:
-            sock.close()
+        return _perturbed(self._records())
+
+    # ------------------------------------------------------------------ #
+    def _records(self) -> Iterator[Optional[Tuple]]:
+        attempts = 0  # consecutive failures since the last received data
+        nrec = 0      # record ordinal for the injection hook
+        while True:
+            try:
+                sock = socket.create_connection((self.host, self.port))
+            except OSError as e:
+                attempts += 1
+                yield from self._backoff_ticks(attempts, e)
+                continue
+            sock.settimeout(self.tick_s)
+            buf = b""
+            clean_close = False
+            try:
+                while True:
+                    try:
+                        data = sock.recv(1 << 16)
+                    except socket.timeout:
+                        yield None  # idle tick: lets time windows close
+                        continue
+                    if not data:  # peer closed CLEANLY: the stream's end
+                        clean_close = True
+                        break
+                    attempts = 0  # data flowed: reconnect budget refills
+                    buf += data
+                    if b"\n" not in buf:
+                        continue
+                    lines, buf = buf.rsplit(b"\n", 1)
+                    for line in lines.split(b"\n"):
+                        rec = self._parse(line)
+                        if rec is not None:
+                            if _faults.active():
+                                _faults.fire("source.record", index=nrec)
+                            nrec += 1
+                            yield rec
+            except OSError as e:
+                # reset / injected disconnect mid-stream: reconnect.
+                # Parsed-but-unyielded tail records of the dead
+                # connection are dropped with it — the peer re-serves
+                # (at-least-once), exactly Flink's source-replay shape.
+                attempts += 1
+                yield from self._backoff_ticks(attempts, e)
+                continue
+            finally:
+                sock.close()
+            if clean_close:
+                rec = self._parse(buf)
+                if rec is not None:
+                    if _faults.active():
+                        _faults.fire("source.record", index=nrec)
+                    yield rec
+                return
+
+    def _backoff_ticks(self, attempts: int, err: OSError):
+        """Record one connection failure, then wait out the
+        bounded-exponential delay in ``tick_s`` slices, yielding a
+        ``None`` tick per slice — processing-time windows keep closing
+        on schedule THROUGH the outage, not only between backoffs.
+        Raises :class:`TransientSourceError` past the budget
+        (``reconnect=0`` fails fast, the legacy behavior)."""
+        get_registry().counter("source.reconnects").inc()
+        if attempts > self.reconnect:
+            raise TransientSourceError(
+                f"socket source {self.host}:{self.port} gave up after "
+                f"{attempts - 1} reconnect attempts"
+            ) from err
+        delay = exp_backoff(
+            attempts - 1, self.reconnect_base_s, self.reconnect_max_s
+        )
+        while True:
+            yield None
+            if delay <= 0:
+                return
+            step = min(max(self.tick_s, 1e-3), delay)
+            time.sleep(step)
+            delay -= step
 
     def _parse(self, line: bytes) -> Optional[Tuple]:
         line = line.strip()
@@ -81,18 +185,31 @@ class SocketEdgeSource:
             return None
         parts = line.split()
         if len(parts) < 2:
+            self._count_malformed()
             return None
         try:
             s, d = int(parts[0]), int(parts[1])
             v = float(parts[2]) if self.weighted and len(parts) > 2 else 0.0
         except ValueError:
+            self._count_malformed()
             return None
         return (s, d, v)
+
+    def _count_malformed(self) -> None:
+        # a malformed line is DATA the operator should know about, not
+        # noise (satellite: no silent discards); resolved lazily so a
+        # source built before obs/test registry swaps still reports
+        if self._malformed is None:
+            self._malformed = get_registry().counter(
+                "source.malformed_lines"
+            )
+        self._malformed.inc()
 
 
 class GeneratorSource:
     """Unbounded synthetic edge stream: R-MAT chunks, forever (or for
-    ``limit`` edges when given — tests need an end)."""
+    ``limit`` edges when given — tests need an end). Honors an installed
+    fault plan's record perturbations like the socket source."""
 
     def __init__(
         self,
@@ -107,6 +224,9 @@ class GeneratorSource:
         self.limit = limit
 
     def __iter__(self) -> Iterator[Tuple]:
+        return _perturbed(self._records())
+
+    def _records(self) -> Iterator[Tuple]:
         from ..datasets import rmat_edges
 
         produced = 0

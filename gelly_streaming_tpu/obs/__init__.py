@@ -39,6 +39,16 @@ dispatch (+ ``engine.donated_dispatches`` counter),
 ``pipeline.queue_depth`` / ``producer_blocked_s`` / ``consumer_idle_s``
 prefetch coupling, ``checkpoint.barrier`` / ``barrier_wait`` /
 ``serialize``, and the ``serving.*`` admission/batch/drain surface.
+
+Resilience events (PR 4) are ALWAYS on — a restart or a rejected
+checkpoint is operational truth, not optional telemetry:
+``resilience.restarts{kind}`` / ``recovery_seconds`` /
+``deduped_windows`` / ``backoff_s`` / ``poison_windows`` /
+``ckpt_rejected`` / ``fault_injected{site}``,
+``pipeline.producer_leaked`` / ``pipeline.stalls``,
+``source.reconnects`` / ``source.malformed_lines``, and
+``serving.shed{cls}`` / ``retries`` / ``deadline_expired`` /
+``worker_stalls`` (see ``gelly_streaming_tpu/resilience/__init__.py``).
 """
 
 from .registry import (

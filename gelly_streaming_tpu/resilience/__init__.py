@@ -1,0 +1,67 @@
+"""Resilience layer: checkpoint integrity, supervised recovery, chaos.
+
+The reference inherits fault tolerance wholesale from Flink — barrier
+snapshots (Carbone et al., lightweight asynchronous snapshots) plus a
+restart strategy — and never has to prove it; the runtime does. This
+repo's checkpoint surface (``aggregate/checkpoint.py`` +
+``AutoCheckpoint``) reproduced the snapshots but, before this layer,
+nothing guaranteed they SURVIVE real failures: a kill between the two
+files of a pytree checkpoint left a torn pair, a socket source died
+permanently on its first disconnect, and an ``Overloaded`` serving
+rejection had no retry or shed story. This package closes that gap in
+three parts, in the MillWheel spirit that recovery is a tested property:
+
+- :mod:`integrity` — content checksums + atomic multi-file commit for
+  checkpoint artifacts; every rejected artifact is visible as
+  ``resilience.ckpt_rejected`` in the obs registry.
+- :mod:`supervisor` (+ :mod:`retry`, :mod:`errors`) — restart a
+  checkpointed pipeline from the newest valid barrier under bounded
+  exponential backoff, classify failures (transient / poison window /
+  fatal), and deduplicate replayed emissions; the bounded-backoff rule
+  is shared with socket reconnect and the serving tier's client
+  ``RetryPolicy``.
+- :mod:`faults` + :mod:`chaos` — a seeded deterministic
+  :class:`FaultPlan` behind test-only hook points (pipeline, sources,
+  checkpoints, serving worker) and the kill-at-every-window sweep
+  (``bench.py --chaos``) that asserts oracle-identical recovery.
+
+Resilience telemetry rides the PR-3 obs registry:
+``resilience.restarts{kind=...}``, ``resilience.ckpt_rejected``,
+``resilience.recovery_seconds``, ``resilience.deduped_windows``,
+``resilience.fault_injected{site=...}``, ``pipeline.producer_leaked``,
+``pipeline.stalls``, ``source.malformed_lines``, ``source.reconnects``,
+``serving.shed{cls=...}``, ``serving.retries``,
+``serving.deadline_expired``, ``serving.worker_stalls``.
+"""
+
+from . import faults
+from .errors import (
+    CheckpointCorrupt,
+    DeadlineExceeded,
+    InjectedFault,
+    PoisonWindowError,
+    RestartBudgetExceeded,
+    SimulatedCrash,
+    StallError,
+    TransientSourceError,
+)
+from .faults import FaultPlan
+from .retry import RetryPolicy, exp_backoff, jittered
+from .supervisor import Supervisor
+
+__all__ = [
+    "CheckpointCorrupt",
+    "DeadlineExceeded",
+    "FaultPlan",
+    "InjectedFault",
+    "PoisonWindowError",
+    "RestartBudgetExceeded",
+    "RetryPolicy",
+    "SimulatedCrash",
+    "StallError",
+    "Supervisor",
+    "TransientSourceError",
+    "exp_backoff",
+    "faults",
+    "jittered",
+]

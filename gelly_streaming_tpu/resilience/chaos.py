@@ -1,0 +1,374 @@
+"""Deterministic chaos harness: kill the CC pipeline at every window.
+
+The recovery guarantee this repo claims — a killed process restarts
+from the newest valid barrier and finishes with output value-identical
+to an uninterrupted run — is only worth stating if something kills the
+process at EVERY window and checks. This module is that something:
+
+- :func:`run_sweep` runs an ORACLE pass of the superbatched CC pipeline
+  (fixed seeded corpus, per-window emission digests), then for each
+  kill point ``k`` launches a fresh worker process that dies hard
+  (``os._exit``) after ``k`` windows, optionally corrupts the committed
+  barrier head (flip-byte / truncate — the torn-checkpoint fault), and
+  relaunches to completion. Every digest line any worker ever wrote
+  must equal the oracle digest at its window ordinal, and together they
+  must cover every window — which proves both recovery AND that
+  replayed re-emissions are value-identical at every kill point.
+- Workers append one flushed JSONL digest line per window BEFORE the
+  kill hook fires, so the pre-crash evidence survives ``os._exit``; the
+  obs registry's event log (written on clean exits) records every
+  ``resilience.ckpt_rejected`` so torn artifacts are visibly rejected,
+  never silently loaded.
+
+Everything is seeded and index-driven (:mod:`~gelly_streaming_tpu.resilience.faults`),
+so a failing kill point reproduces exactly. ``bench.py --chaos`` wraps
+:func:`run_sweep` into the committed ``BENCH_CHAOS_CPU.json`` artifact
+(recovery-time distribution + restart counts); the test suite runs a
+reduced sweep (``-m chaos_full``) and the in-process fast subset
+(``-m chaos_fast``).
+
+Worker entry point (subprocess only)::
+
+    python -m gelly_streaming_tpu.resilience.chaos worker '<json cfg>'
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+#: worker exit code for an injected kill (distinct from real failures)
+KILL_RC = 17
+
+#: repo root (the directory holding ``gelly_streaming_tpu``), for
+#: subprocess sys.path injection — workers must import this package
+#: regardless of the driver's cwd
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: default sweep geometry: small windows + superbatch=2 so barriers,
+#: group boundaries, and kill points interleave in every phase
+DEFAULTS = dict(
+    windows=24, window_edges=256, superbatch=2, every=2, seed=1234
+)
+
+
+def corpus(seed: int, n_edges: int) -> list:
+    """Deterministic edge list with SPARSE raw ids (vertex-dict replay
+    must reproduce exact compact-id assignment across restarts — same
+    discipline as ``tests/_ckpt_worker.py``)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    pairs = rng.integers(0, 600, size=(n_edges, 2))
+    return [(int(a) * 7 + 3, int(b) * 7 + 3, 0.0) for a, b in pairs]
+
+
+def digest(emission) -> str:
+    """Stable fingerprint of one per-window emission (the Components
+    string form is canonical: sorted roots, sorted members)."""
+    import hashlib
+
+    return hashlib.sha1(str(emission).encode()).hexdigest()[:16]
+
+
+# --------------------------------------------------------------------- #
+# Worker (runs in a subprocess; dies hard at the kill point)
+# --------------------------------------------------------------------- #
+def worker_main(cfg: dict) -> None:
+    """Drive the supervised CC pipeline once. ``cfg`` keys: ``ckpt``,
+    ``digests``, ``events``, ``meta`` (paths), ``kill_after`` (windows
+    consumed before ``os._exit(KILL_RC)``; -1 = run to completion),
+    plus the sweep geometry (``windows``/``window_edges``/``superbatch``
+    /``every``/``seed``)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ..aggregate.autockpt import AutoCheckpoint
+    from ..core.stream import SimpleEdgeStream
+    from ..core.window import CountWindow
+    from ..library import ConnectedComponents
+    from ..obs.export import JsonlSink
+    from ..obs.registry import get_registry
+    from . import faults
+    from .supervisor import Supervisor
+
+    raw = corpus(cfg["seed"], cfg["windows"] * cfg["window_edges"])
+    sink = JsonlSink(cfg["events"])
+    get_registry().add_sink(sink)
+
+    def make_stream(vd):
+        return SimpleEdgeStream(
+            raw, window=CountWindow(cfg["window_edges"]), vertex_dict=vd
+        )
+
+    def make_work():
+        return ConnectedComponents(superbatch=cfg["superbatch"])
+
+    ac = AutoCheckpoint(cfg["ckpt"], every=cfg["every"], keep=3)
+    resumed_from = ac.windows_done()
+    sup = Supervisor(
+        ac, backoff_base_s=0.0, jitter=0.0, seed=cfg["seed"]
+    )
+    kill_after = int(cfg.get("kill_after", -1))
+    if kill_after >= 0:
+        faults.install(faults.FaultPlan(
+            seed=cfg["seed"],
+            kill_at_window=kill_after - 1,
+            kill_exit_code=KILL_RC,
+        ))
+    t0 = time.perf_counter()
+    first = None
+    yielded = 0
+    with open(cfg["digests"], "a") as out:
+        ordinal = resumed_from
+        for comps in sup.run(make_stream, make_work):
+            if first is None:
+                first = time.perf_counter() - t0
+            out.write(json.dumps({"o": ordinal, "d": digest(comps)}) + "\n")
+            # flush BEFORE the kill hook: os._exit drops python-level
+            # buffers, and the pre-crash digest lines are the evidence
+            out.flush()
+            if faults.active():
+                faults.fire("chaos.window", index=ordinal)
+            ordinal += 1
+            yielded += 1
+    with open(cfg["meta"], "w") as f:
+        json.dump({
+            "resumed_from": resumed_from,
+            "restarts": sup.restarts,
+            "yielded": yielded,
+            "first_emission_s": first,
+            "total_s": time.perf_counter() - t0,
+        }, f)
+    sink.write()
+    get_registry().remove_sink(sink)
+    faults.clear()
+
+
+def _spawn_worker(cfg: dict, timeout: float = 600.0):
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    code = (
+        "import sys, json; "
+        f"sys.path.insert(0, {REPO_ROOT!r}); "
+        "from gelly_streaming_tpu.resilience import chaos; "
+        "chaos.worker_main(json.loads(sys.argv[1]))"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code, json.dumps(cfg)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+# --------------------------------------------------------------------- #
+# Driver
+# --------------------------------------------------------------------- #
+def _read_jsonl(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _count_rejections(events_path: str) -> int:
+    return sum(
+        1 for e in _read_jsonl(events_path)
+        if e.get("name") == "resilience.ckpt_rejected"
+    )
+
+
+def run_sweep(
+    *,
+    windows: int = DEFAULTS["windows"],
+    window_edges: int = DEFAULTS["window_edges"],
+    superbatch: int = DEFAULTS["superbatch"],
+    every: int = DEFAULTS["every"],
+    seed: int = DEFAULTS["seed"],
+    corrupt: bool = True,
+    workdir: Optional[str] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Kill-at-every-window sweep; returns the artifact document.
+
+    For every ``k`` in ``1..windows``: run a worker that dies after
+    ``k`` windows, then relaunch to completion, asserting the combined
+    digest stream is oracle-identical and covers every window. With
+    ``corrupt=True`` two kill points additionally flip-byte / truncate
+    the committed barrier head between kill and resume, proving the
+    fallback-to-previous-barrier path end to end (visible as
+    ``ckpt_rejected`` counts in those points).
+    """
+    import shutil
+    import tempfile
+
+    from ..obs.registry import nearest_rank
+
+    say = log or (lambda s: print(s, file=sys.stderr, flush=True))
+    root = workdir or tempfile.mkdtemp(prefix="chaos_")
+    geometry = dict(
+        windows=windows, window_edges=window_edges,
+        superbatch=superbatch, every=every, seed=seed,
+    )
+
+    def cfg_for(d: str, kill_after: int) -> dict:
+        return dict(
+            geometry,
+            ckpt=os.path.join(d, "c.ckpt"),
+            digests=os.path.join(d, "digests.jsonl"),
+            events=os.path.join(d, "events.jsonl"),
+            meta=os.path.join(d, "meta.json"),
+            kill_after=kill_after,
+        )
+
+    # -- oracle: one uninterrupted run --------------------------------- #
+    oracle_dir = os.path.join(root, "oracle")
+    os.makedirs(oracle_dir, exist_ok=True)
+    say(f"chaos: oracle run ({windows} windows x {window_edges} edges, "
+        f"superbatch={superbatch}, every={every})...")
+    r = _spawn_worker(cfg_for(oracle_dir, -1))
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"chaos oracle run failed rc={r.returncode}: {r.stderr[-2000:]}"
+        )
+    oracle = {
+        line["o"]: line["d"]
+        for line in _read_jsonl(os.path.join(oracle_dir, "digests.jsonl"))
+    }
+    if sorted(oracle) != list(range(windows)):
+        raise RuntimeError(
+            f"chaos oracle covered windows {sorted(oracle)}, "
+            f"expected 0..{windows - 1}"
+        )
+
+    # two corruption points (one per mode), centered in the sweep so a
+    # barrier definitely exists to corrupt
+    corrupt_at = {}
+    if corrupt and windows >= 2 * every + 2:
+        corrupt_at[max(every + 1, windows // 3)] = "flip"
+        corrupt_at[max(every + 2, (2 * windows) // 3)] = "truncate"
+
+    points = []
+    all_ok = True
+    for k in range(1, windows + 1):
+        d = os.path.join(root, f"kill_{k:03d}")
+        os.makedirs(d, exist_ok=True)
+        cfg = cfg_for(d, k)
+        point = {"kill_after": k, "corrupt": corrupt_at.get(k)}
+        r = _spawn_worker(cfg)
+        if r.returncode != KILL_RC:
+            point.update(ok=False, reason=(
+                f"kill run rc={r.returncode} (expected {KILL_RC}): "
+                f"{r.stderr[-500:]}"
+            ))
+            points.append(point)
+            all_ok = False
+            continue
+        mode = corrupt_at.get(k)
+        if mode is not None and os.path.exists(cfg["ckpt"]):
+            from .faults import corrupt_file
+
+            corrupt_file(cfg["ckpt"], mode, seed=seed + k)
+        t0 = time.perf_counter()
+        r = _spawn_worker(dict(cfg, kill_after=-1))
+        resume_s = time.perf_counter() - t0
+        if r.returncode != 0:
+            point.update(ok=False, reason=(
+                f"resume rc={r.returncode}: {r.stderr[-500:]}"
+            ))
+            points.append(point)
+            all_ok = False
+            continue
+        lines = _read_jsonl(cfg["digests"])
+        bad = [
+            line for line in lines if oracle.get(line["o"]) != line["d"]
+        ]
+        covered = sorted({line["o"] for line in lines})
+        with open(cfg["meta"]) as f:
+            meta = json.load(f)
+        point.update(
+            resume_s=round(resume_s, 3),
+            first_emission_s=round(meta["first_emission_s"], 4)
+            if meta["first_emission_s"] is not None else None,
+            resumed_from=meta["resumed_from"],
+            replayed=max(0, k - meta["resumed_from"]),
+            in_process_restarts=meta["restarts"],
+            ckpt_rejected=_count_rejections(cfg["events"]),
+        )
+        ok = not bad and covered == list(range(windows))
+        if mode is not None and meta["resumed_from"] > 0:
+            # a corrupted head must have been REJECTED (visible in the
+            # event log), never loaded
+            ok = ok and point["ckpt_rejected"] >= 1
+        point["ok"] = ok
+        if not ok:
+            point["reason"] = (
+                f"{len(bad)} digest mismatches, covered {len(covered)}/"
+                f"{windows} windows"
+            )
+            all_ok = False
+        points.append(point)
+        say(f"chaos: kill@{k}"
+            + (f"+{mode}" if mode else "")
+            + f" -> resumed_from={point.get('resumed_from')} "
+            f"rejected={point.get('ckpt_rejected')} ok={ok}")
+
+    recov = sorted(
+        p["first_emission_s"] for p in points
+        if p.get("ok") and p.get("first_emission_s") is not None
+    )
+    resumes = sorted(
+        p["resume_s"] for p in points if p.get("ok") and "resume_s" in p
+    )
+    doc = {
+        "config": geometry,
+        "ok": all_ok,
+        "kill_points": len(points),
+        "restarts_total": sum(
+            1 + p.get("in_process_restarts", 0) for p in points
+        ),
+        "ckpt_rejected_total": sum(
+            p.get("ckpt_rejected", 0) for p in points
+        ),
+        "recovery_s": {
+            # supervisor-measured: worker start to first (re-)emission,
+            # i.e. restore + replay, excluding interpreter boot
+            "p50": nearest_rank(recov, 50),
+            "p90": nearest_rank(recov, 90),
+            "max": recov[-1] if recov else None,
+        },
+        "resume_wall_s": {
+            # full relaunch wall time; dominated by interpreter + jax
+            # import on this harness's tiny windows
+            "p50": nearest_rank(resumes, 50),
+            "max": resumes[-1] if resumes else None,
+        },
+        "points": points,
+        "note": (
+            "every kill point must replay to oracle-identical digests "
+            "over full window coverage; corrupt points additionally "
+            "require the torn head to be rejected (ckpt_rejected >= 1) "
+            "with recovery from the previous barrier"
+        ),
+    }
+    if workdir is None:
+        shutil.rmtree(root, ignore_errors=True)
+    return doc
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "worker":
+        worker_main(json.loads(sys.argv[2]))
+    else:
+        print(json.dumps(run_sweep(), indent=2))

@@ -41,7 +41,9 @@ from .query import (
     QueryEngine,
     RankQuery,
 )
-from .server import Overloaded, Servable, StreamServer
+from ..resilience.errors import DeadlineExceeded
+from ..resilience.retry import RetryPolicy
+from .server import Overloaded, Servable, Shed, StreamServer
 from .snapshot_store import PublishedSnapshot, SnapshotStore
 from .stats import ServingStats
 
@@ -49,14 +51,17 @@ __all__ = [
     "Answer",
     "ComponentSizeQuery",
     "ConnectedQuery",
+    "DeadlineExceeded",
     "DegreeQuery",
     "Overloaded",
     "PublishedSnapshot",
     "Query",
     "QueryEngine",
     "RankQuery",
+    "RetryPolicy",
     "Servable",
     "ServingStats",
+    "Shed",
     "SnapshotStore",
     "StreamServer",
 ]

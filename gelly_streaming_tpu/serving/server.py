@@ -26,11 +26,16 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from collections import deque
 from concurrent.futures import Future
 from typing import Iterator, Optional, Tuple
 
 from ..obs import trace as _trace
+from ..obs.registry import get_registry
+from ..resilience import faults as _faults
+from ..resilience.errors import DeadlineExceeded
+from ..resilience.retry import RetryPolicy
 from .query import Answer, Query, QueryEngine
 from .snapshot_store import PublishedSnapshot, SnapshotStore
 from .stats import ServingStats
@@ -39,7 +44,16 @@ from .stats import ServingStats
 class Overloaded(RuntimeError):
     """The server's admission limit is reached; retry with back-off.
     Raised from ``submit``/``ask`` so rejection is synchronous and
-    explicit — an overloaded serving tier must shed, not buffer."""
+    explicit — an overloaded serving tier must shed, not buffer.
+    ``submit`` retries these internally when a
+    :class:`~gelly_streaming_tpu.resilience.RetryPolicy` is configured."""
+
+
+class Shed(Overloaded):
+    """The query's CLASS is being load-shed under sustained pressure
+    (see ``StreamServer`` ``shed_classes``). Never retried by the
+    built-in retry policy: shedding exists to lose exactly this
+    traffic so the protected classes keep their latency."""
 
 
 class Servable:
@@ -82,6 +96,26 @@ class StreamServer:
     max_pending:
         Admission limit: queries admitted but not yet answered. At the
         limit, ``submit`` raises :class:`Overloaded`.
+    retry_policy:
+        Default :class:`~gelly_streaming_tpu.resilience.RetryPolicy` for
+        :class:`Overloaded` rejections: ``submit`` blocks the CALLER
+        through bounded-exponential, jittered re-admission attempts
+        before giving up (clients get back-pressure-with-patience
+        instead of hand-rolling retry loops). None (default) keeps
+        rejections immediate. :class:`Shed` rejections never retry.
+    shed_classes:
+        Query classes (types or type names) to LOAD-SHED under
+        sustained pressure: once admitted load has stayed at or above
+        ``shed_watermark * max_pending`` for ``shed_after_s`` seconds,
+        submits of these classes raise :class:`Shed` immediately
+        (counted as ``serving.shed{cls=...}`` in the obs registry)
+        while other classes keep the remaining headroom. Pressure
+        clears the moment load drops below the watermark.
+    watchdog_s:
+        Arms a worker stall watchdog: a daemon thread that warns (and
+        counts ``serving.worker_stalls``) whenever queries are pending
+        but the worker loop has not completed a sweep within this many
+        seconds — the serving analog of the prefetch stall watchdog.
     """
 
     def __init__(
@@ -93,6 +127,11 @@ class StreamServer:
         store: Optional[SnapshotStore] = None,
         engine: Optional[QueryEngine] = None,
         stats: Optional[ServingStats] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        shed_classes: tuple = (),
+        shed_watermark: float = 0.8,
+        shed_after_s: float = 0.05,
+        watchdog_s: Optional[float] = None,
     ):
         self._servable = servable
         self._source = source
@@ -100,7 +139,19 @@ class StreamServer:
         self.engine = engine or QueryEngine()
         self.stats = stats or ServingStats()
         self.max_pending = int(max_pending)
-        self._pending: deque = deque()  # (query, future, t_submit)
+        self.retry_policy = retry_policy
+        self._shed_names = frozenset(
+            c if isinstance(c, str) else c.__name__ for c in shed_classes
+        )
+        self._shed_level = max(1, int(shed_watermark * self.max_pending))
+        self.shed_after_s = float(shed_after_s)
+        self._pressure_t0: Optional[float] = None  # sustained-load start
+        self.watchdog_s = watchdog_s
+        self._worker_beat = time.monotonic()
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._watchdog_stop = threading.Event()
+        # (query, future, t_submit, deadline_abs_or_None)
+        self._pending: deque = deque()
         self._inflight = 0  # drained by the worker, not yet answered
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -135,6 +186,12 @@ class StreamServer:
         )
         self._ingest_thread.start()
         self._worker_thread.start()
+        if self.watchdog_s is not None:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog, name="stream-server-watchdog",
+                daemon=True,
+            )
+            self._watchdog_thread.start()
         return self
 
     def __enter__(self) -> "StreamServer":
@@ -181,11 +238,45 @@ class StreamServer:
     # ------------------------------------------------------------------ #
     # Query surface
     # ------------------------------------------------------------------ #
-    def submit(self, query: Query) -> "Future[Answer]":
+    def submit(
+        self,
+        query: Query,
+        *,
+        deadline_s: Optional[float] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+    ) -> "Future[Answer]":
         """Admit one query; resolves to an :class:`~.query.Answer`.
         Raises :class:`Overloaded` at the admission limit — immediately,
-        on the caller's thread, so clients get synchronous
-        back-pressure."""
+        on the caller's thread, so clients get synchronous back-pressure
+        — unless a retry policy (per-call, else the server default)
+        absorbs it: then the CALLER blocks through bounded-backoff
+        re-admission attempts (``serving.retries`` counts them) and
+        only a spent budget re-raises. :class:`Shed` never retries.
+
+        ``deadline_s`` bounds how long the query may WAIT: if the
+        worker has not answered it that many seconds after submission,
+        its future fails with
+        :class:`~gelly_streaming_tpu.resilience.errors.DeadlineExceeded`
+        (``serving.deadline_expired`` counts it) instead of returning
+        an arbitrarily stale answer to a caller that stopped caring."""
+        policy = retry_policy if retry_policy is not None else self.retry_policy
+        attempt = 0
+        while True:
+            try:
+                return self._admit(query, deadline_s)
+            except Shed:
+                raise
+            except Overloaded:
+                delay = None if policy is None else policy.delay_s(attempt)
+                if delay is None:
+                    raise
+                attempt += 1
+                get_registry().counter("serving.retries").inc()
+                time.sleep(delay)
+
+    def _admit(
+        self, query: Query, deadline_s: Optional[float]
+    ) -> "Future[Answer]":
         declared = getattr(self._servable, "query_classes", ())
         if declared and not isinstance(query, tuple(declared)):
             # reject the wrong class SYNCHRONOUSLY on the caller's
@@ -210,20 +301,46 @@ class StreamServer:
             # count the worker's drained-but-unanswered batch too, or a
             # slow answer sweep would let admissions reach 2x the limit
             admitted = len(self._pending) + self._inflight
+            # sustained-pressure tracking for class shedding: the clock
+            # starts when load reaches the watermark and clears the
+            # moment it drops below (a burst alone never sheds)
+            now = time.monotonic()
+            if admitted >= self._shed_level:
+                if self._pressure_t0 is None:
+                    self._pressure_t0 = now
+            else:
+                self._pressure_t0 = None
+            if (
+                self._shed_names
+                and self._pressure_t0 is not None
+                and now - self._pressure_t0 >= self.shed_after_s
+                and type(query).__name__ in self._shed_names
+            ):
+                self.stats.record_rejected()
+                get_registry().counter(
+                    "serving.shed", cls=type(query).__name__
+                ).inc()
+                raise Shed(
+                    f"{type(query).__name__} shed under sustained "
+                    f"pressure ({admitted}/{self.max_pending} in flight)"
+                )
             if admitted >= self.max_pending:
                 self.stats.record_rejected()
                 raise Overloaded(
                     f"{admitted} queries in flight "
                     f"(max_pending={self.max_pending})"
                 )
-            self._pending.append((query, f, time.perf_counter()))
+            t0 = time.perf_counter()
+            deadline = None if deadline_s is None else t0 + float(deadline_s)
+            self._pending.append((query, f, t0, deadline))
             self.stats.set_pending(admitted + 1)  # admission gauge
         self._wake.set()
         return f
 
-    def ask(self, query: Query, timeout: Optional[float] = None) -> Answer:
+    def ask(self, query: Query, timeout: Optional[float] = None,
+            deadline_s: Optional[float] = None) -> Answer:
         """Synchronous point query (submit + wait)."""
-        return self.submit(query).result(timeout)
+        return self.submit(query, deadline_s=deadline_s).result(timeout)
 
     def snapshot(self) -> Optional[PublishedSnapshot]:
         """The snapshot queries are currently answered from."""
@@ -234,15 +351,51 @@ class StreamServer:
     # ------------------------------------------------------------------ #
     def _drain(self) -> list:
         with self._lock:
-            batch = list(self._pending)
+            drained = list(self._pending)
             self._pending.clear()
+            # deadline sweep happens at drain time (the worker's
+            # cadence): an expired query is settled with
+            # DeadlineExceeded instead of joining the answer batch —
+            # it must not spend engine time on an answer nobody wants
+            batch = []
+            now = time.perf_counter()
+            expired = []
+            for entry in drained:
+                dl = entry[3]
+                if dl is not None and now > dl:
+                    expired.append(entry)
+                else:
+                    batch.append(entry)
             self._inflight = len(batch)
+        for q, f, t0, dl in expired:
+            self._expire(q, f, t0, dl, "unanswered after")
+        if expired and not batch:
+            # the whole drain expired: nothing will reach the answer
+            # path's _settle, so settle here or an idle server reports
+            # the expired burst as a phantom backlog forever
+            self._settle()
         if batch:
             # coalescing evidence: how many concurrent queries one
             # vectorized sweep absorbed (empty sweeps are not recorded —
             # the idle poll would drown the signal)
             self.stats.record_drain(len(batch))
         return batch
+
+    @staticmethod
+    def _expire(q, f, t0, dl, verb: str) -> None:
+        """Settle one deadline-expired query: count it and fail its
+        future, with the same cancel-race guard as the answer path (a
+        client may cancel() mid-sweep; set_exception then raises, and
+        that must never kill the worker)."""
+        get_registry().counter("serving.deadline_expired").inc()
+        if not f.done():
+            try:
+                f.set_exception(DeadlineExceeded(
+                    f"{type(q).__name__} {verb} its {dl - t0:.3f}s "
+                    "deadline"
+                ))
+            except Exception:
+                pass
 
     def _settle(self) -> None:
         with self._lock:
@@ -269,10 +422,10 @@ class StreamServer:
             )
             if self._ingest_error is not None:
                 err.__cause__ = self._ingest_error
-            for _, f, _ in batch:
+            for _, f, _, _ in batch:
                 f.set_exception(err)
             return
-        queries = [q for q, _, _ in batch]
+        queries = [q for q, _, _, _ in batch]
         try:
             with _trace.span(
                 "serving.answer",
@@ -283,13 +436,20 @@ class StreamServer:
                     snap, queries, head_window=self.store.head_window()
                 )
         except Exception as e:
-            for _, f, _ in batch:
+            for _, f, _, _ in batch:
                 if not f.done():
                     f.set_exception(e)
             return
         now = time.perf_counter()
         self.stats.record_batch()
-        for (q, f, t0), ans in zip(batch, answers):
+        for (q, f, t0, dl), ans in zip(batch, answers):
+            # deadline re-check at settle time: a query drained in time
+            # but answered late (a slow engine sweep) must still honor
+            # its deadline rather than deliver a stale answer the
+            # caller stopped waiting for
+            if dl is not None and now > dl:
+                self._expire(q, f, t0, dl, "answered after")
+                continue
             self.stats.record(type(q).__name__, now - t0, ans.staleness)
             # a client may have cancel()ed its future mid-sweep;
             # settling it then raises InvalidStateError, which must not
@@ -302,6 +462,11 @@ class StreamServer:
 
     def _worker(self) -> None:
         while True:
+            # heartbeat first: the watchdog reads it to distinguish a
+            # stalled sweep (answer wedged on a device op) from idling
+            self._worker_beat = time.monotonic()
+            if _faults.active():  # chaos hook: injected worker stall
+                _faults.fire("serving.worker")
             batch = self._drain()
             if batch:
                 if self.store.latest() is None and not (
@@ -320,7 +485,7 @@ class StreamServer:
                     # the worker thread must survive ANY answer-path
                     # error — a dead worker hangs every future forever;
                     # fail this batch and keep serving
-                    for _, f, _ in batch:
+                    for _, f, _, _ in batch:
                         if not f.done():
                             f.set_exception(e)
                 finally:
@@ -330,6 +495,37 @@ class StreamServer:
                 return
             self._wake.wait(0.05)
             self._wake.clear()
+
+    def _watchdog(self) -> None:
+        """Stall watchdog (armed via ``watchdog_s``): flags a worker
+        that has queries WAITING but has not completed a sweep within
+        the threshold — wedged in an answer, not idle. Warns once per
+        stall episode and counts ``serving.worker_stalls``; detection
+        only (restart policy belongs to the operator — killing a thread
+        blocked in a device op is not safe from here)."""
+        flagged = False
+        interval = max(self.watchdog_s / 2, 0.01)
+        # interruptible wait: close() sets the stop event, so shutdown
+        # never blocks on a half-period sleep
+        while not self._watchdog_stop.wait(interval):
+            with self._lock:
+                waiting = bool(self._pending) or self._inflight > 0
+            stalled = (
+                waiting
+                and self._worker_thread is not None
+                and self._worker_thread.is_alive()
+                and time.monotonic() - self._worker_beat > self.watchdog_s
+            )
+            if stalled and not flagged:
+                flagged = True
+                get_registry().counter("serving.worker_stalls").inc()
+                warnings.warn(
+                    f"serving worker made no progress for "
+                    f"{self.watchdog_s}s with queries pending",
+                    RuntimeWarning,
+                )
+            elif not stalled:
+                flagged = False
 
     # ------------------------------------------------------------------ #
     # Shutdown
@@ -369,12 +565,15 @@ class StreamServer:
                 try:
                     self._answer(leftovers)
                 except BaseException as e:
-                    for _, f, _ in leftovers:
+                    for _, f, _, _ in leftovers:
                         if not f.done():
                             f.set_exception(e)
                 finally:
                     self._settle()
             self.store.close()
             self._closed = True
+            self._watchdog_stop.set()
+            if self._watchdog_thread is not None:
+                self._watchdog_thread.join(timeout)
         if self._ingest_error is not None:
             raise self._ingest_error
