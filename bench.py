@@ -1933,7 +1933,41 @@ def main():
         # CPU-pinned by construction (every worker subprocess pins
         # jax_platforms=cpu): the harness measures recovery
         # correctness + restore cost, not device throughput.
+        #
+        # --multiprocess (ISSUE 5 acceptance): the DISTRIBUTED sweep —
+        # an N-process cluster on coordinated epoch barriers, one
+        # worker of N killed at every window ordinal plus one
+        # torn-epoch corruption point, the whole cluster restarted from
+        # the agreed epoch; asserts oracle-identical emissions,
+        # byte-identical VertexDicts, no mixed-epoch restore at any
+        # point, and the serving-replica failover scenario's events in
+        # the obs log. Artifact: BENCH_CHAOS_MP_CPU.json.
         from gelly_streaming_tpu.resilience import chaos
+
+        if "--multiprocess" in sys.argv:
+            doc = chaos.run_mp_sweep(log=log)
+            doc["platform"] = "cpu-xla"
+            artifact = "BENCH_CHAOS_MP_CPU.json"
+            with open(artifact, "w") as f:
+                json.dump(doc, f, indent=2)
+            log(f"chaos-mp: ok={doc['ok']} "
+                f"kill_points={doc['kill_points']} "
+                f"cluster_restarts={doc['cluster_restarts_total']} "
+                f"torn_events={doc['epoch_torn_events_total']} "
+                f"recovery_p50={doc['recovery_s']['p50']}s")
+            print(json.dumps({
+                "metric": "chaos_mp_kill_sweep_recovery_p50_s",
+                "value": doc["recovery_s"]["p50"],
+                "unit": "seconds",
+                "kill_points": doc["kill_points"],
+                "cluster_restarts_total": doc["cluster_restarts_total"],
+                "failover_ok": (doc.get("failover") or {}).get("ok"),
+                "ok": doc["ok"],
+                "artifact": artifact,
+            }))
+            if not doc["ok"]:
+                sys.exit(1)
+            return
 
         doc = chaos.run_sweep(log=log)
         doc["platform"] = "cpu-xla"

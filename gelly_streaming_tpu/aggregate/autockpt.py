@@ -46,8 +46,11 @@ group-aligned barrier and replay, which the equivalence tests pin
 
 from __future__ import annotations
 
+import math
 import os
 import pickle
+import re
+import time
 from typing import Any, Callable, Iterator, Optional
 
 import numpy as np
@@ -104,9 +107,32 @@ class AutoCheckpoint:
     rejection — recovery never silently loads damage.
     """
 
-    def __init__(self, path: str, every: int = 8, keep: int = 2):
+    #: ``every="auto"``: barrier-overhead budget as a fraction of wall
+    #: time (the ISSUE 5 satellite target — at most ~5% of the run spent
+    #: inside barriers), and the cadence clamp the tuner moves within
+    AUTO_TARGET_OVERHEAD = 0.05
+    AUTO_MIN_EVERY = 1
+    AUTO_MAX_EVERY = 4096
+
+    def __init__(self, path: str, every=8, keep: int = 2, *,
+                 target_overhead: Optional[float] = None):
         self.path = path
-        self.every = int(every)
+        #: ``every="auto"`` tunes the cadence from the measured
+        #: ``checkpoint.barrier_wait`` + ``checkpoint.serialize`` cost of
+        #: each barrier vs the measured per-window wall time, so at most
+        #: ``target_overhead`` of the run is spent inside barriers. The
+        #: tuned value is re-derived after every barrier (both costs
+        #: drift as the summary grows) and always lands on a
+        #: superbatch-group boundary (see run()).
+        self.auto = every == "auto"
+        self.every = 2 if self.auto else int(every)
+        self.target_overhead = float(
+            self.AUTO_TARGET_OVERHEAD if target_overhead is None
+            else target_overhead
+        )
+        #: last measured costs (seconds), exposed for tests / telemetry
+        self.measured_barrier_s: Optional[float] = None
+        self.measured_window_s: Optional[float] = None
         self.keep = max(1, int(keep))
         #: artifacts already rejected, keyed by (path, mtime_ns, size):
         #: repeated _load scans (every windows_done() while all barriers
@@ -115,6 +141,13 @@ class AutoCheckpoint:
         #: new key and re-validates
         self._rejected_seen: set = set()
         self._cache = None  # loaded payload (invalidated on snapshot)
+        # True when _cache holds a scan RESULT — including the negative
+        # "no barrier found" one. The no-result case must cache too: in
+        # the coordinated layout a peer can commit between two scans,
+        # and an attempt whose windows_done() said "from scratch" but
+        # whose run() then restored a fresh epoch would desynchronize
+        # the supervisor's dedupe ordinals from the actual replay
+        self._cache_valid = False
         #: vertex dictionary restored by the last :meth:`run` (None on a
         #: fresh start) — the public surface for consumers that need to
         #: decode restored state when the resumed stream yields nothing
@@ -122,6 +155,41 @@ class AutoCheckpoint:
         self.restored_vdict = None
 
     # ------------------------------------------------------------------ #
+    def invalidate(self) -> None:
+        """Drop the cached barrier payload so the next read re-scans the
+        disk. The supervisor calls this before every (re)start attempt:
+        between a failure and its restart another actor may have
+        committed or damaged barriers (a peer process in the coordinated
+        multi-host layout, the chaos harness's corruption fault), and a
+        restart must restore from what is on disk NOW, not from a
+        pre-failure cache."""
+        self._cache = None
+        self._cache_valid = False
+
+    def discard(self) -> None:
+        """Delete every artifact of THIS checkpoint — the barrier head,
+        its crash-leftover temp, and all numbered rotation slots — and
+        drop the cache: the fresh-start path (the example CLIs'
+        ``--fresh``). Layout knowledge lives here next to ``_commit`` /
+        ``_rotate``; prefix-sharing siblings (``/d/run1`` vs
+        ``/d/run10``) are never touched."""
+        d, base = os.path.split(self.path)
+        try:
+            names = os.listdir(d or ".")
+        except OSError:
+            names = []
+        for name in names:
+            if name == base or name == base + ".tmp" or (
+                name.startswith(base + ".")
+                and re.fullmatch(r"\d+", name[len(base) + 1:])
+            ):
+                try:
+                    os.remove(os.path.join(d or ".", name))
+                except OSError:
+                    pass
+        self._cache = None
+        self._cache_valid = False
+
     def windows_done(self) -> int:
         """Windows completed at the last barrier (0 if no checkpoint)."""
         payload = self._load()
@@ -147,12 +215,52 @@ class AutoCheckpoint:
         # identically.
         gran = getattr(work, "checkpoint_granularity", None)
         k = int(gran()) if callable(gran) else 1
+        if self.auto and self.every % k:
+            self.every = self.every + (k - self.every % k)
         w = done
+        last_barrier = done
+        seg_t0 = time.perf_counter()  # start of the inter-barrier segment
         for batch in work.run(src):
             yield batch
             w += 1
-            if w % self.every == 0 and w % k == 0:
+            # fixed cadence keeps the historical modulo rule (barriers on
+            # multiples of `every`, resume re-tiles identically); the
+            # auto tuner counts windows SINCE the last barrier instead,
+            # because `every` itself moves between barriers
+            due = (
+                w - last_barrier >= self.every if self.auto
+                else w % self.every == 0
+            )
+            if due and w % k == 0:
+                window_s = (time.perf_counter() - seg_t0) / max(
+                    1, w - last_barrier
+                )
                 self._snapshot(work, stream.vertex_dict, w)
+                last_barrier = w
+                if self.auto:
+                    self._retune(window_s, k)
+                seg_t0 = time.perf_counter()
+
+    def _retune(self, window_s: float, k: int) -> None:
+        """Re-derive the auto cadence from the just-measured barrier cost
+        (the ``checkpoint.barrier_wait`` + ``checkpoint.serialize`` spans
+        of :meth:`_snapshot`) and the measured per-window wall time:
+        ``every >= barrier_s / (target_overhead * window_s)`` keeps the
+        fraction of wall time spent in barriers at or under the target,
+        rounded UP to a superbatch-group multiple and clamped to
+        [AUTO_MIN_EVERY, AUTO_MAX_EVERY]."""
+        barrier_s = self.measured_barrier_s
+        self.measured_window_s = window_s
+        if not barrier_s or window_s <= 0:
+            return
+        want = math.ceil(barrier_s / (self.target_overhead * window_s))
+        want = max(self.AUTO_MIN_EVERY, want, k)
+        if want % k:
+            want = want + (k - want % k)
+        # clamp AFTER rounding, to the largest superbatch multiple under
+        # the ceiling (never below k itself: barriers must stay aligned)
+        cap = max(self.AUTO_MAX_EVERY - self.AUTO_MAX_EVERY % k, k)
+        self.every = min(want, cap)
 
     def restored_emission(self, work):
         """For ENGINE aggregations: the emission the restored barrier's
@@ -167,6 +275,7 @@ class AutoCheckpoint:
 
     # ------------------------------------------------------------------ #
     def _snapshot(self, work, vdict, windows_done: int) -> None:
+        t0 = time.perf_counter()
         with _trace.span(
             "checkpoint.barrier",
             {"windows_done": windows_done} if _trace.on() else None,
@@ -197,20 +306,34 @@ class AutoCheckpoint:
                 "vdict": self._vdict_payload(vdict),
             }
             with _trace.span("checkpoint.serialize"):
-                data = _integrity.wrap_checksummed(pickle.dumps(payload))
-                tmp = self.path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(data)
-                self._rotate()
-                os.replace(tmp, self.path)  # atomic barrier commit
+                committed = self._commit(payload)
         # invalidate, do NOT cache: payload["state"] aliases LIVE workload
         # arrays (e.g. the degree shadow mutated by later windows); only
         # the pickled file is a true point-in-time snapshot
         self._cache = None
+        self._cache_valid = False
+        # the measured barrier cost feeds the auto cadence tuner — the
+        # same barrier_wait + serialize regions the obs spans time, but
+        # measured directly so tuning works with obs disabled
+        self.measured_barrier_s = time.perf_counter() - t0
         if _faults.active():  # chaos hook: corrupt-the-barrier-just-written
             _faults.fire(
-                "checkpoint.committed", index=windows_done, path=self.path
+                "checkpoint.committed", index=windows_done, path=committed
             )
+
+    def _commit(self, payload: dict) -> str:
+        """Serialize + atomically commit one barrier; returns the
+        committed path (the chaos corruption hook's target). The
+        single-process layout writes ``self.path`` with keep-last-N
+        rotation; the coordinated multi-host subclass overrides this to
+        write per-shard epoch files plus a rendezvous record."""
+        data = _integrity.wrap_checksummed(pickle.dumps(payload))
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        self._rotate()
+        os.replace(tmp, self.path)  # atomic barrier commit
+        return self.path
 
     def _rotate(self) -> None:
         """Shift committed barriers one slot down (``path`` -> ``path.1``
@@ -244,15 +367,19 @@ class AutoCheckpoint:
         ``windows_done()`` calls must not re-unpickle the file each
         time. Scans head-first, then the rotation slots; invalid
         artifacts are rejected (recorded + warned) and the scan falls
-        through to the previous barrier."""
-        if self._cache is not None:
+        through to the previous barrier. The NEGATIVE result caches
+        too: one attempt's reads must all agree (see ``_cache_valid``);
+        :meth:`invalidate` is the explicit re-scan."""
+        if self._cache_valid:
             return self._cache
+        payload = None
         for cand in self._candidates():
             payload = self._read_barrier(cand)
             if payload is not None:
-                self._cache = payload
-                return payload
-        return None
+                break
+        self._cache = payload
+        self._cache_valid = True
+        return payload
 
     def _candidates(self) -> list:
         """Barrier files newest-first: the head plus every rotation

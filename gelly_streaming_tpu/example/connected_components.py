@@ -15,7 +15,14 @@ from typing import List, Optional
 from ..core.stream import SimpleEdgeStream
 from ..core.window import CountWindow
 from ..library import ConnectedComponents
-from .common import default_chain_edges, read_edges, run_main, usage, write_lines
+from .common import (
+    default_chain_edges,
+    read_edges,
+    run_main,
+    supervised_emissions,
+    usage,
+    write_lines,
+)
 
 
 def run(
@@ -23,31 +30,40 @@ def run(
     window_size: int,
     output_path: Optional[str] = None,
     checkpoint_path: Optional[str] = None,
-    checkpoint_every: int = 64,
+    checkpoint_every=64,
+    resume: bool = True,
 ):
-    """``checkpoint_path`` enables transparent fault tolerance: an atomic
-    barrier every ``checkpoint_every`` windows; re-running the same
-    command after a crash resumes from the last barrier and ends with
-    identical output (``aggregate/autockpt.py``; the reference gets this
-    from Flink checkpointing, ``SummaryAggregation.java:127-135``)."""
+    """``checkpoint_path`` enables transparent fault tolerance, now
+    SUPERVISED (ISSUE 5 satellite): an atomic barrier every
+    ``checkpoint_every`` windows (``"auto"`` tunes the cadence so
+    barriers cost at most ~5% of wall time), restart-with-backoff on
+    transient faults via the resilience layer's ``Supervisor``, and
+    transparent restore — re-running the same command after a crash
+    resumes from the last barrier and ends with identical output
+    (``aggregate/autockpt.py`` + ``resilience/supervisor.py``; the
+    reference gets this from Flink checkpointing plus its restart
+    strategy, ``SummaryAggregation.java:127-135``). Resuming is the
+    default (the crash-recovery contract); ``resume=False`` (CLI
+    ``--fresh``) starts over, discarding any stale barrier at the
+    path."""
     if checkpoint_path is not None:
         import time
 
-        from ..aggregate.autockpt import AutoCheckpoint
-
-        ac = AutoCheckpoint(checkpoint_path, every=checkpoint_every)
         agg = ConnectedComponents()
+        emissions, ac = supervised_emissions(
+            checkpoint_path, checkpoint_every,
+            lambda vd: SimpleEdgeStream(
+                edges, window=CountWindow(window_size), vertex_dict=vd
+            ),
+            agg,
+            resume=resume,
+        )
         done = ac.windows_done()
         if done:
             print(f"resuming from barrier at window {done}")
         last = None
         t0 = time.perf_counter()
-        for last in ac.run(
-            lambda vd: SimpleEdgeStream(
-                edges, window=CountWindow(window_size), vertex_dict=vd
-            ),
-            agg,
-        ):
+        for last in emissions:
             pass
         runtime_ms = (time.perf_counter() - t0) * 1000
         if last is None and done:
@@ -142,19 +158,19 @@ def main(args: List[str]) -> None:
             "Usage: connected_components [--corpus <name|path> [window] "
             "[--device-encode <id bound>]] | <input edges path> "
             "<merge window size (edges)> [output path] "
-            "[--checkpoint <path> [--every <windows>]]"
+            "[--checkpoint <path> | --checkpoint-dir <dir>] "
+            "[--every <windows|auto>] [--resume | --fresh]"
         )
         try:
-            ckpt = None
-            every = 64
-            if "--checkpoint" in args:
-                i = args.index("--checkpoint")
-                ckpt = args[i + 1]
-                args = args[:i] + args[i + 2 :]
-                if "--every" in args:
-                    j = args.index("--every")
-                    every = int(args[j + 1])
-                    args = args[:j] + args[j + 2 :]
+            from .common import checkpoint_path_in, parse_checkpoint_flags
+
+            args, spec = parse_checkpoint_flags(args)
+            ckpt = every = None
+            resume = True
+            if spec is not None:
+                ckpt = checkpoint_path_in(spec, "cc.ckpt")
+                every = spec["every"]
+                resume = spec["resume"]
             if len(args) not in (2, 3):
                 print(usage_line)
                 return
@@ -164,7 +180,9 @@ def main(args: List[str]) -> None:
             return
         edges = read_edges(args[0])
         run(edges, window, args[2] if len(args) > 2 else None,
-            checkpoint_path=ckpt, checkpoint_every=every)
+            checkpoint_path=ckpt,
+            checkpoint_every=64 if every is None else every,
+            resume=resume)
     else:
         usage(
             "connected_components",

@@ -30,6 +30,8 @@ is host-count agnostic.
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -123,8 +125,89 @@ def globalize_stream(stream, mesh):
     )
 
 
+class JaxAllgatherTransport:
+    """The default exchange transport: ``multihost_utils.process_allgather``
+    over the live ``jax.distributed`` runtime. Tags are ignored — the
+    runtime's collective ordering IS the alignment."""
+
+    def allgather(self, tag: str, arr: np.ndarray) -> list:
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray(arr)
+        out = np.asarray(multihost_utils.process_allgather(arr))
+        return list(out.reshape((-1,) + arr.shape))
+
+
+class FileExchangeTransport:
+    """Allgather over a shared directory — the exchange transport for
+    multi-process runs WITHOUT a ``jax.distributed`` runtime (the CPU
+    backend implements no cross-process collectives; the distributed
+    chaos sweep runs on exactly that).
+
+    Every rank atomically publishes its array under
+    ``<root>/<tag>.p<rank>.npy`` (temp + ``os.replace``) and polls for
+    the peers', returning the arrays in rank order. Two properties make
+    this the RECOVERY-SAFE transport the coordinated-barrier layer
+    needs:
+
+    - **Persistence**: exchange files are never deleted, so a process
+      replaying windows after a restore re-reads the proposals its
+      peers published BEFORE the failure — replay is deterministic and
+      the dictionaries stay byte-identical without peers re-running
+      their side of old exchanges.
+    - **Idempotent publication**: a rank whose file already exists
+      skips the write. Proposals are pure functions of the raw window
+      (first-occurrence raw ids), so a replayed publication would be
+      byte-identical anyway; skipping just keeps mtimes stable.
+
+    A peer that never publishes (killed worker) fails the exchange with
+    :class:`~gelly_streaming_tpu.resilience.errors.TransientSourceError`
+    after ``timeout_s`` — the supervisor classifies it transient and the
+    cluster layer restarts everyone from the agreed epoch.
+    """
+
+    def __init__(self, root: str, process_id: int, num_processes: int,
+                 *, timeout_s: float = 60.0, poll_s: float = 0.002):
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        self.process_id = int(process_id)
+        self.num_processes = int(num_processes)
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s)
+
+    def _path(self, tag: str, rank: int) -> str:
+        return os.path.join(self.root, f"{tag}.p{rank}.npy")
+
+    def allgather(self, tag: str, arr: np.ndarray) -> list:
+        own = self._path(tag, self.process_id)
+        if not os.path.exists(own):
+            tmp = own + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                np.save(f, np.asarray(arr))
+            os.replace(tmp, own)
+        from ..resilience.errors import TransientSourceError
+
+        deadline = time.monotonic() + self.timeout_s
+        out = []
+        for rank in range(self.num_processes):
+            path = self._path(tag, rank)
+            while True:
+                try:
+                    out.append(np.load(path))
+                    break
+                except (OSError, ValueError):
+                    if time.monotonic() >= deadline:
+                        raise TransientSourceError(
+                            f"exchange {tag!r}: rank {rank} never "
+                            f"published within {self.timeout_s}s"
+                        )
+                    time.sleep(self.poll_s)
+        return out
+
+
 def dict_exchange_encode(
-    mesh, vdict, src_raw: np.ndarray, dst_raw: np.ndarray
+    mesh, vdict, src_raw: np.ndarray, dst_raw: np.ndarray,
+    *, transport=None, window=None,
 ):
     """Encode one window's raw columns under a GLOBALLY-AGREED dictionary
     (ingest contract (a), module docstring).
@@ -139,11 +222,29 @@ def dict_exchange_encode(
     their compiled programs) stay stable across windows. ``mesh`` is
     accepted for call-site symmetry with the pre-partition helpers; the
     exchange itself spans the global process set.
-    """
-    from jax.experimental import multihost_utils
 
+    ``transport`` selects how the allgather runs:
+    :class:`JaxAllgatherTransport` (default — the live multi-controller
+    runtime) or :class:`FileExchangeTransport` (a shared directory; the
+    coordinated-recovery path, replay-deterministic). ``window`` is the
+    window ordinal used to tag file-transport exchanges; required there,
+    ignored by the jax transport.
+    """
     from ..core.edgeblock import bucket_capacity
 
+    tr = transport if transport is not None else JaxAllgatherTransport()
+    if window is None and not isinstance(tr, JaxAllgatherTransport):
+        # a persisted transport keys the exchange on the tag; with a
+        # constant tag its idempotent-skip path would silently re-read
+        # the FIRST window's proposals for every later window and the
+        # ranks' dictionaries would diverge — the exact state the
+        # exchange exists to prevent
+        raise ValueError(
+            "dict_exchange_encode: `window` is required when the "
+            "transport persists exchanges by tag (it disambiguates "
+            "windows); only the jax allgather transport may omit it"
+        )
+    tag = "x" if window is None else f"w{int(window):08d}"
     ids = np.concatenate(
         [src_raw.astype(np.int64), dst_raw.astype(np.int64)]
     )
@@ -151,9 +252,10 @@ def dict_exchange_encode(
     _, first = np.unique(ids, return_index=True)
     proposal = ids[np.sort(first)]
     n = np.int32(len(proposal))
-    counts = np.asarray(
-        multihost_utils.process_allgather(np.array([n], np.int32))
-    ).reshape(-1)
+    counts = np.concatenate([
+        np.asarray(c).reshape(-1)
+        for c in tr.allgather(tag + ".n", np.array([n], np.int32))
+    ])
     cap = bucket_capacity(int(counts.max()) if counts.size else 1, minimum=8)
     # ship int64 raw ids as two int32 planes: the gather rides device
     # arrays, and default-jax (x64 disabled) silently truncates int64 —
@@ -161,11 +263,12 @@ def dict_exchange_encode(
     padded = np.zeros((2, cap), np.int32)
     padded[0, : len(proposal)] = (proposal >> 32).astype(np.int32)
     padded[1, : len(proposal)] = (proposal & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
-    gathered = np.asarray(multihost_utils.process_allgather(padded))
-    gathered = gathered.reshape(-1, 2, cap)
-    for p in range(gathered.shape[0]):
-        hi = gathered[p, 0, : int(counts[p])].astype(np.int64)
-        lo = gathered[p, 1, : int(counts[p])].view(np.uint32).astype(np.int64)
+    gathered = [
+        np.asarray(g) for g in tr.allgather(tag + ".ids", padded)
+    ]
+    for p, plane in enumerate(gathered):
+        hi = plane[0, : int(counts[p])].astype(np.int64)
+        lo = plane[1, : int(counts[p])].view(np.uint32).astype(np.int64)
         vdict.encode((hi << 32) | lo)
     return vdict.encode(src_raw), vdict.encode(dst_raw)
 

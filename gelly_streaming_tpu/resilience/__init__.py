@@ -24,6 +24,15 @@ three parts, in the MillWheel spirit that recovery is a tested property:
   :class:`FaultPlan` behind test-only hook points (pipeline, sources,
   checkpoints, serving worker) and the kill-at-every-window sweep
   (``bench.py --chaos``) that asserts oracle-identical recovery.
+- :mod:`coordinated` — the DISTRIBUTED half (ISSUE 5): per-shard epoch
+  barriers aligned across processes with a restore-side rendezvous
+  (newest epoch valid across ALL shards; mixed-epoch restores
+  impossible by construction) and :class:`ClusterSupervisor`
+  restart-all process supervision; the multi-process chaos sweep
+  (``bench.py --chaos --multiprocess``) kills one worker of N at every
+  window ordinal and demands oracle-identical recovery with
+  byte-identical vertex dictionaries. Serving-side failover lives in
+  :mod:`gelly_streaming_tpu.serving.failover`.
 
 Resilience telemetry rides the PR-3 obs registry:
 ``resilience.restarts{kind=...}``, ``resilience.ckpt_rejected``,
@@ -35,6 +44,12 @@ Resilience telemetry rides the PR-3 obs registry:
 """
 
 from . import faults
+from .coordinated import (
+    ClusterError,
+    ClusterSupervisor,
+    CoordinatedCheckpoint,
+    select_epoch,
+)
 from .errors import (
     CheckpointCorrupt,
     DeadlineExceeded,
@@ -51,6 +66,9 @@ from .supervisor import Supervisor
 
 __all__ = [
     "CheckpointCorrupt",
+    "ClusterError",
+    "ClusterSupervisor",
+    "CoordinatedCheckpoint",
     "DeadlineExceeded",
     "FaultPlan",
     "InjectedFault",
@@ -64,4 +82,5 @@ __all__ = [
     "exp_backoff",
     "faults",
     "jittered",
+    "select_epoch",
 ]

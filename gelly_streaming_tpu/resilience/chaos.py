@@ -3,7 +3,18 @@
 The recovery guarantee this repo claims — a killed process restarts
 from the newest valid barrier and finishes with output value-identical
 to an uninterrupted run — is only worth stating if something kills the
-process at EVERY window and checks. This module is that something:
+process at EVERY window and checks. This module is that something.
+:func:`run_sweep` is the single-process sweep (ISSUE 4);
+:func:`run_mp_sweep` is the DISTRIBUTED half (ISSUE 5): an N-process
+cluster with coordinated epoch barriers
+(:mod:`~gelly_streaming_tpu.resilience.coordinated`) and the
+file-exchange dictionary contract
+(:class:`~gelly_streaming_tpu.parallel.multihost.FileExchangeTransport`),
+where one worker of N is killed at every window ordinal, the
+:class:`~gelly_streaming_tpu.resilience.coordinated.ClusterSupervisor`
+restarts the whole cluster from the agreed epoch, and the driver asserts
+oracle-identical emissions, byte-identical VertexDicts, and that no
+relaunch ever mixed epochs. Single-process mechanics:
 
 - :func:`run_sweep` runs an ORACLE pass of the superbatched CC pipeline
   (fixed seeded corpus, per-window emission digests), then for each
@@ -54,6 +65,14 @@ REPO_ROOT = os.path.dirname(
 #: group boundaries, and kill points interleave in every phase
 DEFAULTS = dict(
     windows=24, window_edges=256, superbatch=2, every=2, seed=1234
+)
+
+#: multi-process sweep geometry: 2 processes (kill-one-of-N at every
+#: window ordinal), window_edges divisible by the process count so the
+#: interleaved pre-partition tiles windows exactly
+MP_DEFAULTS = dict(
+    processes=2, windows=12, window_edges=128, superbatch=2, every=2,
+    seed=4321,
 )
 
 
@@ -151,20 +170,246 @@ def worker_main(cfg: dict) -> None:
     faults.clear()
 
 
-def _spawn_worker(cfg: dict, timeout: float = 600.0):
-    import subprocess
-
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    code = (
+def _worker_code(entry: str) -> str:
+    return (
         "import sys, json; "
         f"sys.path.insert(0, {REPO_ROOT!r}); "
         "from gelly_streaming_tpu.resilience import chaos; "
-        "chaos.worker_main(json.loads(sys.argv[1]))"
+        f"chaos.{entry}(json.loads(sys.argv[1]))"
     )
+
+
+def _spawn_worker(cfg: dict, timeout: float = 600.0,
+                  entry: str = "worker_main"):
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.run(
-        [sys.executable, "-c", code, json.dumps(cfg)],
+        [sys.executable, "-c", _worker_code(entry), json.dumps(cfg)],
         capture_output=True, text=True, timeout=timeout, env=env,
     )
+
+
+# --------------------------------------------------------------------- #
+# Multi-process worker (one shard of the coordinated cluster)
+# --------------------------------------------------------------------- #
+def mp_worker_main(cfg: dict) -> None:
+    """One shard of the distributed sweep's cluster. ``cfg`` keys:
+    ``root`` (shared directory: ``ckpt/`` epochs + ``exchange/``
+    files), ``process``/``processes``, ``digests``/``events``/``meta``
+    (per-process paths), ``kill_after`` (windows consumed before
+    ``os._exit``; fires only when ``process == victim``), plus the
+    sweep geometry. Each process windows its interleaved shard of the
+    global corpus (edge ``i`` belongs to process ``i % N`` — the
+    pre-partition keyBy analog), agrees on raw->compact ids through the
+    persisted file exchange, and commits coordinated epoch barriers."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from ..core.stream import SimpleEdgeStream
+    from ..core.vertexdict import VertexDict
+    from ..core.window import CountWindow
+    from ..library import ConnectedComponents
+    from ..obs.export import JsonlSink
+    from ..obs.registry import get_registry
+    from ..parallel.multihost import FileExchangeTransport, dict_exchange_encode
+    from . import faults
+    from .coordinated import CoordinatedCheckpoint
+    from .supervisor import Supervisor
+
+    pid = int(cfg["process"])
+    nprocs = int(cfg["processes"])
+    windows = int(cfg["windows"])
+    we = int(cfg["window_edges"])
+    if we % nprocs:
+        raise ValueError("window_edges must divide by the process count")
+    lw = we // nprocs  # local (per-shard) window size
+    raw = corpus(cfg["seed"], windows * we)
+    mine = raw[pid::nprocs]
+    fx = FileExchangeTransport(
+        os.path.join(cfg["root"], "exchange"), pid, nprocs,
+        timeout_s=float(cfg.get("exchange_timeout_s", 60.0)),
+    )
+    sink = JsonlSink(cfg["events"])
+    get_registry().add_sink(sink)
+    seen_vd = {}  # the live stream's vertex dict (for the final CRC)
+
+    def make_stream(vd):
+        vd_eff = vd if vd is not None else VertexDict()
+        seen_vd["vd"] = vd_eff
+
+        def gen():
+            for w in range(windows):
+                chunk = mine[w * lw:(w + 1) * lw]
+                src = np.array([e[0] for e in chunk], np.int64)
+                dst = np.array([e[1] for e in chunk], np.int64)
+                # the union fold is the point; the returned compact
+                # columns are re-derived by the windower's own encode
+                dict_exchange_encode(
+                    None, vd_eff, src, dst, transport=fx, window=w
+                )
+                yield from chunk
+
+        return SimpleEdgeStream(
+            gen(), window=CountWindow(lw), vertex_dict=vd_eff
+        )
+
+    def make_work():
+        return ConnectedComponents(superbatch=cfg["superbatch"])
+
+    cc = CoordinatedCheckpoint(
+        os.path.join(cfg["root"], "ckpt"),
+        process_id=pid, num_processes=nprocs,
+        every=cfg["every"], keep=3,
+    )
+    sup = Supervisor(cc, backoff_base_s=0.0, jitter=0.0, seed=cfg["seed"])
+    kill_after = int(cfg.get("kill_after", -1))
+    if kill_after >= 0 and int(cfg.get("victim", -1)) == pid:
+        faults.install(faults.FaultPlan(
+            seed=cfg["seed"],
+            kill_at_window=kill_after - 1,
+            kill_exit_code=KILL_RC,
+        ))
+    t0 = time.perf_counter()
+    first = None
+    yielded = 0
+    resumed_epoch = None
+    with open(cfg["digests"], "a") as out:
+        ordinal = None
+        for comps in sup.run(make_stream, make_work):
+            if first is None:
+                first = time.perf_counter() - t0
+            if ordinal is None:
+                # label base = the epoch the supervisor ACTUALLY
+                # restored for the attempt that produced this first
+                # emission (read via the attempt's own cached load) —
+                # a pre-run scan could disagree with it: the
+                # supervisor re-invalidates and rescans, and in that
+                # gap a peer's healing commit can complete a newer
+                # epoch, or a pre-emission failure can fall back past
+                # a torn one; either way a stale base would mislabel
+                # every digest line
+                resumed_epoch = ordinal = cc.windows_done()
+            out.write(json.dumps({"o": ordinal, "d": digest(comps)}) + "\n")
+            out.flush()  # pre-crash evidence must survive os._exit
+            if faults.active():
+                faults.fire("chaos.window", index=ordinal)
+            ordinal += 1
+            yielded += 1
+    if resumed_epoch is None:
+        # nothing was emitted: the barrier already covered the whole
+        # stream, so the resumed epoch is the (cached) restored one
+        resumed_epoch = cc.windows_done()
+    import zlib
+
+    vd = seen_vd.get("vd")
+    vd_crc = (
+        None if vd is None
+        else zlib.crc32(np.ascontiguousarray(vd.raw_ids()).tobytes())
+        & 0xFFFFFFFF
+    )
+    with open(cfg["meta"], "w") as f:
+        json.dump({
+            "process": pid,
+            "resumed_epoch": resumed_epoch,
+            "restarts": sup.restarts,
+            "yielded": yielded,
+            "vd_crc": vd_crc,
+            "first_emission_s": first,
+            "total_s": time.perf_counter() - t0,
+        }, f)
+    sink.write()
+    get_registry().remove_sink(sink)
+    faults.clear()
+
+
+# --------------------------------------------------------------------- #
+# Serving failover scenario (one subprocess; events are the evidence)
+# --------------------------------------------------------------------- #
+def failover_main(cfg: dict) -> None:
+    """Kill the primary serving worker mid-stream and prove the standby
+    takeover contract: expired in-flight queries fail DeadlineExceeded,
+    the rest are re-answered from the standby's newest snapshot, new
+    submits keep working, and every failover event lands in the obs
+    event log. ``cfg`` keys: ``events``, ``meta``, ``seed``."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from ..datasets import IdentityDict
+    from ..obs.export import JsonlSink
+    from ..obs.registry import get_registry
+    from ..serving import ConnectedQuery, FailoverServer
+    from . import faults
+    from .errors import DeadlineExceeded
+
+    sink = JsonlSink(cfg["events"])
+    get_registry().add_sink(sink)
+    V = 32
+    vd = IdentityDict(V)
+    vd.observe(V - 1)
+
+    def payloads():
+        labels = np.arange(V, dtype=np.int32)
+        for w in range(200):
+            labels = labels.copy()
+            labels[: min(V, w + 2)] = 0  # a chain growing one node/window
+            yield {"labels": labels, "vdict": vd}, w + 1
+            time.sleep(0.005)
+
+    meta = {"promoted": False, "reanswered": 0, "expired": 0, "post": 0}
+    # the worker dies on its 6th sweep (~0.3s in): deterministic ordinal,
+    # wall timing irrelevant to the assertions below
+    with faults.injected(faults.FaultPlan(
+        seed=cfg["seed"], kill_site="serving.worker", kill_at_window=5,
+    )):
+        fs = FailoverServer(
+            payloads(), None, monitor_s=None, max_pending=64,
+        ).start()
+        try:
+            fs.store.wait_for(1, timeout=30)
+            # admitted BEFORE the death: answered by the primary if it
+            # gets there in time, re-answered by the standby otherwise —
+            # either way the future must settle with the right value
+            f_pre = fs.submit(ConnectedQuery(0, 1))
+            deadline = time.monotonic() + 30
+            while fs.primary.worker_alive() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not fs.primary.worker_alive(), "worker never died"
+            # admitted while the worker is dead: one already-hopeless
+            # deadline, two that the standby must re-answer
+            f_exp = fs.primary.submit(ConnectedQuery(0, 1), deadline_s=0.01)
+            f_ok = fs.primary.submit(ConnectedQuery(0, 1))
+            f_ok2 = fs.primary.submit(ConnectedQuery(0, 1), deadline_s=30.0)
+            time.sleep(0.05)  # f_exp's deadline lapses
+            fs.promote(reason="worker_death")
+            meta["promoted"] = fs.promoted
+            try:
+                f_exp.result(30)
+            except DeadlineExceeded:
+                meta["expired"] += 1
+            for f in (f_ok, f_ok2):
+                if f.result(30).value is True:
+                    meta["reanswered"] += 1
+            meta["pre"] = bool(f_pre.result(30).value)
+            if fs.ask(ConnectedQuery(0, 1), timeout=30).value is True:
+                meta["post"] = 1
+        finally:
+            fs.close()
+    reg = get_registry()
+    meta["failover_events"] = reg.counter(
+        "serving.failover", reason="worker_death"
+    ).value
+    meta["worker_deaths"] = reg.counter("serving.worker_deaths").value
+    with open(cfg["meta"], "w") as f:
+        json.dump(meta, f)
+    sink.write()
+    get_registry().remove_sink(sink)
 
 
 # --------------------------------------------------------------------- #
@@ -182,11 +427,14 @@ def _read_jsonl(path: str) -> list:
     return out
 
 
-def _count_rejections(events_path: str) -> int:
+def _count_events(events_path: str, name: str) -> int:
     return sum(
-        1 for e in _read_jsonl(events_path)
-        if e.get("name") == "resilience.ckpt_rejected"
+        1 for e in _read_jsonl(events_path) if e.get("name") == name
     )
+
+
+def _count_rejections(events_path: str) -> int:
+    return _count_events(events_path, "resilience.ckpt_rejected")
 
 
 def run_sweep(
@@ -367,8 +615,357 @@ def run_sweep(
     return doc
 
 
+# --------------------------------------------------------------------- #
+# Multi-process driver: kill one worker of N at every window ordinal
+# --------------------------------------------------------------------- #
+def run_mp_sweep(
+    *,
+    processes: int = MP_DEFAULTS["processes"],
+    windows: int = MP_DEFAULTS["windows"],
+    window_edges: int = MP_DEFAULTS["window_edges"],
+    superbatch: int = MP_DEFAULTS["superbatch"],
+    every: int = MP_DEFAULTS["every"],
+    seed: int = MP_DEFAULTS["seed"],
+    corrupt: bool = True,
+    failover: bool = True,
+    workdir: Optional[str] = None,
+    log: Optional[Callable[[str], None]] = None,
+) -> dict:
+    """Distributed kill sweep over an N-process coordinated cluster.
+
+    For every window ordinal ``k``, worker ``k % N`` dies hard after
+    ``k`` windows; the :class:`ClusterSupervisor` terminates the rest
+    and relaunches ALL workers, which rendezvous on the newest COMPLETE
+    epoch and replay. Asserted per point: the combined digest stream is
+    oracle-identical with full per-process window coverage, every
+    relaunched worker resumed from the SAME epoch (no mixed-epoch
+    restore, ever), and the final VertexDicts are byte-identical across
+    processes and to the oracle's. One point additionally corrupts one
+    shard of the newest complete epoch between kill and relaunch — the
+    whole epoch must be skipped (torn, visible in the event logs) and
+    every worker must fall back to the SAME previous epoch. With
+    ``failover=True`` the sweep also runs the serving-replica failover
+    scenario (:func:`failover_main`) and folds its evidence in.
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    from ..obs.registry import nearest_rank
+    from .coordinated import ClusterSupervisor, select_epoch
+
+    say = log or (lambda s: print(s, file=sys.stderr, flush=True))
+    root = workdir or tempfile.mkdtemp(prefix="chaos_mp_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    geometry = dict(
+        processes=processes, windows=windows, window_edges=window_edges,
+        superbatch=superbatch, every=every, seed=seed,
+    )
+
+    def cfg_for(d: str, pid: int, kill_after: int, victim: int) -> dict:
+        return dict(
+            geometry,
+            root=d,
+            process=pid,
+            victim=victim,
+            kill_after=kill_after,
+            digests=os.path.join(d, f"digests.p{pid}.jsonl"),
+            events=os.path.join(d, f"events.p{pid}.jsonl"),
+            meta=os.path.join(d, f"meta.p{pid}.json"),
+        )
+
+    def spawner(d: str, victim: int, kill_after: int):
+        """spawn(pid, attempt) for the ClusterSupervisor: the kill plan
+        rides only the FIRST attempt; relaunches run clean. Worker
+        output goes to per-attempt log files (no pipes — a terminated
+        worker must never deadlock the driver on a full pipe)."""
+
+        def spawn(pid: int, attempt: int):
+            cfg = cfg_for(
+                d, pid,
+                kill_after if attempt == 0 else -1,
+                victim,
+            )
+            log_path = os.path.join(d, f"worker.p{pid}.a{attempt}.log")
+            with open(log_path, "wb") as logf:
+                # the child holds its own dup of the fd; closing the
+                # driver's copy immediately keeps the sweep from
+                # accumulating points x processes x attempts open files
+                p = subprocess.Popen(
+                    [sys.executable, "-c", _worker_code("mp_worker_main"),
+                     json.dumps(cfg)],
+                    stdout=logf, stderr=subprocess.STDOUT, env=env,
+                )
+            p.log_path = log_path  # ClusterError reads its tail
+            return p
+
+        return spawn
+
+    def read_point(d: str) -> tuple:
+        """(digest lines per (pid, o), metas per pid) for one point."""
+        lines = {}
+        bad_dupes = []
+        for pid in range(processes):
+            for line in _read_jsonl(
+                os.path.join(d, f"digests.p{pid}.jsonl")
+            ):
+                key = (pid, line["o"])
+                if key in lines and lines[key] != line["d"]:
+                    bad_dupes.append(key)
+                lines[key] = line["d"]
+        metas = {}
+        for pid in range(processes):
+            p = os.path.join(d, f"meta.p{pid}.json")
+            if os.path.exists(p):
+                with open(p) as f:
+                    metas[pid] = json.load(f)
+        return lines, metas, bad_dupes
+
+    # -- oracle: one uninterrupted cluster run ------------------------- #
+    oracle_dir = os.path.join(root, "oracle")
+    os.makedirs(oracle_dir, exist_ok=True)
+    say(f"chaos-mp: oracle cluster ({processes} procs x {windows} "
+        f"windows x {window_edges} edges, superbatch={superbatch}, "
+        f"every={every})...")
+    cs = ClusterSupervisor(
+        spawner(oracle_dir, victim=-1, kill_after=-1), processes,
+        restart_codes=(KILL_RC,), backoff_base_s=0.0,
+    )
+    cs.run()
+    oracle, oracle_metas, dupes = read_point(oracle_dir)
+    want_keys = {
+        (pid, o) for pid in range(processes) for o in range(windows)
+    }
+    if set(oracle) != want_keys or dupes:
+        raise RuntimeError(
+            f"chaos-mp oracle covered {len(oracle)}/{len(want_keys)} "
+            f"(pid, window) points ({len(dupes)} digest conflicts)"
+        )
+    oracle_vd = {m["vd_crc"] for m in oracle_metas.values()}
+    if len(oracle_metas) != processes or len(oracle_vd) != 1:
+        raise RuntimeError(
+            f"chaos-mp oracle VertexDicts disagree across processes: "
+            f"{oracle_vd}"
+        )
+    oracle_vd_crc = next(iter(oracle_vd))
+
+    # the torn-epoch corruption point: late enough that a fallback epoch
+    # exists below the one being torn
+    corrupt_k = max(2 * every + 2, windows // 2) if corrupt else None
+    if corrupt_k is not None and corrupt_k > windows:
+        corrupt_k = None
+
+    points = []
+    all_ok = True
+    for k in range(1, windows + 1):
+        d = os.path.join(root, f"kill_{k:03d}")
+        os.makedirs(d, exist_ok=True)
+        victim = k % processes
+        point = {
+            "kill_after": k,
+            "victim": victim,
+            "corrupt": "flip" if k == corrupt_k else None,
+        }
+        corrupted_epoch = {}
+
+        def before_restart(attempt: int, _d=d, _k=k, _v=victim,
+                           _ce=corrupted_epoch):
+            if _k != corrupt_k or attempt != 1:
+                return
+            ckpt_dir = os.path.join(_d, "ckpt")
+            epoch = select_epoch(ckpt_dir, processes, record=False)
+            if epoch is None:
+                return
+            from .faults import corrupt_file
+
+            shard = os.path.join(
+                ckpt_dir, f"e{epoch:08d}.p{_v}.ckpt"
+            )
+            if os.path.exists(shard):
+                corrupt_file(shard, "flip", seed=seed + _k)
+                _ce["epoch"] = epoch
+
+        cs = ClusterSupervisor(
+            spawner(d, victim=victim, kill_after=k), processes,
+            restart_codes=(KILL_RC,), backoff_base_s=0.0,
+            before_restart=before_restart,
+        )
+        t0 = time.perf_counter()
+        try:
+            res = cs.run()
+        except Exception as e:
+            # one unrecoverable point (a worker bug outside the
+            # restart codes, an exhausted restart budget) must not
+            # throw away the evidence of every point already measured
+            # — record it failed and keep sweeping, like run_sweep
+            point.update(
+                resume_s=round(time.perf_counter() - t0, 3),
+                ok=False,
+                reason=f"cluster did not recover: {e!r:.800}",
+            )
+            all_ok = False
+            points.append(point)
+            say(f"chaos-mp: kill@{k} victim=p{victim} -> "
+                f"UNRECOVERED: {type(e).__name__}")
+            continue
+        resume_s = time.perf_counter() - t0
+        lines, metas, dupes = read_point(d)
+        bad = [
+            key for key, dg in lines.items() if oracle.get(key) != dg
+        ]
+        covered_ok = set(lines) >= want_keys
+        resumed = {m["resumed_epoch"] for m in metas.values()}
+        vd_crcs = {m.get("vd_crc") for m in metas.values()}
+        killed = [e for e in res["worker_exits"] if e[1] == KILL_RC]
+        point.update(
+            resume_s=round(resume_s, 3),
+            cluster_restarts=res["restarts"],
+            worker_exits=res["worker_exits"],
+            resumed_epochs=sorted(resumed),
+            first_emission_s=min(
+                (m["first_emission_s"] for m in metas.values()
+                 if m.get("first_emission_s") is not None),
+                default=None,
+            ),
+            epoch_torn_events=sum(
+                _count_events(
+                    os.path.join(d, f"events.p{p}.jsonl"),
+                    "resilience.epoch_torn",
+                )
+                for p in range(processes)
+            ),
+        )
+        # the contract, point by point: oracle-identical digests over
+        # full coverage; every relaunched worker restored from A
+        # complete epoch; byte-identical dictionaries; the injected
+        # kill really landed. Workers USUALLY agree on one epoch, but
+        # agreement is time-of-scan dependent, not guaranteed: a fast
+        # worker that restores from epoch e and replays forward
+        # re-commits its shards along the way, and that healing commit
+        # can COMPLETE a newer epoch (its peer's shard persisted from
+        # before the kill) before a slower-booting peer runs its own
+        # rendezvous — the peer then selects the newer epoch. Both
+        # restores are complete-epoch restores (never mixed within a
+        # process), and deterministic replay + digest dedupe make the
+        # outcome identical, so skew is recorded (``epoch_agreed``)
+        # but only CORRECTNESS failures fail the point.
+        ok = (
+            not bad and not dupes and covered_ok
+            and len(metas) == processes
+            and bool(resumed)
+            and vd_crcs == {oracle_vd_crc}
+            and killed and killed[0][0] == victim
+            and res["restarts"] >= 1
+        )
+        point["epoch_agreed"] = len(resumed) == 1
+        if k == corrupt_k and "epoch" in corrupted_epoch:
+            # the FIRST rendezvous after the corruption must have
+            # skipped the torn epoch (fallback strictly below it) and
+            # visibly rejected it; a later selector may land back on
+            # the corrupted ordinal only after a healing re-commit
+            ok = ok and min(resumed) < corrupted_epoch["epoch"]
+            ok = ok and point["epoch_torn_events"] >= 1
+            point["corrupted_epoch"] = corrupted_epoch["epoch"]
+        point["ok"] = ok
+        if not ok:
+            point["reason"] = (
+                f"{len(bad)} digest mismatches ({len(dupes)} conflicting "
+                f"dupes), covered={len(set(lines) & want_keys)}/"
+                f"{len(want_keys)}, resumed_epochs={sorted(resumed)}, "
+                f"vd_match={vd_crcs == {oracle_vd_crc}}, "
+                f"exits={res['worker_exits']}"
+            )
+            all_ok = False
+        points.append(point)
+        say(f"chaos-mp: kill@{k} victim=p{victim}"
+            + ("+flip" if k == corrupt_k else "")
+            + f" -> resumed_epoch={sorted(resumed)} "
+            f"restarts={res['restarts']} ok={ok}")
+
+    # -- serving replica failover point -------------------------------- #
+    failover_doc = None
+    if failover:
+        fd = os.path.join(root, "failover")
+        os.makedirs(fd, exist_ok=True)
+        cfg = {
+            "events": os.path.join(fd, "events.jsonl"),
+            "meta": os.path.join(fd, "meta.json"),
+            "seed": seed,
+        }
+        say("chaos-mp: serving failover scenario...")
+        r = _spawn_worker(cfg, entry="failover_main")
+        if r.returncode != 0:
+            failover_doc = {
+                "ok": False,
+                "reason": f"rc={r.returncode}: {r.stderr[-800:]}",
+            }
+            all_ok = False
+        else:
+            with open(cfg["meta"]) as f:
+                meta = json.load(f)
+            fo_ok = (
+                meta["promoted"] and meta["reanswered"] == 2
+                and meta["expired"] == 1 and meta["post"] == 1
+                and meta["failover_events"] >= 1
+                and _count_events(cfg["events"], "serving.failover") >= 1
+            )
+            failover_doc = {"ok": fo_ok, **meta}
+            all_ok = all_ok and fo_ok
+        say(f"chaos-mp: failover ok={failover_doc['ok']}")
+
+    recov = sorted(
+        p["first_emission_s"] for p in points
+        if p.get("ok") and p.get("first_emission_s") is not None
+    )
+    resumes = sorted(
+        p["resume_s"] for p in points if p.get("ok") and "resume_s" in p
+    )
+    doc = {
+        "config": geometry,
+        "ok": all_ok,
+        "kill_points": len(points),
+        "cluster_restarts_total": sum(
+            p.get("cluster_restarts", 0) for p in points
+        ),
+        "epoch_torn_events_total": sum(
+            p.get("epoch_torn_events", 0) for p in points
+        ),
+        "recovery_s": {
+            # worker start to first (re-)emission after relaunch:
+            # rendezvous + restore + replay, excluding interpreter boot
+            "p50": nearest_rank(recov, 50),
+            "p90": nearest_rank(recov, 90),
+            "max": recov[-1] if recov else None,
+        },
+        "resume_wall_s": {
+            "p50": nearest_rank(resumes, 50),
+            "max": resumes[-1] if resumes else None,
+        },
+        "points": points,
+        "failover": failover_doc,
+        "note": (
+            "every kill-one-of-N point must replay to oracle-identical "
+            "digests over full per-process coverage, with every worker "
+            "resumed from a COMPLETE epoch (mixed-epoch restores are "
+            "rejected by construction; cross-worker agreement is "
+            "recorded per point as epoch_agreed) and byte-identical "
+            "VertexDicts; "
+            "the corrupt point must skip the torn epoch on every worker; "
+            "the failover scenario must promote the standby with expired "
+            "queries failing DeadlineExceeded and the rest re-answered"
+        ),
+    }
+    if workdir is None:
+        shutil.rmtree(root, ignore_errors=True)
+    return doc
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "worker":
         worker_main(json.loads(sys.argv[2]))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "mp_worker":
+        mp_worker_main(json.loads(sys.argv[2]))
+    elif "--multiprocess" in sys.argv:
+        print(json.dumps(run_mp_sweep(), indent=2))
     else:
         print(json.dumps(run_sweep(), indent=2))

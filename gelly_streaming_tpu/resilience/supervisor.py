@@ -148,6 +148,16 @@ class Supervisor:
         fail_count = 0
         t_fail = None        # set at failure, cleared on first emission
         while True:
+            # re-scan the disk on every attempt: between a failure and
+            # its restart the barrier set may have changed under us (a
+            # coordinated peer committed or tore an epoch, the chaos
+            # harness corrupted the head) — restarting from a cached
+            # pre-failure payload could silently resurrect damage or,
+            # in the multi-host layout, restore a different epoch than
+            # the peers agree on
+            inv = getattr(self.ckpt, "invalidate", None)
+            if inv is not None:
+                inv()
             done = self.ckpt.windows_done()
             ordinal = done
             try:
@@ -210,6 +220,14 @@ class Supervisor:
     def _fresh_work(self, factory, pristine, current):
         if factory is not None:
             return factory()
+        # decide from the disk's CURRENT barrier state, not the attempt's
+        # cached payload: if every barrier was destroyed between the
+        # failure and this restart, the next attempt restores nothing —
+        # reusing the mutated object then would run mid-window wreckage
+        # as if it were pristine state
+        inv = getattr(self.ckpt, "invalidate", None)
+        if inv is not None:
+            inv()
         if self.ckpt.windows_done() > 0:
             # the barrier restore inside AutoCheckpoint.run overwrites
             # the carried state wholesale (restore_state /

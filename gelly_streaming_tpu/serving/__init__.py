@@ -21,6 +21,11 @@ stack:
   publishes snapshots, exposes ``submit(query) -> Future`` and a
   synchronous ``ask()``, rejects with :class:`Overloaded` past the
   admission limit, and drains cleanly on ``close()``.
+- :mod:`failover` — :class:`FailoverServer`: a standby ``StreamServer``
+  attached to the shared snapshot store, promoted when the primary's
+  query worker dies — expired in-flight queries fail
+  ``DeadlineExceeded``, the rest are re-answered from the standby's
+  newest snapshot, and admission/shedding/retry policies carry over.
 - :mod:`stats` — per-query-class latency histograms + staleness gauges,
   exported as plain dict snapshots (metrics stay ordinary output
   streams, the reference's design stance).
@@ -43,6 +48,7 @@ from .query import (
 )
 from ..resilience.errors import DeadlineExceeded
 from ..resilience.retry import RetryPolicy
+from .failover import FailoverServer
 from .server import Overloaded, Servable, Shed, StreamServer
 from .snapshot_store import PublishedSnapshot, SnapshotStore
 from .stats import ServingStats
@@ -53,6 +59,7 @@ __all__ = [
     "ConnectedQuery",
     "DeadlineExceeded",
     "DegreeQuery",
+    "FailoverServer",
     "Overloaded",
     "PublishedSnapshot",
     "Query",

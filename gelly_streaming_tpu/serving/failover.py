@@ -1,0 +1,268 @@
+"""Serving replica failover: a standby server promoted on primary death.
+
+The serving tier's availability story so far ends at the worker thread:
+``StreamServer``'s answer path survives any per-batch error, but a death
+of the worker itself (an injected crash in the chaos harness, a bug past
+the guards, a wedged device op the watchdog can only report) leaves
+every admitted future hanging forever. This module adds the replica
+analog of the pipeline's supervised recovery:
+
+- :class:`FailoverServer` runs a PRIMARY :class:`~.server.StreamServer`
+  (which owns ingest) and a STANDBY attached to the SAME
+  :class:`~.snapshot_store.SnapshotStore`. Snapshots are immutable and
+  publication is one reference swap, so the standby needs no catch-up
+  protocol — the store IS the replicated state, and the standby's first
+  answer is as fresh as the newest published snapshot.
+- A monitor thread polls primary worker liveness; on death (or an
+  explicit :meth:`promote`) the standby starts, the primary's admitted
+  queries move over, and new submits route to the standby. In-flight
+  queries past their deadline fail
+  :class:`~gelly_streaming_tpu.resilience.errors.DeadlineExceeded`
+  (counted ``serving.failover_expired`` on top of the usual
+  ``serving.deadline_expired``); the rest are RE-ANSWERED from the
+  standby's newest snapshot with their original submit times and
+  deadlines (``serving.failover_requeued``).
+- Admission, shedding, and retry policies carry over: both replicas are
+  constructed from the same configuration and share one
+  :class:`~.stats.ServingStats`, so ``max_pending``, ``shed_classes``,
+  the default ``retry_policy``, and the stats continuity a dashboard
+  depends on are identical before and after promotion.
+
+Ingest is NOT failed over here: if the primary's ingest thread is alive
+it keeps publishing into the shared store (a worker death does not stop
+the stream), and if ingest died the standby serves the newest snapshot —
+the same keep-serving-from-final-state contract a closed stream already
+has. Process-level ingest recovery belongs to the supervisor/cluster
+layer (``resilience/supervisor.py``, ``resilience/coordinated.py``).
+
+Every promotion is visible in the obs registry:
+``serving.failover{reason=...}``, ``serving.failover_requeued``,
+``serving.failover_expired``, plus the ``serving.worker_deaths`` the
+server itself records.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, Optional, Tuple
+
+from ..obs.registry import get_registry
+from .query import Answer, Query
+from .server import StreamServer
+from .snapshot_store import PublishedSnapshot, SnapshotStore
+from .stats import ServingStats
+
+
+def _follow_ingest(primary_done, stop) -> Iterator[Tuple[dict, int]]:
+    """The standby's ingest: publish nothing, but END only when the
+    PRIMARY's ingest ends (or this replica is told to stop). The
+    standby also shares the primary's ``_ingest_done`` event, so the
+    stream is "over" for the standby exactly when it is over for the
+    shared store. An instantly-finishing empty ingest would instead
+    flip the standby into post-stream mode while the primary is still
+    publishing: its answers would insist on the head snapshot (whose
+    arrays may reference the just-dispatched fold — the latency cliff
+    ``prefer_ready`` exists to avoid) and a promotion BEFORE the first
+    publish would fail adopted queries instead of holding them."""
+    while not primary_done.is_set() and not stop.is_set():
+        primary_done.wait(0.05)
+    return
+    yield  # unreachable: makes this a lazy, closeable generator
+
+
+class FailoverServer:
+    """A primary/standby :class:`StreamServer` pair over one shared
+    snapshot store.
+
+    Construct and :meth:`start` it exactly like a ``StreamServer`` —
+    ``submit``/``ask``/``snapshot``/``close`` route to whichever replica
+    is active. ``monitor_s`` sets the liveness poll period (None
+    disables the monitor; promotion is then manual via
+    :meth:`promote`). All other keyword arguments are the
+    ``StreamServer`` configuration, applied to BOTH replicas.
+    """
+
+    #: how long a MANUAL promotion waits for a still-alive primary
+    #: worker to settle its in-flight batch before stealing it
+    INFLIGHT_GRACE_S = 1.0
+
+    def __init__(
+        self,
+        servable,
+        source=None,
+        *,
+        monitor_s: Optional[float] = 0.02,
+        store: Optional[SnapshotStore] = None,
+        stats: Optional[ServingStats] = None,
+        **server_kwargs,
+    ):
+        self.store = store or SnapshotStore()
+        self.stats = stats or ServingStats()
+        self._kwargs = dict(
+            server_kwargs, store=self.store, stats=self.stats
+        )
+        self.primary = StreamServer(servable, source, **self._kwargs)
+        self.standby = StreamServer(iter(()), None, **self._kwargs)
+        # follower wiring: ingest stays the primary's job, so the
+        # standby's stream-ended signal must BE the primary's (shared
+        # event), and its own ingest thread must outlive the primary's
+        # publishing instead of finishing instantly — see _follow_ingest
+        self.standby._ingest_done = self.primary._ingest_done
+        self.standby._servable = _follow_ingest(
+            self.primary._ingest_done, self.standby._stop_ingest
+        )
+        self._active = self.primary
+        self.promoted = False
+        self.monitor_s = monitor_s
+        self._plock = threading.Lock()
+        self._monitor_stop = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+    def start(self) -> "FailoverServer":
+        self.primary.start()
+        if self.monitor_s is not None:
+            self._monitor_thread = threading.Thread(
+                target=self._monitor, name="stream-server-failover",
+                daemon=True,
+            )
+            self._monitor_thread.start()
+        return self
+
+    def __enter__(self) -> "FailoverServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def publish_boot(self, payload: dict, watermark: int = 0) -> None:
+        self.primary.publish_boot(payload, watermark)
+
+    def _monitor(self) -> None:
+        while not self._monitor_stop.wait(self.monitor_s):
+            if self.promoted or self._closed:
+                return
+            p = self.primary
+            if p._worker_thread is not None and not p.worker_alive():
+                self.promote(reason="worker_death")
+                return
+
+    # ------------------------------------------------------------------ #
+    # Promotion
+    # ------------------------------------------------------------------ #
+    def promote(self, reason: str = "manual") -> None:
+        """Switch serving to the standby. Safe to call once; later calls
+        are no-ops. The primary's admitted-but-unanswered queries are
+        re-homed: entries past their deadline fail ``DeadlineExceeded``
+        (they are late no matter who answers), the rest are adopted by
+        the standby and re-answered from its newest snapshot with their
+        original submit times and deadlines intact."""
+        with self._plock:
+            if self.promoted or self._closed:
+                return
+            reg = get_registry()
+            reg.counter("serving.failover", reason=reason).inc()
+            primary = self.primary
+            # refuse stragglers at the primary's admission gate; the
+            # flag flips under ITS lock so no submit can slip between
+            # the queue steal below and the reroute of self._active
+            with primary._lock:
+                primary._closing = True
+                entries = list(primary._pending)
+                primary._pending.clear()
+            self.standby.start()
+            # the in-flight batch: if the primary worker is still
+            # alive (a MANUAL switchover), it is mid-answer on exactly
+            # these entries — adopting them too would compute every
+            # query twice and double-record stats. Give the worker a
+            # short grace to settle, then steal whatever remains (the
+            # worker-death path skips the wait entirely; for a wedged
+            # worker the futures' done() guards make any late
+            # primary-side settle harmless).
+            deadline = time.monotonic() + self.INFLIGHT_GRACE_S
+            while (primary.worker_alive() and primary._inflight
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
+            with primary._lock:
+                entries.extend(primary._inflight_entries)
+                primary._inflight = 0
+                primary._inflight_entries = []
+            now = time.perf_counter()
+            keep = []
+            for q, f, t0, dl in entries:
+                if f.done():
+                    continue
+                if dl is not None and now > dl:
+                    StreamServer._expire(q, f, t0, dl, "failed over after")
+                    reg.counter("serving.failover_expired").inc()
+                else:
+                    keep.append((q, f, t0, dl))
+            self.standby._adopt(keep)
+            if keep:
+                reg.counter("serving.failover_requeued").inc(len(keep))
+            self._active = self.standby
+            self.promoted = True
+
+    # ------------------------------------------------------------------ #
+    # Query surface (routed to the active replica)
+    # ------------------------------------------------------------------ #
+    @property
+    def active(self) -> StreamServer:
+        with self._plock:
+            return self._active
+
+    def submit(self, query: Query, **kw):
+        srv = self.active
+        try:
+            return srv.submit(query, **kw)
+        except RuntimeError as e:
+            # possibly lost the race with a concurrent promotion: the
+            # primary refuses as "closed" the moment promote() starts,
+            # BEFORE the standby is ready. Taking the promotion lock
+            # waits out any in-flight promote; if the active replica
+            # changed, one re-route settles it (promotion is one-shot).
+            # A genuinely closed replica set re-raises.
+            if "closed" not in str(e) or self._closed:
+                raise
+            with self._plock:
+                now = self._active
+            if now is not srv:
+                return now.submit(query, **kw)
+            raise
+
+    def ask(self, query: Query, timeout: Optional[float] = None,
+            deadline_s: Optional[float] = None) -> Answer:
+        return self.submit(query, deadline_s=deadline_s).result(timeout)
+
+    def snapshot(self) -> Optional[PublishedSnapshot]:
+        return self.store.latest()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self.primary.join(timeout)
+
+    # ------------------------------------------------------------------ #
+    # Shutdown
+    # ------------------------------------------------------------------ #
+    def close(self, timeout: float = 30.0) -> None:
+        """Close both replicas (idempotent). The primary closes first so
+        ingest stops at a window boundary; each replica answers its own
+        admitted stragglers on the way down."""
+        with self._plock:
+            if self._closed:
+                return
+            self._closed = True
+        self._monitor_stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout)
+        errors = []
+        for srv in (self.primary, self.standby):
+            try:
+                srv.close(timeout)
+            except BaseException as e:
+                errors.append(e)
+        if errors:
+            raise errors[0]

@@ -34,7 +34,7 @@ from typing import Iterator, Optional, Tuple
 from ..obs import trace as _trace
 from ..obs.registry import get_registry
 from ..resilience import faults as _faults
-from ..resilience.errors import DeadlineExceeded
+from ..resilience.errors import DeadlineExceeded, InjectedFault
 from ..resilience.retry import RetryPolicy
 from .query import Answer, Query, QueryEngine
 from .snapshot_store import PublishedSnapshot, SnapshotStore
@@ -153,6 +153,12 @@ class StreamServer:
         # (query, future, t_submit, deadline_abs_or_None)
         self._pending: deque = deque()
         self._inflight = 0  # drained by the worker, not yet answered
+        # the drained batch's entries, kept until _settle: if the worker
+        # thread DIES mid-sweep (injected crash, answer-path bug past
+        # the guards) these futures would otherwise be unreachable —
+        # failover promotion re-homes them onto the standby
+        self._inflight_entries: list = []
+        self._sweeps = 0  # completed worker sweeps (fault-plan ordinal)
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop_ingest = threading.Event()
@@ -367,6 +373,7 @@ class StreamServer:
                 else:
                     batch.append(entry)
             self._inflight = len(batch)
+            self._inflight_entries = batch
         for q, f, t0, dl in expired:
             self._expire(q, f, t0, dl, "unanswered after")
         if expired and not batch:
@@ -400,6 +407,7 @@ class StreamServer:
     def _settle(self) -> None:
         with self._lock:
             self._inflight = 0
+            self._inflight_entries = []
             # the answered batch left flight: the admission gauge must
             # fall back to what is actually still waiting, or an idle
             # server reports the last burst as a phantom backlog forever
@@ -461,12 +469,52 @@ class StreamServer:
                     pass
 
     def _worker(self) -> None:
+        try:
+            self._worker_loop()
+        except InjectedFault:
+            # the fault plan's simulated worker death: count it and end
+            # the thread QUIETLY (no interpreter-level thread traceback
+            # — the death is the experiment, the failover monitor's
+            # promotion is the observable)
+            get_registry().counter("serving.worker_deaths").inc()
+        except BaseException:
+            # the loop's answer path already survives everything; an
+            # exception HERE is real worker death (a drain-path bug) —
+            # record it so the failover monitor can promote a standby,
+            # and let the thread traceback surface
+            get_registry().counter("serving.worker_deaths").inc()
+            raise
+
+    def worker_alive(self) -> bool:
+        """True while the query worker thread is running — the liveness
+        signal the failover monitor polls."""
+        t = self._worker_thread
+        return t is not None and t.is_alive()
+
+    def _adopt(self, entries: list) -> None:
+        """Enqueue already-admitted ``(query, future, t0, deadline)``
+        entries from another server — the failover promotion path. The
+        entries keep their original submit times and deadlines, so
+        re-answered queries still report honest latency and expired
+        ones still expire; adoption bypasses admission on purpose (the
+        queries were admitted once; failover must not shed them)."""
+        if not entries:
+            return
+        with self._lock:
+            self._pending.extend(entries)
+            self.stats.set_pending(
+                len(self._pending) + self._inflight
+            )
+        self._wake.set()
+
+    def _worker_loop(self) -> None:
         while True:
             # heartbeat first: the watchdog reads it to distinguish a
             # stalled sweep (answer wedged on a device op) from idling
             self._worker_beat = time.monotonic()
-            if _faults.active():  # chaos hook: injected worker stall
-                _faults.fire("serving.worker")
+            if _faults.active():  # chaos hook: worker stall / crash
+                _faults.fire("serving.worker", index=self._sweeps)
+            self._sweeps += 1
             batch = self._drain()
             if batch:
                 if self.store.latest() is None and not (
@@ -478,6 +526,7 @@ class StreamServer:
                     with self._lock:
                         self._pending.extendleft(reversed(batch))
                         self._inflight = 0
+                        self._inflight_entries = []
                     continue
                 try:
                     self._answer(batch)

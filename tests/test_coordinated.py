@@ -1,0 +1,600 @@
+"""Distributed resilience (ISSUE 5): coordinated epoch barriers, the
+restore-side rendezvous, cluster-level restart, the file-exchange
+ingest contract's replay determinism, and serving replica failover.
+
+``-m chaos_fast`` selects the in-process subset (blocking in CI; the
+"2-process" cases simulate both shards in one process or two threads);
+``-m chaos_full`` runs the reduced 2-process subprocess kill sweep."""
+
+import json
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import obs
+from gelly_streaming_tpu.parallel.multihost import (
+    FileExchangeTransport,
+    dict_exchange_encode,
+)
+from gelly_streaming_tpu.resilience import (
+    ClusterError,
+    ClusterSupervisor,
+    CoordinatedCheckpoint,
+    RestartBudgetExceeded,
+    TransientSourceError,
+    select_epoch,
+)
+from gelly_streaming_tpu.resilience.chaos import digest
+from gelly_streaming_tpu.resilience.faults import corrupt_file
+
+pytestmark = pytest.mark.chaos_fast
+
+N = 2  # the "2-process" geometry all rendezvous cases use
+
+
+@pytest.fixture
+def registry():
+    reg = obs.set_registry(None)
+    yield reg
+    obs.set_registry(None)
+
+
+def _commit(d, epoch, pid, marker=0):
+    """One shard's barrier + rendezvous record for ``epoch`` (synthetic
+    payload; the selection protocol only reads the container bytes)."""
+    cc = CoordinatedCheckpoint(
+        str(d), process_id=pid, num_processes=N, every=2
+    )
+    cc._commit({
+        "windows_done": epoch, "kind": "workload",
+        "state": {"marker": marker}, "vdict": None,
+    })
+
+
+# --------------------------------------------------------------------- #
+# 1. Epoch rendezvous selection
+# --------------------------------------------------------------------- #
+def test_select_newest_complete_epoch(tmp_path, registry):
+    for e in (2, 4):
+        for p in range(N):
+            _commit(tmp_path, e, p)
+    assert select_epoch(str(tmp_path), N) == 4
+    assert registry.gauge("resilience.epoch_selected").value == 4
+
+
+def test_select_skips_missing_shard_epoch(tmp_path, registry):
+    """An epoch one process never committed (it died first) is
+    incomplete: selection must NOT hand process 0 its own newer shard —
+    that would be a mixed-epoch restore one failure later."""
+    for e in (2, 4):
+        for p in range(N):
+            _commit(tmp_path, e, p)
+    _commit(tmp_path, 6, 0)  # p1 died before committing epoch 6
+    assert select_epoch(str(tmp_path), N) == 4
+    assert registry.counter("resilience.epoch_incomplete").value >= 1
+    # BOTH processes' loads agree on the epoch and restore their own
+    # shard of it — never p0's epoch-6 artifact
+    for p in range(N):
+        cc = CoordinatedCheckpoint(
+            str(tmp_path), process_id=p, num_processes=N, every=2
+        )
+        assert cc.windows_done() == 4
+        assert cc.epoch == 4
+
+
+def test_no_epoch_result_is_cached_until_invalidate(tmp_path, registry):
+    """The negative rendezvous result must cache like a positive one:
+    peers commit CONCURRENTLY, so without it one attempt's reads can
+    disagree — the supervisor labels ordinals from ``windows_done()``
+    and then ``run()`` re-loads, and a peer's healing commit landing
+    between the two scans would restore a fresh epoch while the replay
+    ordinals (and the sweep's digest labels) still start from scratch.
+    ``invalidate()`` is the one explicit re-scan point."""
+    cc = CoordinatedCheckpoint(
+        str(tmp_path), process_id=0, num_processes=N, every=2
+    )
+    assert cc.windows_done() == 0  # nothing on disk: cached negative
+    # a peer-driven epoch completes AFTER the scan (the healing race)
+    for p in range(N):
+        _commit(tmp_path, 2, p)
+    # same attempt: every read must still agree with the first scan
+    assert cc.windows_done() == 0
+    assert cc.epoch is None
+    # the next attempt re-scans explicitly and sees the new epoch
+    cc.invalidate()
+    assert cc.windows_done() == 2
+    assert cc.epoch == 2
+
+
+def test_select_skips_torn_epoch(tmp_path, registry):
+    for e in (2, 4):
+        for p in range(N):
+            _commit(tmp_path, e, p)
+    corrupt_file(str(tmp_path / "e00000004.p1.ckpt"), "flip", seed=7)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert select_epoch(str(tmp_path), N) == 2
+        # the torn epoch is skipped for EVERY shard, including the one
+        # whose artifact is perfectly fine
+        cc0 = CoordinatedCheckpoint(
+            str(tmp_path), process_id=0, num_processes=N, every=2
+        )
+        assert cc0.windows_done() == 2
+    assert registry.counter("resilience.epoch_torn").value >= 1
+    assert registry.counter("resilience.epoch_fallbacks").value >= 1
+    assert registry.counter("resilience.ckpt_rejected").value >= 1
+
+
+def test_select_rejects_foreign_geometry_and_ordinal(tmp_path, registry):
+    """Rendezvous records carrying a different process count (a stale
+    run's leftovers) or an ordinal disagreeing with their epoch slot
+    (a stitched / renamed file) invalidate the epoch."""
+    for p in range(N):
+        _commit(tmp_path, 2, p)
+    # geometry mismatch: rewrite p1's record claiming nprocs=3
+    rec_path = str(tmp_path / "e00000002.p1.json")
+    with open(rec_path) as f:
+        rec = json.load(f)
+    rec["nprocs"] = 3
+    with open(rec_path, "w") as f:
+        json.dump(rec, f)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert select_epoch(str(tmp_path), N) is None
+    rec["nprocs"] = N
+    rec["windows_done"] = 4  # ordinal disagreeing with the epoch slot
+    with open(rec_path, "w") as f:
+        json.dump(rec, f)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert select_epoch(str(tmp_path), N) is None
+
+
+def test_gc_never_strands_a_slow_peer(tmp_path, registry):
+    """A fast shard must keep its half of old epochs while they are the
+    only COMPLETE ones: GC gates on complete-epoch count, not on the
+    process's own commit history."""
+    for e in (2, 4):
+        for p in range(N):
+            _commit(tmp_path, e, p)
+    for e in (6, 8, 10, 12):  # p0 races ahead; p1 is stuck at 4
+        _commit(tmp_path, e, 0)
+    # only {2, 4} are complete (< keep=3): p0 deleted nothing
+    assert select_epoch(str(tmp_path), N, record=False) == 4
+    assert os.path.exists(tmp_path / "e00000002.p0.ckpt")
+    # p1 catches up; complete epochs now {2..12}; committing 14
+    # advances the floor to the 3rd-newest complete epoch
+    for e in (6, 8, 10, 12):
+        _commit(tmp_path, e, 1)
+    for p in range(N):
+        _commit(tmp_path, 14, p)
+    assert select_epoch(str(tmp_path), N, record=False) == 14
+    assert not os.path.exists(tmp_path / "e00000002.p0.ckpt")
+    assert not os.path.exists(tmp_path / "e00000002.p1.ckpt")
+    assert os.path.exists(tmp_path / "e00000010.p0.ckpt")
+
+
+def test_coordinated_rejects_auto_cadence(tmp_path):
+    """Per-process auto tuning would desynchronize barrier ordinals and
+    no epoch would ever be complete again — refused loudly."""
+    with pytest.raises(ValueError, match="identical on every process"):
+        CoordinatedCheckpoint(
+            str(tmp_path), process_id=0, num_processes=N, every="auto"
+        )
+
+
+def test_gc_floor_ignores_torn_epochs(tmp_path, registry):
+    """Torn epochs must not advance the GC floor: records alone would
+    count bit-rotted epochs as keepable history, and the floor would
+    slide over the last epochs selection can actually restore."""
+    for e in (2, 4, 6, 8, 10):
+        for p in range(N):
+            _commit(tmp_path, e, p)
+    corrupt_file(str(tmp_path / "e00000008.p1.ckpt"), "flip", seed=1)
+    corrupt_file(str(tmp_path / "e00000010.p1.ckpt"), "flip", seed=2)
+    for p in range(N):
+        _commit(tmp_path, 12, p)  # each commit runs the committer's GC
+    # epoch 6 is among the keep=3 newest VALID epochs ({4, 6, 12} by
+    # the time both shards committed 12) — both halves must survive
+    for p in range(N):
+        assert os.path.exists(tmp_path / f"e00000006.p{p}.ckpt"), p
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert select_epoch(str(tmp_path), N, record=False) == 12
+
+
+# --------------------------------------------------------------------- #
+# 2. File exchange: determinism, replay, timeout
+# --------------------------------------------------------------------- #
+def test_file_exchange_allgather_and_replay(tmp_path):
+    root = str(tmp_path / "x")
+    a = FileExchangeTransport(root, 0, 2, timeout_s=10)
+    b = FileExchangeTransport(root, 1, 2, timeout_s=10)
+    out = {}
+
+    def rank(tr, arr, key):
+        out[key] = tr.allgather("w00000000.ids", arr)
+
+    t0 = threading.Thread(target=rank, args=(a, np.arange(4), 0))
+    t1 = threading.Thread(target=rank, args=(b, np.arange(4) * 10, 1))
+    t0.start(); t1.start(); t0.join(10); t1.join(10)
+    for key in (0, 1):
+        got = out[key]
+        assert [g.tolist() for g in got] == [
+            [0, 1, 2, 3], [0, 10, 20, 30],
+        ]
+    # replay: the files persist, so a restarted rank re-reads the SAME
+    # exchange without peers re-publishing — and a changed local value
+    # is IGNORED (publication is idempotent; the first write is truth)
+    replay = FileExchangeTransport(root, 0, 2, timeout_s=10).allgather(
+        "w00000000.ids", np.arange(4) + 99
+    )
+    assert [g.tolist() for g in replay] == [[0, 1, 2, 3], [0, 10, 20, 30]]
+
+
+def test_file_exchange_timeout_is_transient(tmp_path):
+    tr = FileExchangeTransport(str(tmp_path), 0, 2, timeout_s=0.1)
+    with pytest.raises(TransientSourceError, match="never published"):
+        tr.allgather("w00000000.n", np.array([1]))
+
+
+def test_dict_exchange_over_files_keeps_dicts_identical(tmp_path):
+    """The dict-exchange contract over the file transport: two shards
+    with disjoint sparse raw ids end up with byte-identical
+    dictionaries, and a REPLAYED shard (fresh dict, same windows)
+    reconstructs the same dictionary from the persisted files — the
+    recovery property the coordinated sweep relies on."""
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+
+    rng = np.random.default_rng(5)
+    pool = rng.integers(1 << 40, 1 << 41, size=32).astype(np.int64)
+    shard = {
+        p: (pool[rng.integers(0, 32, 12)], pool[rng.integers(0, 32, 12)])
+        for p in range(2)
+    }
+    root = str(tmp_path / "x")
+    dicts = {}
+
+    def rank(pid):
+        tr = FileExchangeTransport(root, pid, 2, timeout_s=10)
+        vd = VertexDict()
+        src, dst = shard[pid]
+        for w in range(3):
+            sl = slice(w * 4, (w + 1) * 4)
+            dict_exchange_encode(
+                None, vd, src[sl], dst[sl], transport=tr, window=w
+            )
+        dicts[pid] = vd.raw_ids().tolist()
+
+    ts = [threading.Thread(target=rank, args=(p,)) for p in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert dicts[0] == dicts[1] and dicts[0]
+    # replay rank 0 from scratch: same dict, no live peer needed
+    before = dicts[0]
+    rank(0)
+    assert dicts[0] == before
+
+
+# --------------------------------------------------------------------- #
+# 3. Two-shard coordinated run with in-process crash recovery
+# --------------------------------------------------------------------- #
+def _shard_corpus(seed=99, windows=6, window_edges=32, nprocs=2):
+    rng = np.random.default_rng(seed)
+    pairs = rng.integers(0, 80, size=(windows * window_edges, 2))
+    raw = [(int(a) * 5 + 1, int(b) * 5 + 1, 0.0) for a, b in pairs]
+    return [raw[p::nprocs] for p in range(nprocs)]
+
+
+def _run_cluster(root, shards, *, windows, lw, crash_at=None,
+                 results=None):
+    """Drive both shards' supervised pipelines on two threads over one
+    shared checkpoint/exchange directory. ``crash_at=(pid, ordinal)``
+    raises SimulatedCrash inside that shard's stream once — the
+    in-process "worker death" the supervisor recovers from."""
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+    from gelly_streaming_tpu.resilience import Supervisor
+    from gelly_streaming_tpu.resilience.errors import SimulatedCrash
+
+    results = {} if results is None else results
+    errors = []
+
+    def worker(pid):
+        try:
+            fx = FileExchangeTransport(
+                os.path.join(root, "exchange"), pid, len(shards),
+                timeout_s=60,
+            )
+            mine = shards[pid]
+            armed = {"crash": crash_at is not None and crash_at[0] == pid}
+
+            def make_stream(vd):
+                vd_eff = vd if vd is not None else VertexDict()
+
+                def gen():
+                    for w in range(windows):
+                        chunk = mine[w * lw:(w + 1) * lw]
+                        src = np.array([e[0] for e in chunk], np.int64)
+                        dst = np.array([e[1] for e in chunk], np.int64)
+                        dict_exchange_encode(
+                            None, vd_eff, src, dst,
+                            transport=fx, window=w,
+                        )
+                        if armed["crash"] and w == crash_at[1]:
+                            armed["crash"] = False
+                            raise SimulatedCrash(f"injected at {w}")
+                        yield from chunk
+
+                return SimpleEdgeStream(
+                    gen(), window=CountWindow(lw), vertex_dict=vd_eff
+                )
+
+            cc = CoordinatedCheckpoint(
+                os.path.join(root, "ckpt"),
+                process_id=pid, num_processes=len(shards),
+                every=2, keep=3,
+            )
+            sup = Supervisor(cc, backoff_base_s=0.0, jitter=0.0)
+            digests = []
+            o = cc.windows_done()
+            vd_final = None
+            for comps in sup.run(
+                make_stream,
+                lambda: ConnectedComponents(superbatch=2),
+            ):
+                digests.append((o, digest(comps)))
+                o += 1
+            results[pid] = {
+                "digests": digests,
+                "restarts": sup.restarts,
+                "resumed": cc.epoch,
+            }
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errors.append((pid, e))
+
+    ts = [
+        threading.Thread(target=worker, args=(p,))
+        for p in range(len(shards))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(300)
+    assert not errors, errors
+    return results
+
+
+def test_coordinated_two_shard_recovery_oracle_identical(
+    tmp_path, registry
+):
+    """One shard crashes mid-run; its supervisor restores from the
+    AGREED epoch (complete across both shards) and the consumer-visible
+    emissions of both shards equal an uninterrupted cluster's exactly."""
+    windows, lw = 6, 16
+    shards = _shard_corpus(windows=windows, window_edges=2 * lw)
+    oracle = _run_cluster(
+        str(tmp_path / "oracle"), shards, windows=windows, lw=lw
+    )
+    crashed = _run_cluster(
+        str(tmp_path / "crash"), shards, windows=windows, lw=lw,
+        crash_at=(1, 4),
+    )
+    for pid in range(2):
+        assert crashed[pid]["digests"] == oracle[pid]["digests"]
+    assert crashed[1]["restarts"] == 1
+    assert registry.counter("resilience.coord_commits").value >= 4
+    assert registry.counter(
+        "resilience.restarts", kind="transient"
+    ).value == 1
+
+
+# --------------------------------------------------------------------- #
+# 4. ClusterSupervisor: restart-all, fatal classification, budget
+# --------------------------------------------------------------------- #
+def _spawn_script(tmp_path, script):
+    import subprocess
+    import sys
+
+    def spawn(pid, attempt):
+        return subprocess.Popen(
+            [sys.executable, "-c", script, str(pid), str(attempt),
+             str(tmp_path)],
+        )
+
+    return spawn
+
+
+_DIE_ONCE = """
+import sys
+pid, attempt = int(sys.argv[1]), int(sys.argv[2])
+if attempt == 0 and pid == 1:
+    sys.exit(17)
+"""
+
+
+def test_cluster_supervisor_restarts_all_on_one_death(tmp_path, registry):
+    cs = ClusterSupervisor(
+        _spawn_script(tmp_path, _DIE_ONCE), 2,
+        restart_codes=(17,), backoff_base_s=0.0,
+    )
+    res = cs.run()
+    assert res["restarts"] == 1
+    assert res["worker_exits"] == [(1, 17)]
+    assert registry.counter(
+        "resilience.cluster_restarts", reason="kill"
+    ).value == 1
+
+
+def test_cluster_supervisor_unknown_rc_is_fatal(tmp_path):
+    cs = ClusterSupervisor(
+        _spawn_script(tmp_path, "import sys; sys.exit(3)"), 2,
+        restart_codes=(17,), backoff_base_s=0.0,
+    )
+    with pytest.raises(ClusterError, match="rc=3"):
+        cs.run()
+
+
+def test_cluster_supervisor_budget(tmp_path):
+    cs = ClusterSupervisor(
+        _spawn_script(
+            tmp_path, "import sys; sys.exit(17 if int(sys.argv[1]) else 0)"
+        ),
+        2, restart_codes=(17,), max_restarts=2, backoff_base_s=0.0,
+    )
+    with pytest.raises(RestartBudgetExceeded):
+        cs.run()
+    assert cs.restarts == 2
+
+
+# --------------------------------------------------------------------- #
+# 5. Serving replica failover: promotion, deadline expiry vs re-answer
+# --------------------------------------------------------------------- #
+def _failover_pair(**kw):
+    """A FailoverServer whose primary publishes one snapshot and whose
+    worker can be killed on demand (via the fault plan)."""
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.serving import FailoverServer
+
+    V = 8
+    vd = IdentityDict(V)
+    vd.observe(V - 1)
+    labels = np.arange(V, dtype=np.int32)
+    labels[1] = 0  # 0-1 connected
+    hold = threading.Event()
+
+    def payloads():
+        yield {"labels": labels, "vdict": vd}, 1
+        hold.wait(30)  # keep ingest alive so close() is exercised fully
+
+    fs = FailoverServer(payloads(), None, **kw)
+    return fs, hold
+
+
+def test_failover_monitor_promotes_on_worker_death(registry):
+    """The liveness monitor path: the primary's worker dies (injected
+    crash on its 4th sweep), the monitor promotes the standby, and the
+    replica set keeps answering from the shared store."""
+    from gelly_streaming_tpu.resilience import FaultPlan, faults
+    from gelly_streaming_tpu.serving import ConnectedQuery
+
+    with faults.injected(FaultPlan(
+        kill_site="serving.worker", kill_at_window=3
+    )):
+        fs, hold = _failover_pair(monitor_s=0.005, max_pending=16)
+        fs.start()
+        try:
+            fs.store.wait_for(1, timeout=20)
+            deadline = time.monotonic() + 20
+            while not fs.promoted and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert fs.promoted, "monitor never promoted the standby"
+            assert not fs.primary.worker_alive()
+            assert fs.ask(ConnectedQuery(0, 1), timeout=20).value is True
+            assert fs.active is fs.standby
+        finally:
+            hold.set()
+            fs.close()
+    assert registry.counter(
+        "serving.failover", reason="worker_death"
+    ).value == 1
+    assert registry.counter("serving.worker_deaths").value == 1
+
+
+def test_failover_expires_late_queries_and_reanswers_the_rest(registry):
+    """Promotion semantics, deterministically (no monitor): queries
+    admitted against a DEAD primary either fail DeadlineExceeded (past
+    their deadline — late no matter who answers) or are re-answered by
+    the standby from the newest shared snapshot."""
+    from gelly_streaming_tpu.resilience import FaultPlan, faults
+    from gelly_streaming_tpu.resilience.errors import DeadlineExceeded
+    from gelly_streaming_tpu.serving import ConnectedQuery
+
+    with faults.injected(FaultPlan(
+        kill_site="serving.worker", kill_at_window=3
+    )):
+        fs, hold = _failover_pair(monitor_s=None, max_pending=16)
+        fs.start()
+        try:
+            fs.store.wait_for(1, timeout=20)
+            deadline = time.monotonic() + 20
+            while fs.primary.worker_alive() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert not fs.primary.worker_alive()
+            f_exp = fs.primary.submit(
+                ConnectedQuery(0, 1), deadline_s=0.005
+            )
+            f_ok = fs.primary.submit(ConnectedQuery(0, 1))
+            f_ok2 = fs.primary.submit(
+                ConnectedQuery(0, 1), deadline_s=20.0
+            )
+            time.sleep(0.02)  # f_exp's deadline lapses before promotion
+            fs.promote(reason="worker_death")
+            with pytest.raises(DeadlineExceeded):
+                f_exp.result(20)
+            assert f_ok.result(20).value is True
+            assert f_ok2.result(20).value is True
+        finally:
+            hold.set()
+            fs.close()
+    assert registry.counter("serving.failover_requeued").value == 2
+    assert registry.counter("serving.failover_expired").value == 1
+    assert registry.counter("serving.deadline_expired").value == 1
+
+
+def test_failover_policies_carry_over(registry):
+    """Admission/shedding/retry configuration and the stats surface are
+    the SAME objects/values on both replicas, and promotion is
+    idempotent — a dashboard or client sees no policy discontinuity
+    across a failover."""
+    from gelly_streaming_tpu.serving import ConnectedQuery, RetryPolicy
+
+    rp = RetryPolicy(attempts=2)
+    fs, hold = _failover_pair(
+        monitor_s=None, max_pending=2, retry_policy=rp,
+        shed_classes=("ComponentSizeQuery",),
+    )
+    fs.start()
+    try:
+        fs.store.wait_for(1, timeout=20)
+        for srv in (fs.primary, fs.standby):
+            assert srv.max_pending == 2
+            assert srv.retry_policy is rp
+            assert srv._shed_names == {"ComponentSizeQuery"}
+            assert srv.stats is fs.stats
+            assert srv.store is fs.store
+        fs.promote(reason="manual")
+        fs.promote(reason="manual")  # idempotent
+        assert registry.counter(
+            "serving.failover", reason="manual"
+        ).value == 1
+        assert fs.ask(ConnectedQuery(0, 1), timeout=20).value is True
+    finally:
+        hold.set()
+        fs.close()
+
+
+# --------------------------------------------------------------------- #
+# 6. Reduced 2-process kill sweep (the bench.py --chaos --multiprocess
+#    shape)
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+@pytest.mark.chaos_full
+def test_chaos_mp_kill_sweep_reduced(tmp_path):
+    from gelly_streaming_tpu.resilience import chaos
+
+    doc = chaos.run_mp_sweep(
+        processes=2, windows=3, window_edges=64, superbatch=2, every=2,
+        corrupt=False, failover=False, workdir=str(tmp_path),
+    )
+    assert doc["ok"], doc["points"]
+    assert doc["kill_points"] == 3
+    assert doc["cluster_restarts_total"] == 3

@@ -266,3 +266,36 @@ def test_cc_corpus_carry_flag(tmp_path, capsys):
             ln for ln in got.splitlines() if "=" in ln and "[" in ln
         ]
     assert outs["forest"] == outs["host"] == outs["dense"]
+
+
+def test_cc_supervised_checkpoint_dir_flags(tmp_path, capsys):
+    """ISSUE 5 satellite: the CC example runs SUPERVISED when given
+    --checkpoint-dir; re-running resumes from an existing barrier BY
+    DEFAULT (the crash-recovery contract) with identical output, and
+    --fresh replaces stale barriers instead of silently continuing
+    them."""
+    import os
+
+    from gelly_streaming_tpu.example import connected_components as ex
+
+    inp = tmp_path / "e.txt"
+    inp.write_text("".join(f"{k} {k + 2}\n" for k in range(1, 41)))
+    out = str(tmp_path / "out.txt")
+    ckdir = str(tmp_path / "ck")
+
+    ex.main([str(inp), "8", out, "--checkpoint-dir", ckdir, "--every", "2"])
+    capsys.readouterr()
+    first = open(out).read()
+    assert os.path.exists(os.path.join(ckdir, "cc.ckpt"))
+
+    # re-running the same command resumes by default (the barrier
+    # already covers the stream); output identical
+    ex.main([str(inp), "8", out, "--checkpoint-dir", ckdir, "--every", "2"])
+    assert "resuming from barrier" in capsys.readouterr().out
+    assert open(out).read() == first
+
+    # --fresh: stale barrier replaced, no resume line
+    ex.main([str(inp), "8", out, "--checkpoint-dir", ckdir,
+             "--every", "2", "--fresh"])
+    assert "resuming" not in capsys.readouterr().out
+    assert open(out).read() == first
