@@ -88,7 +88,7 @@ def save_pytree(path: str, tree: Any, meta: Optional[dict] = None) -> None:
         "checkpoint.barrier_wait",
         {"leaves": len(leaves)} if _trace.on() else None,
     ):
-        arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+        arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     with _trace.span(
         "checkpoint.serialize",
         {"leaves": len(leaves)} if _trace.on() else None,
@@ -245,6 +245,32 @@ def load_vertex_dict(path: str) -> VertexDict:
     return d
 
 
+def _commit_pickle_bytes(path: str, payload: bytes) -> None:
+    """Atomically commit pickled state: CRC-framed container written to
+    a tmp sibling, then ``os.replace``d into place — the same
+    torn-file guarantee the pytree/barrier paths already have. A kill
+    at any byte leaves the previous committed file (or nothing), never
+    a half-written pickle under the live name."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_integrity.wrap_checksummed(payload))
+    _integrity.replace_atomic(tmp, path)
+
+
+def _load_pickle_bytes(path: str) -> bytes:
+    """Read back a :func:`_commit_pickle_bytes` artifact. Legacy
+    un-framed pickles pass through unchanged (rename-atomicity was
+    their only guarantee, as before); a torn/corrupt frame raises
+    :class:`CheckpointCorrupt` and is recorded."""
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        return _integrity.unwrap_checksummed(data, origin=path)
+    except CheckpointCorrupt as e:
+        _integrity.record_rejection(path, str(e))
+        raise
+
+
 def save_aggregation(path: str, aggregation, vdict: Optional[VertexDict] = None) -> None:
     """Checkpoint an aggregation's running summary (+ optional dict).
 
@@ -258,8 +284,9 @@ def save_aggregation(path: str, aggregation, vdict: Optional[VertexDict] = None)
     else:
         import pickle
 
-        with open(path + ".pkl", "wb") as f:
-            pickle.dump(aggregation._summary, f)
+        _commit_pickle_bytes(
+            path + ".pkl", pickle.dumps(aggregation._summary)
+        )
     if vdict is not None:
         save_vertex_dict(path, vdict)
 
@@ -287,8 +314,9 @@ def restore_aggregation(path: str, aggregation, template: Any = None) -> Optiona
     else:
         import pickle
 
-        with open(path + ".pkl", "rb") as f:
-            aggregation._summary = pickle.load(f)
+        aggregation._summary = pickle.loads(
+            _load_pickle_bytes(path + ".pkl")
+        )
     vd_path = path + ".vdict.npy"
     return load_vertex_dict(path) if os.path.exists(vd_path) else None
 
@@ -300,8 +328,9 @@ def save_workload(path: str, workload, vdict: Optional[VertexDict] = None) -> No
     pickled — same trust model as the host-aggregation path above."""
     import pickle
 
-    with open(path + ".workload.pkl", "wb") as f:
-        pickle.dump(workload.state_dict(), f)
+    _commit_pickle_bytes(
+        path + ".workload.pkl", pickle.dumps(workload.state_dict())
+    )
     if vdict is not None:
         save_vertex_dict(path, vdict)
 
@@ -311,8 +340,9 @@ def restore_workload(path: str, workload) -> Optional[VertexDict]:
     Returns the restored VertexDict when one was saved alongside."""
     import pickle
 
-    with open(path + ".workload.pkl", "rb") as f:
-        workload.load_state_dict(pickle.load(f))
+    workload.load_state_dict(
+        pickle.loads(_load_pickle_bytes(path + ".workload.pkl"))
+    )
     vd_path = path + ".vdict.npy"
     return load_vertex_dict(path) if os.path.exists(vd_path) else None
 

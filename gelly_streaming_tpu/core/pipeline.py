@@ -138,7 +138,14 @@ def prefetch(iterator: Iterator[T], depth: int = 2,
                     try:
                         close()
                     except Exception:
-                        pass
+                        # abandoned-consumer teardown: the close
+                        # failure must not displace the consumer's own
+                        # exit path, but a producer thread swallowing
+                        # errors invisibly is the bug class GL003
+                        # exists for — count it
+                        get_registry().counter(
+                            name + ".swallowed", site="iterator_close"
+                        ).inc()
             _put(_SENTINEL)
 
     def _blocking_get():
@@ -192,6 +199,7 @@ def prefetch(iterator: Iterator[T], depth: int = 2,
             # the silent leak (round-4 shape): a producer that never
             # honored the stop flag is still holding its iterator (and
             # possibly a device); surface it instead of quietly leaking
+            # graftlint: disable=GL005 (teardown-only, fires at most once per prefetch lifetime; the leak must stay countable in disabled-obs production runs where warning filters can eat the RuntimeWarning)
             get_registry().counter(name + ".producer_leaked").inc()
             warnings.warn(
                 f"{name}: prefetch producer thread did not exit within "

@@ -39,7 +39,6 @@ import socket
 import time
 from typing import Iterator, Optional, Tuple
 
-import numpy as np
 
 from ..obs.registry import get_registry
 from ..resilience import faults as _faults

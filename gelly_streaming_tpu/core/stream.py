@@ -27,14 +27,14 @@ reproduced bit-exactly in ``tests/``.
 
 from __future__ import annotations
 
-import itertools
+import functools
 from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .edgeblock import EdgeBlock, concat_blocks
+from .edgeblock import EdgeBlock
 from .types import Edge, EdgeDirection, Vertex
 from .vertexdict import VertexDict
 from .window import (
@@ -778,9 +778,6 @@ class SimpleEdgeStream(GraphStream):
 # --------------------------------------------------------------------------- #
 # Helpers
 # --------------------------------------------------------------------------- #
-import functools
-
-
 @functools.partial(jax.jit, static_argnames=("in_", "out"))
 def _degree_update(deg: jax.Array, block: EdgeBlock, *, in_: bool, out: bool):
     """One window's degree fold + on-device changed-vertex compaction.

@@ -6,7 +6,6 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..core.window import CountWindow
 from ..library.sampling import BroadcastTriangleCount
 from .common import default_chain_edges, read_edges, run_main, usage, write_lines
 

@@ -9,7 +9,7 @@ file when an output path is given, printed otherwise.
 from __future__ import annotations
 
 import sys
-from typing import Iterable, List, Optional, Sequence, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 
 def read_edges(path: str, n_fields: int = 2, val_fn=float) -> List[Tuple]:

@@ -24,7 +24,7 @@ histograms are identical for any windowing.
 from __future__ import annotations
 
 import functools
-from typing import Iterable, Iterator, List, Optional, Tuple
+from typing import Iterable, Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,7 @@ whole matching (``:80-88``).
 from __future__ import annotations
 
 import enum
-from typing import Iterable, Iterator, NamedTuple, Tuple, Union
+from typing import Iterable, Iterator, NamedTuple, Tuple
 
 from ..core.types import Edge
 

@@ -36,7 +36,7 @@ import numpy as np
 
 from ..core.edgeblock import bucket_capacity
 from ..core.emission import LazyListBatch
-from ..core.window import CountWindow, WindowPolicy, Windower
+from ..core.window import WindowPolicy, Windower
 from ..utils.keyruns import SortedRunSet
 from ..ops.triangles import (
     build_sorted_directed,

@@ -21,7 +21,7 @@ framework.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
+from typing import Dict, Iterator, List
 
 import jax
 import jax.numpy as jnp

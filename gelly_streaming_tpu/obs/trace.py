@@ -163,6 +163,7 @@ class Span:
         if self._ann is not None:
             try:
                 self._ann.__exit__(*exc)
+            # graftlint: disable=GL003 (span teardown must never raise, and the obs layer cannot count into the registry it feeds — a sink mirroring events back through a span would recurse)
             except Exception:
                 pass
         stack = getattr(_LOCAL, "stack", None)

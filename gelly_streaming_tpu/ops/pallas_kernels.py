@@ -73,13 +73,13 @@ def fused_sage_matmul(
             f"got {activation!r}"
         )
     V, F = h.shape
-    O = w_self.shape[1]
+    o_dim = w_self.shape[1]
     dtype = h.dtype
     hp = _pad_to(h, tile_v, 128)
     ap = _pad_to(agg, tile_v, 128)
     wsp = _pad_to(w_self, 128, tile_o)
     wnp = _pad_to(w_nbr, 128, tile_o)
-    bp = jnp.pad(b, (0, wsp.shape[1] - O))[None, :]
+    bp = jnp.pad(b, (0, wsp.shape[1] - o_dim))[None, :]
     Vp, Fp = hp.shape
     Op = wsp.shape[1]
 
@@ -109,7 +109,7 @@ def fused_sage_matmul(
         out_specs=pl.BlockSpec((tile_v, tile_o), lambda i, j: (i, j)),
         interpret=interpret,
     )(hp, ap, wsp, wnp, bp)
-    return out[:V, :O]
+    return out[:V, :o_dim]
 
 
 def pallas_available() -> bool:

@@ -30,7 +30,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from .csr import CSR, build_csr, dense_neighbors
+from .csr import build_csr, dense_neighbors
 
 _BIG = jnp.iinfo(jnp.int32).max
 

@@ -28,13 +28,12 @@ SPMD context where the axis is bound).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 try:  # jax>=0.6 moved shard_map to jax.shard_map
     from jax import shard_map as _shard_map_fn  # type: ignore[attr-defined]

@@ -526,7 +526,13 @@ class ClusterSupervisor:
                     try:
                         err = procs[pid].stderr.read() or b""
                     except Exception:
-                        pass
+                        # diagnostics collection on an already-failed
+                        # worker: the ClusterError below still raises,
+                        # just without a tail — record the gap
+                        get_registry().counter(
+                            "resilience.swallowed",
+                            site="worker_stderr_read",
+                        ).inc()
                 elif getattr(procs[pid], "log_path", None):
                     try:
                         with open(procs[pid].log_path, "rb") as f:

@@ -28,7 +28,7 @@ import threading
 import time
 import warnings
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Iterator, Optional, Tuple
 
 from ..obs import trace as _trace
@@ -237,7 +237,12 @@ class StreamServer:
                     try:
                         close()
                     except Exception:
-                        pass
+                        # the stream is already torn down; the close
+                        # failure must not mask the shutdown, but it
+                        # must be visible in the event stream
+                        get_registry().counter(
+                            "serving.swallowed", site="ingest_close"
+                        ).inc()
             self._ingest_done.set()
             self._wake.set()  # the worker re-checks exit conditions
 
@@ -401,8 +406,12 @@ class StreamServer:
                     f"{type(q).__name__} {verb} its {dl - t0:.3f}s "
                     "deadline"
                 ))
-            except Exception:
-                pass
+            except InvalidStateError:
+                # client cancel() raced the sweep; the future is
+                # already settled — count the race, don't hide it
+                get_registry().counter(
+                    "serving.swallowed", site="expire_settle_race"
+                ).inc()
 
     def _settle(self) -> None:
         with self._lock:
@@ -465,8 +474,10 @@ class StreamServer:
             if not f.done():
                 try:
                     f.set_result(ans)
-                except Exception:
-                    pass
+                except InvalidStateError:
+                    get_registry().counter(
+                        "serving.swallowed", site="answer_settle_race"
+                    ).inc()
 
     def _worker(self) -> None:
         try:

@@ -29,6 +29,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Optional
 
+from ..obs.registry import get_registry
+
 
 def _payload_ready(payload) -> bool:
     """True when every array in the payload has finished computing
@@ -40,7 +42,12 @@ def _payload_ready(payload) -> bool:
                 if not ready():
                     return False
             except Exception:
-                pass
+                # a broken is_ready probe must never break a read —
+                # the value counts as ready — but it is evidence the
+                # payload contract is off, so it stays visible
+                get_registry().counter(
+                    "serving.swallowed", site="payload_ready_probe"
+                ).inc()
     return True
 
 

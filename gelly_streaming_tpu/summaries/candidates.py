@@ -21,7 +21,7 @@ reference's output format byte-for-byte: ``(true,{1={1=(1,true), ...}})`` /
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

@@ -44,7 +44,7 @@ vectorized over the window's touched set.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import numpy as np
 import pytest
 
 

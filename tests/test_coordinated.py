@@ -221,7 +221,10 @@ def test_file_exchange_allgather_and_replay(tmp_path):
 
     t0 = threading.Thread(target=rank, args=(a, np.arange(4), 0))
     t1 = threading.Thread(target=rank, args=(b, np.arange(4) * 10, 1))
-    t0.start(); t1.start(); t0.join(10); t1.join(10)
+    t0.start()
+    t1.start()
+    t0.join(10)
+    t1.join(10)
     for key in (0, 1):
         got = out[key]
         assert [g.tolist() for g in got] == [
@@ -346,7 +349,6 @@ def _run_cluster(root, shards, *, windows, lw, crash_at=None,
             sup = Supervisor(cc, backoff_base_s=0.0, jitter=0.0)
             digests = []
             o = cc.windows_done()
-            vd_final = None
             for comps in sup.run(
                 make_stream,
                 lambda: ConnectedComponents(superbatch=2),

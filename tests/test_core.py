@@ -76,7 +76,6 @@ def test_event_time_array_path_respects_timestamp_fn():
 
     src = np.arange(6, dtype=np.int64)
     dst = src + 100
-    val = np.zeros(6, np.float32)
     ts = np.array([0, 1, 12, 13, 25, 26], np.float64)
     # 4 columns: a naive implementation windows on cols[3]; the fn says e[2]
     wrong_ts = np.zeros(6, np.float64)

@@ -126,7 +126,6 @@ def test_movielens_matching_runs_on_fixture():
     path = datasets.locate("movielens-100k")
     u, m, r = datasets.load_movielens(path)
     wm = CentralizedWeightedMatching()
-    out = None
-    for out in wm.run(zip(u.tolist(), m.tolist(), r.tolist())):
+    for _out in wm.run(zip(u.tolist(), m.tolist(), r.tolist())):
         pass
     assert wm.total_weight() > 0

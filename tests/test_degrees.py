@@ -148,7 +148,7 @@ def test_out_of_order_batch_materialization_safe():
     dd = DegreeDistribution(CountWindow(6))
     batches = list(dd.run(events))
     assert len(batches) == 4
-    last_items = list(batches[-1])  # newest first
+    _ = list(batches[-1])  # newest first
     ub_after_last = dd._max_deg_ub
     _ = list(batches[0])  # old batch read later: no watermark regression
     assert dd._emit_base >= batches[-1]._ev

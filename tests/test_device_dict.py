@@ -87,7 +87,7 @@ def test_id_bound_violation_raises():
 
 def test_stream_file_device_encode_guards(tmp_path):
     from gelly_streaming_tpu import datasets
-    from gelly_streaming_tpu.core.window import CountWindow, EventTimeWindow
+    from gelly_streaming_tpu.core.window import CountWindow
     from gelly_streaming_tpu.core.vertexdict import VertexDict
 
     p = tmp_path / "g.txt"
@@ -170,8 +170,6 @@ def test_growth_mode_matches_host_dict(tmp_path):
     """General arbitrary-id text ingest (dense_ids=False): a tiny initial
     table forces repeated proactive growth (host novelty tracking);
     decoded edges and CC output must match the host-dict path exactly."""
-    import jax
-
     from gelly_streaming_tpu import datasets
     from gelly_streaming_tpu.core.window import CountWindow
     from gelly_streaming_tpu.library import ConnectedComponents

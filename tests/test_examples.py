@@ -43,7 +43,7 @@ def test_degree_distribution_itcase(tmp_path):
     inp = tmp_path / "events.txt"
     out = tmp_path / "result.txt"
     inp.write_text(DEGREES_DATA_ZERO)
-    dd = degree_distribution.main([str(inp), "1", str(out)])
+    degree_distribution.main([str(inp), "1", str(out)])
     lines = out.read_text().splitlines()
     # final state: edges {1-4, 3-4}: degrees 1:1, 4:2, 3:1 -> hist {1:2, 2:1}
     assert lines[-1] == "(1,1)"  # the deletion-to-zero case's last change
@@ -83,12 +83,12 @@ def test_exact_triangle_count_example(tmp_path):
     inp = tmp_path / "edges.txt"
     out = tmp_path / "result.txt"
     inp.write_text(
-        "\n".join(" ".join(l.split()[:2]) for l in TRIANGLES_DATA.splitlines())
+        "\n".join(" ".join(ln.split()[:2]) for ln in TRIANGLES_DATA.splitlines())
     )
     exact_triangle_count.main([str(inp), "5", str(out)])
     lines = dict(
-        tuple(map(int, l.strip("()").split(",")))
-        for l in out.read_text().splitlines()
+        tuple(map(int, ln.strip("()").split(",")))
+        for ln in out.read_text().splitlines()
     )
     assert lines[-1] == 9  # global count
 
@@ -96,7 +96,7 @@ def test_exact_triangle_count_example(tmp_path):
 def test_sampling_examples_run(tmp_path):
     inp = tmp_path / "edges.txt"
     inp.write_text("\n".join(
-        " ".join(l.split()[:2]) for l in TRIANGLES_DATA.splitlines()
+        " ".join(ln.split()[:2]) for ln in TRIANGLES_DATA.splitlines()
     ))
     out1 = tmp_path / "r1.txt"
     out2 = tmp_path / "r2.txt"
@@ -130,7 +130,7 @@ def test_pagerank_example(tmp_path):
     inp.write_text("1 2\n2 3\n3 1\n")
     incremental_pagerank.main([str(inp), "2", str(out)])
     vals = [
-        float(l.strip("()").split(",")[1]) for l in out.read_text().splitlines()
+        float(ln.strip("()").split(",")[1]) for ln in out.read_text().splitlines()
     ]
     assert len(vals) == 3
     assert sum(vals) == pytest.approx(1.0, abs=1e-3)

@@ -1,0 +1,790 @@
+"""Tests for graftlint, the repo-specific static-analysis suite (ISSUE 6).
+
+Per rule: at least one TRUE POSITIVE fixture — pinned to the shape of
+the bug this codebase actually shipped (citations in each fixture) —
+and at least one NEAR-MISS negative that a sloppier rule would flag.
+Plus: the suppression policy (a reason is mandatory), baseline
+round-trip/line-drift behavior, and the self-run gate: the repo must
+be clean against the committed baseline, a seeded violation of each
+rule must exit nonzero, and the full scan must finish in <30s.
+
+graftlint is stdlib-only on purpose; these tests exercise it through
+both the library surface (``run_lint``) and the CLI (``main``).
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python -m pytest` from the checkout has it
+    sys.path.insert(0, REPO)
+
+from tools.graftlint.cli import DEFAULT_BASELINE, main as lint_main
+from tools.graftlint.core import (
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from tools.graftlint.rules import ALL_RULES, RULE_DOCS
+from tools.graftlint.rules.gl001_donation import DonationAfterUse
+from tools.graftlint.rules.gl002_locks import LockDiscipline
+from tools.graftlint.rules.gl003_swallow import SilentSwallow
+from tools.graftlint.rules.gl004_hostsync import HostSyncInHotPath
+from tools.graftlint.rules.gl005_obsgate import ObsZeroOverhead
+from tools.graftlint.rules.gl006_atomic import AtomicCommitDiscipline
+from tools.graftlint.rules.gl007_faults import FaultHookPurity
+
+
+def _fresh_rules():
+    return [
+        DonationAfterUse(),
+        LockDiscipline(),
+        SilentSwallow(),
+        HostSyncInHotPath(),
+        ObsZeroOverhead(),
+        AtomicCommitDiscipline(),
+        FaultHookPurity(),
+    ]
+
+
+def lint_files(tmp_path, files):
+    """Write ``{relpath: source}`` fixtures and lint them with a fresh
+    rule suite (fixture relpaths mirror the real directory names so
+    scope-restricted rules apply exactly as they do on the repo)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    rules = _fresh_rules()
+    res = run_lint(rules, [str(tmp_path)], str(tmp_path))
+    lock = next(r for r in rules if isinstance(r, LockDiscipline))
+    res.findings.extend(lock.order_findings())
+    assert not res.errors, res.errors
+    return res
+
+
+def rule_ids(res):
+    return [f.rule for f in res.findings]
+
+
+# --------------------------------------------------------------------- #
+# GL001 donation-after-use
+# --------------------------------------------------------------------- #
+# Pinned pre-fix shape: PR 3's hardening (CHANGES.md) — CCServable
+# published an ALIAS of the engine's carried summary while the dense
+# superbatch dispatch donated that carry (donate_argnums=(0,)); on
+# TPU/GPU the dispatch invalidates the donated buffer and every reader
+# of the published alias sees garbage.
+GL001_PINNED = {
+    "aggregate/summary.py": """
+    import jax
+
+    def _superbatch_step(summary, xs):
+        return summary, xs
+
+    step = jax.jit(_superbatch_step, donate_argnums=(0,))
+
+    class Engine:
+        def dispatch(self, sblock):
+            out, stacked = step(self._summary, sblock)
+            self.store.publish(self._summary, self._window)
+            self._summary = out
+            return stacked
+    """,
+}
+
+# Factory shape: library/pagerank.py:_build_pr_step returns
+# jax.jit(step, donate_argnums=(0,)); a caller that reads the carry it
+# just donated has the same bug one indirection later.
+GL001_FACTORY = {
+    "library/pagerank.py": """
+    import jax
+
+    def _build_pr_step(n):
+        def step(carry, xs):
+            return carry, 0.0
+        return jax.jit(step, donate_argnums=(0,))
+
+    def run(blocks, carry, emit):
+        step = _build_pr_step(4)
+        for xs in blocks:
+            out, delta = step(carry, xs)
+            emit(carry)
+            carry = out
+    """,
+}
+
+# Near-miss: the blessed idiom rebinds the carry from the call result
+# on the call's own statement — the donated name is dead immediately.
+GL001_NEG = {
+    "aggregate/summary.py": """
+    import jax
+
+    def _step(carry, xs):
+        return carry
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def run(blocks, carry):
+        for xs in blocks:
+            carry = step(carry, xs)
+        return carry
+    """,
+}
+
+
+def test_gl001_pinned_ccservable_alias_fires(tmp_path):
+    res = lint_files(tmp_path, GL001_PINNED)
+    assert "GL001" in rule_ids(res)
+    (f,) = [f for f in res.findings if f.rule == "GL001"]
+    assert "self._summary" in f.message
+    assert f.symbol == "Engine.dispatch"
+
+
+def test_gl001_factory_shape_fires(tmp_path):
+    res = lint_files(tmp_path, GL001_FACTORY)
+    assert "GL001" in rule_ids(res)
+    (f,) = [f for f in res.findings if f.rule == "GL001"]
+    assert "'carry'" in f.message
+
+
+def test_gl001_rebind_idiom_is_clean(tmp_path):
+    res = lint_files(tmp_path, GL001_NEG)
+    assert "GL001" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# GL002 lock discipline
+# --------------------------------------------------------------------- #
+# Pinned shape: StreamServer's documented discipline — every mutation
+# of the worker-shared backlog happens under _lock (PR 5's failover
+# adoption of in-flight entries depends on it). The pre-fix bug class:
+# one method clearing the backlog without the lock the submit path
+# holds.
+GL002_PINNED = {
+    "serving/server.py": """
+    import threading
+    from collections import deque
+
+    class StreamServer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = deque()
+
+        def submit(self, entry):
+            with self._lock:
+                self._pending = deque([entry])
+
+        def drain_all(self):
+            self._pending = deque()
+    """,
+}
+
+GL002_NEG = {
+    "serving/server.py": """
+    import threading
+    from collections import deque
+
+    class StreamServer:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = deque()  # no second thread exists yet
+
+        def submit(self, entry):
+            with self._lock:
+                self._pending = deque([entry])
+
+        def drain_all(self):
+            with self._lock:
+                self._pending = deque()
+    """,
+}
+
+# Lock-order cycle: FailoverServer._plock nests StreamServer._lock
+# (serving/failover.py:promote). The TP adds the one thing the repo
+# must never grow: a path acquiring them in the other order.
+GL002_CYCLE = {
+    "serving/failover.py": """
+    class FailoverServer:
+        def promote(self, primary):
+            with self._plock:
+                with primary._lock:
+                    pass
+    """,
+    "serving/server.py": """
+    class StreamServer:
+        def _settle(self):
+            with self._lock:
+                with self._plock:
+                    pass
+    """,
+}
+
+GL002_CYCLE_NEG = {k: v for k, v in GL002_CYCLE.items()
+                   if k == "serving/failover.py"}
+
+
+def test_gl002_unguarded_write_fires(tmp_path):
+    res = lint_files(tmp_path, GL002_PINNED)
+    assert "GL002" in rule_ids(res)
+    (f,) = [f for f in res.findings if f.rule == "GL002"]
+    assert "_pending" in f.message and "drain_all" in f.message
+
+
+def test_gl002_guarded_and_init_writes_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL002_NEG)
+    assert "GL002" not in rule_ids(res)
+
+
+def test_gl002_lock_order_cycle_fires(tmp_path):
+    res = lint_files(tmp_path, GL002_CYCLE)
+    cyc = [f for f in res.findings if f.rule == "GL002"]
+    assert cyc and any("lock-order cycle" in f.message for f in cyc)
+
+
+def test_gl002_one_direction_nesting_is_clean(tmp_path):
+    res = lint_files(tmp_path, GL002_CYCLE_NEG)
+    assert "GL002" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# GL003 silent-swallow
+# --------------------------------------------------------------------- #
+# Pinned VERBATIM from the pre-fix tree (serving/server.py _ingest
+# finally-block at the commit before this PR): the iterator-close
+# swallow in exactly the worker thread whose death the resilience
+# layer classifies. Fixed in this PR to count serving.swallowed.
+GL003_PINNED = {
+    "serving/server.py": """
+    class StreamServer:
+        def _ingest(self, it):
+            try:
+                pass
+            finally:
+                if self._stop_ingest.is_set():
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:
+                            pass
+                self._ingest_done.set()
+    """,
+}
+
+GL003_NEG = {
+    "serving/server.py": """
+    import queue
+
+    class StreamServer:
+        def _poll(self, q):
+            try:
+                return q.get_nowait()
+            except queue.Empty:
+                pass
+            return None
+
+        def _close_quietly(self, it):
+            try:
+                it.close()
+            except Exception:
+                get_registry().counter(
+                    "serving.swallowed", site="ingest_close"
+                ).inc()
+    """,
+}
+
+
+def test_gl003_pinned_prefix_ingest_swallow_fires(tmp_path):
+    res = lint_files(tmp_path, GL003_PINNED)
+    assert "GL003" in rule_ids(res)
+    (f,) = [f for f in res.findings if f.rule == "GL003"]
+    assert f.symbol == "StreamServer._ingest"
+
+
+def test_gl003_narrow_or_counting_handlers_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL003_NEG)
+    assert "GL003" not in rule_ids(res)
+
+
+def test_gl003_bare_and_tuple_broad_handlers_fire(tmp_path):
+    res = lint_files(tmp_path, {"core/x.py": """
+    def f():
+        try:
+            pass
+        except:
+            pass
+        try:
+            pass
+        except (ValueError, Exception):
+            ...
+    """})
+    assert rule_ids(res).count("GL003") == 2
+
+
+# --------------------------------------------------------------------- #
+# GL004 host-sync-in-hot-path
+# --------------------------------------------------------------------- #
+GL004_SCAN = {
+    "library/anywhere.py": """
+    from jax import lax
+
+    def fold(xs, init):
+        def body(carry, x):
+            v = float(carry.sum())
+            carry.block_until_ready()
+            return carry, v
+        return lax.scan(body, init, xs)
+    """,
+}
+
+GL004_LOOP = {
+    # per-window loop of a named hot module: the PR 2 cliff shape
+    "aggregate/summary.py": """
+    class SummaryAggregation:
+        def run(self, stream):
+            for block in stream:
+                out = self._dispatch(block)
+                out.block_until_ready()
+                yield out
+    """,
+}
+
+GL004_NEG = {
+    # np.asarray in a hot-module loop is the host packing path — NOT
+    # flagged outside scan bodies; .item() in an except handler is a
+    # cold error path; a non-hot module's loop is out of scope.
+    "aggregate/summary.py": """
+    import numpy as np
+
+    def pack(windows):
+        for w in windows:
+            cols = np.asarray(w.cols)
+            yield cols
+    """,
+    "serving/query.py": """
+    def answer_all(batches):
+        for b in batches:
+            yield b.total.item()
+    """,
+    "library/anywhere.py": """
+    from jax import lax
+
+    def fold(xs, init):
+        def body(carry, x):
+            try:
+                return carry, x
+            except Exception as e:
+                raise RuntimeError(str(carry.item())) from e
+        return lax.scan(body, init, xs)
+    """,
+}
+
+
+def test_gl004_scan_body_syncs_fire(tmp_path):
+    res = lint_files(tmp_path, GL004_SCAN)
+    msgs = [f.message for f in res.findings if f.rule == "GL004"]
+    assert len(msgs) == 2
+    assert any("float() on a traced value" in m for m in msgs)
+    assert any(".block_until_ready()" in m for m in msgs)
+
+
+def test_gl004_hot_loop_sync_fires(tmp_path):
+    res = lint_files(tmp_path, GL004_LOOP)
+    assert "GL004" in rule_ids(res)
+
+
+def test_gl004_near_misses_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL004_NEG)
+    assert "GL004" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# GL005 obs zero-overhead
+# --------------------------------------------------------------------- #
+GL005_TP = {
+    # the PR 5 hardening shape: un-gated obs work in the per-window
+    # engine core — including the dominant repo idiom with the
+    # intermediate get_registry() call in the chain
+    "core/window.py": """
+    def pack(cols):
+        get_registry().counter("window.pack_calls").inc()
+        with span("window.pack", {"n": len(cols)}):
+            return cols
+    """,
+}
+
+GL005_NEG = {
+    "core/window.py": """
+    def pack(cols):
+        if _trace.on():
+            get_registry().counter("window.pack_calls").inc()
+        with span("window.pack", {"n": len(cols)} if _trace.on() else None):
+            try:
+                return cols
+            except Exception:
+                get_registry().counter("window.swallowed").inc()
+                raise
+    """,
+    # same un-gated code outside the hot modules is out of scope
+    "library/pagerank.py": """
+    def converge(state):
+        get_registry().counter("pagerank.iters").inc()
+        return state
+    """,
+}
+
+
+def test_gl005_ungated_mutation_and_span_attrs_fire(tmp_path):
+    res = lint_files(tmp_path, GL005_TP)
+    msgs = [f.message for f in res.findings if f.rule == "GL005"]
+    assert len(msgs) == 2
+    assert any("window.pack_calls" in m for m in msgs)
+    assert any("span attrs dict" in m for m in msgs)
+
+
+def test_gl005_gated_and_out_of_scope_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL005_NEG)
+    assert "GL005" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# GL006 atomic-commit discipline
+# --------------------------------------------------------------------- #
+# Pinned VERBATIM from the pre-fix tree (aggregate/checkpoint.py
+# save_aggregation at the commit before this PR): the raw open on the
+# live .pkl name — a kill mid-pickle left a torn artifact. Fixed in
+# this PR via the tmp+replace+CRC helper; the finding is also visible
+# as the GL006 pair in the pre-fix lint run recorded in CHANGES.md.
+GL006_PINNED = {
+    "aggregate/checkpoint.py": """
+    import pickle
+
+    def save_aggregation(path, aggregation):
+        with open(path + ".pkl", "wb") as f:
+            pickle.dump(aggregation._summary, f)
+    """,
+}
+
+GL006_NEG = {
+    "aggregate/checkpoint.py": """
+    import os
+    import pickle
+
+    def save_aggregation(path, aggregation):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(aggregation._summary, f)
+        os.replace(tmp, path + ".pkl")
+
+    def load_aggregation(path):
+        with open(path + ".pkl", "rb") as f:
+            return pickle.load(f)
+    """,
+    # raw binary writes outside the checkpoint/rendezvous modules are
+    # out of scope (bench artifacts, exports, ...)
+    "obs/export.py": """
+    def dump(path, blob):
+        with open(path, "wb") as f:
+            f.write(blob)
+    """,
+}
+
+
+def test_gl006_raw_live_name_open_fires(tmp_path):
+    res = lint_files(tmp_path, GL006_PINNED)
+    assert "GL006" in rule_ids(res)
+    (f,) = [f for f in res.findings if f.rule == "GL006"]
+    assert "torn file" in f.message
+
+
+def test_gl006_tmp_reads_and_out_of_scope_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL006_NEG)
+    assert "GL006" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# GL007 fault-hook purity
+# --------------------------------------------------------------------- #
+GL007_TP = {
+    "core/stream.py": """
+    import os
+    from gelly_streaming_tpu.resilience.faults import InjectedFault
+
+    def die(code):
+        os._exit(code)
+
+    def pretend_crash():
+        raise InjectedFault("window", 3)
+    """,
+}
+
+GL007_NEG = {
+    # the fault-plan modules themselves ARE the blessed location...
+    "resilience/faults.py": """
+    import os
+
+    def fire(site, ordinal):
+        raise InjectedFault(site, ordinal)
+
+    def hard_kill():
+        os._exit(3)
+    """,
+    # ...and calling the hook API is how production code participates
+    "core/stream.py": """
+    from gelly_streaming_tpu.resilience import faults as _faults
+
+    def step(window):
+        if _faults.active():
+            _faults.fire("pipeline.item")
+        return window
+    """,
+}
+
+
+def test_gl007_exit_and_injected_raise_fire(tmp_path):
+    res = lint_files(tmp_path, GL007_TP)
+    assert rule_ids(res).count("GL007") == 2
+
+
+def test_gl007_fault_plan_modules_and_hooks_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL007_NEG)
+    assert "GL007" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# Suppressions: GL000 reason policy
+# --------------------------------------------------------------------- #
+def test_suppression_without_reason_is_gl000_and_does_not_suppress(
+        tmp_path):
+    res = lint_files(tmp_path, {"x.py": """
+    def f():
+        try:
+            pass
+        except Exception:  # graftlint: disable=GL003
+            pass
+    """})
+    ids = rule_ids(res)
+    assert "GL003" in ids and "GL000" in ids
+
+
+def test_reasoned_suppression_suppresses(tmp_path):
+    res = lint_files(tmp_path, {"x.py": """
+    def f():
+        try:
+            pass
+        except Exception:  # graftlint: disable=GL003 (fixture: benign by construction)
+            pass
+    """})
+    assert rule_ids(res) == []
+    assert len(res.suppressed) == 1
+    assert res.suppressed[0][1].reason.startswith("fixture")
+
+
+def test_standalone_suppression_comment_covers_next_line(tmp_path):
+    res = lint_files(tmp_path, {"x.py": """
+    def f():
+        try:
+            pass
+        # graftlint: disable=GL003 (fixture: benign by construction)
+        except Exception:
+            pass
+    """})
+    assert rule_ids(res) == []
+    assert len(res.suppressed) == 1
+
+
+def test_suppression_only_covers_its_rule(tmp_path):
+    res = lint_files(tmp_path, {"x.py": """
+    def f():
+        try:
+            pass
+        except Exception:  # graftlint: disable=GL004 (wrong rule id)
+            pass
+    """})
+    assert "GL003" in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# Baseline
+# --------------------------------------------------------------------- #
+BAD_GL003 = """
+def f():
+    try:
+        pass
+    except Exception:
+        pass
+"""
+
+
+def test_baseline_roundtrip_and_line_drift(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text(BAD_GL003, encoding="utf-8")
+    res = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path))
+    assert len(res.findings) == 1
+
+    bl_path = tmp_path / "baseline.json"
+    assert write_baseline(str(bl_path), res.findings) == 1
+    baseline = load_baseline(str(bl_path))
+
+    res2 = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path),
+                    baseline=baseline)
+    assert res2.findings == [] and len(res2.baselined) == 1
+
+    # an edit ABOVE the grandfathered finding moves its line; the
+    # line-number-free key keeps it grandfathered
+    src.write_text("# a new header comment\n\n" + BAD_GL003,
+                   encoding="utf-8")
+    res3 = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path),
+                    baseline=load_baseline(str(bl_path)))
+    assert res3.findings == [] and len(res3.baselined) == 1
+
+
+def test_baseline_budget_is_per_occurrence(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text(BAD_GL003, encoding="utf-8")
+    res = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path))
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), res.findings)
+
+    # a SECOND identical violation in the same scope exceeds the
+    # grandfathered count and must be reported
+    src.write_text(BAD_GL003 + textwrap.dedent("""
+    def g():
+        try:
+            pass
+        except Exception:
+            pass
+    """), encoding="utf-8")
+    res2 = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path),
+                    baseline=load_baseline(str(bl_path)))
+    assert len(res2.baselined) == 1 and len(res2.findings) == 1
+
+
+def test_gl000_can_never_be_baselined(tmp_path):
+    # a reason-less waiver cannot be grandfathered: write_baseline
+    # drops GL000 entries, and even a hand-written baseline entry for
+    # one is ignored by the budget match
+    src = tmp_path / "m.py"
+    src.write_text(textwrap.dedent("""
+    def f():
+        try:
+            pass
+        except Exception:  # graftlint: disable=GL003
+            pass
+    """), encoding="utf-8")
+    res = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path))
+    gl000 = [f for f in res.findings if f.rule == "GL000"]
+    assert gl000, rule_ids(res)
+
+    bl_path = tmp_path / "baseline.json"
+    assert write_baseline(str(bl_path), gl000) == 0
+
+    forged = {gl000[0].key(): 1}
+    res2 = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path),
+                    baseline=forged)
+    assert "GL000" in rule_ids(res2) and res2.baselined == []
+
+
+def test_write_baseline_refuses_partial_scan_over_default(
+        tmp_path, capsys):
+    # a partial scan sees a subset of findings; writing it over the
+    # repo-wide default baseline would drop every grandfathered entry
+    # outside the given paths — the CLI must refuse (exit 2) and leave
+    # the committed baseline untouched
+    bad = tmp_path / "m.py"
+    bad.write_text(BAD_GL003, encoding="utf-8")
+    before = open(DEFAULT_BASELINE, "rb").read()
+    rc = lint_main(["--root", str(tmp_path), "--write-baseline",
+                    str(bad)])
+    err = capsys.readouterr().err
+    assert rc == 2 and "partial scan" in err
+    assert open(DEFAULT_BASELINE, "rb").read() == before
+
+    # an explicit --baseline path makes the intent scoped and is fine
+    scoped = tmp_path / "scoped.json"
+    rc = lint_main(["--root", str(tmp_path), "--write-baseline",
+                    "--baseline", str(scoped), str(bad)])
+    assert rc == 0 and load_baseline(str(scoped))
+
+
+def test_partial_scan_honors_default_baseline(tmp_path, monkeypatch,
+                                              capsys):
+    # linting ONE grandfathered file must agree with the full run
+    # (exit 0), not resurrect its baselined finding
+    bad = tmp_path / "m.py"
+    bad.write_text(BAD_GL003, encoding="utf-8")
+    rc = lint_main(["--root", str(tmp_path), str(bad)])
+    assert rc == 1  # not yet grandfathered
+    capsys.readouterr()
+
+    bl_path = tmp_path / "baseline.json"
+    res = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path))
+    write_baseline(str(bl_path), res.findings)
+    import tools.graftlint.cli as cli_mod
+    monkeypatch.setattr(cli_mod, "DEFAULT_BASELINE", str(bl_path))
+    rc = lint_main(["--root", str(tmp_path), str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "1 baselined" in out
+
+
+# --------------------------------------------------------------------- #
+# Self-run gate (the CI contract)
+# --------------------------------------------------------------------- #
+def test_repo_is_clean_against_committed_baseline_under_30s(capsys):
+    t0 = time.perf_counter()
+    rc = lint_main([])
+    dt = time.perf_counter() - t0
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 findings" in out
+    assert dt < 30.0, f"self-run took {dt:.1f}s (budget 30s)"
+
+
+def test_committed_baseline_is_loadable():
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert isinstance(baseline, dict)
+
+
+SEEDED = {
+    "GL001": GL001_PINNED,
+    "GL002": GL002_PINNED,
+    "GL003": GL003_PINNED,
+    "GL004": GL004_LOOP,
+    "GL005": GL005_TP,
+    "GL006": GL006_PINNED,
+    "GL007": GL007_TP,
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(SEEDED))
+def test_cli_exits_nonzero_on_seeded_violation(rule_id, tmp_path,
+                                               capsys):
+    files = SEEDED[rule_id]
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+        paths.append(str(p))
+    rc = lint_main(["--json", "--root", str(tmp_path), *paths])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert rule_id in {f["rule"] for f in payload["findings"]}
+
+
+def test_cli_rejects_unknown_rule(capsys):
+    assert lint_main(["--rules", "GL999"]) == 2
+
+
+def test_rule_registry_is_coherent():
+    ids = [r.id for r in ALL_RULES]
+    assert ids == sorted(ids) and len(ids) == len(set(ids)) == 7
+    for rid in ids + ["GL000"]:
+        assert RULE_DOCS[rid]

@@ -8,7 +8,6 @@ from gelly_streaming_tpu.core.window import CountWindow
 from gelly_streaming_tpu.library.iterative_cc import IterativeConnectedComponents
 from gelly_streaming_tpu.library.matching import (
     CentralizedWeightedMatching,
-    MatchingEvent,
     MatchingEventType,
 )
 
@@ -183,7 +182,7 @@ def test_incremental_downgrades_midstream_and_negative_ids():
     icc2 = IterativeConnectedComponents()
     s1 = SimpleEdgeStream([(10, 11, 0.0), (12, 13, 0.0)],
                           window=CountWindow(1))
-    out1 = [list(ch) for ch in icc2.run(s1)]
+    _ = [list(ch) for ch in icc2.run(s1)]
     assert icc2._mode == "incremental"
     s2 = SimpleEdgeStream(
         [(11, 12, 0.0)], window=CountWindow(1), vertex_dict=s1.vertex_dict

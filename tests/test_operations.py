@@ -8,7 +8,6 @@ Order-insensitive comparison, as in the reference's
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from gelly_streaming_tpu import CountWindow, SimpleEdgeStream
 

@@ -162,13 +162,13 @@ def test_pallas_fused_sage_matmul_matches_xla():
     from gelly_streaming_tpu.ops.pallas_kernels import fused_sage_matmul
 
     key = jax.random.PRNGKey(7)
-    V, F, O = 100, 48, 72  # deliberately non-tile-aligned
+    V, F, D = 100, 48, 72  # deliberately non-tile-aligned
     k1, k2, k3, k4, k5 = jax.random.split(key, 5)
     h = jax.random.normal(k1, (V, F), jnp.float32)
     agg = jax.random.normal(k2, (V, F), jnp.float32)
-    ws = jax.random.normal(k3, (F, O), jnp.float32)
-    wn = jax.random.normal(k4, (F, O), jnp.float32)
-    b = jax.random.normal(k5, (O,), jnp.float32)
+    ws = jax.random.normal(k3, (F, D), jnp.float32)
+    wn = jax.random.normal(k4, (F, D), jnp.float32)
+    b = jax.random.normal(k5, (D,), jnp.float32)
     want = jax.nn.relu(h @ ws + agg @ wn + b)
     got = fused_sage_matmul(h, agg, ws, wn, b, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
@@ -200,12 +200,12 @@ def test_gcn_layer_matches_dense_reference():
     from gelly_streaming_tpu.models.gcn import gcn_forward, gcn_layer, init_gcn
 
     rng = np.random.default_rng(6)
-    V, F, O, E = 9, 5, 4, 14
+    V, F, D, E = 9, 5, 4, 14
     src = jnp.asarray(rng.integers(0, V, E), jnp.int32)
     dst = jnp.asarray(rng.integers(0, V, E), jnp.int32)
     mask = jnp.asarray(rng.random(E) < 0.8)
     h = jnp.asarray(rng.normal(size=(V, F)), jnp.float32)
-    params = init_gcn(jax.random.PRNGKey(0), [F, O], dtype=jnp.float32)
+    params = init_gcn(jax.random.PRNGKey(0), [F, D], dtype=jnp.float32)
 
     # dense reference
     A = np.eye(V, dtype=np.float32)
@@ -220,8 +220,8 @@ def test_gcn_layer_matches_dense_reference():
     got = gcn_layer(params[0], h, src, dst, mask, activation=lambda x: x)
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
 
-    out = gcn_forward(init_gcn(jax.random.PRNGKey(1), [F, 8, O], jnp.float32), h, src, dst, mask)
-    assert out.shape == (V, O)
+    out = gcn_forward(init_gcn(jax.random.PRNGKey(1), [F, 8, D], jnp.float32), h, src, dst, mask)
+    assert out.shape == (V, D)
 
 
 def test_gcn_sharded_train_step_with_optax_and_remat():

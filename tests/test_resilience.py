@@ -30,7 +30,7 @@ from gelly_streaming_tpu.resilience import (
     faults,
 )
 from gelly_streaming_tpu.resilience.chaos import digest
-from gelly_streaming_tpu.resilience.errors import SimulatedCrash, StallError
+from gelly_streaming_tpu.resilience.errors import StallError
 from gelly_streaming_tpu.resilience.faults import corrupt_file
 from gelly_streaming_tpu.resilience import integrity
 

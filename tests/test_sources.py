@@ -7,7 +7,6 @@ import threading
 import time
 
 import numpy as np
-import pytest
 
 from gelly_streaming_tpu.core.sources import GeneratorSource, SocketEdgeSource
 from gelly_streaming_tpu.core.stream import SimpleEdgeStream

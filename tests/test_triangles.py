@@ -9,7 +9,6 @@
 """
 
 import numpy as np
-import pytest
 
 from gelly_streaming_tpu.core.stream import SimpleEdgeStream
 from gelly_streaming_tpu.core.window import CountWindow, EventTimeWindow

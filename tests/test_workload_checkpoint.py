@@ -7,7 +7,6 @@ import pytest
 
 from gelly_streaming_tpu.aggregate import checkpoint
 from gelly_streaming_tpu.core.stream import SimpleEdgeStream
-from gelly_streaming_tpu.core.vertexdict import VertexDict
 from gelly_streaming_tpu.core.window import CountWindow, Windower
 from gelly_streaming_tpu.library import (
     BroadcastTriangleCount,

@@ -1,0 +1,26 @@
+"""graftlint: repo-specific static analysis for gelly_streaming_tpu.
+
+Each rule encodes a bug class this codebase has actually shipped (see
+the README "Static analysis" section for the history). Generic lint
+(unused imports, undefined names, style) belongs to ruff — graftlint
+only carries the invariants a generic linter cannot express:
+
+- GL001 donation-after-use (donated jit buffers read after dispatch)
+- GL002 lock discipline (unguarded writes to lock-owned attributes;
+  lock-acquisition-order cycles)
+- GL003 silent-swallow (``except Exception: pass`` hides worker death)
+- GL004 host-sync-in-hot-path (device syncs inside scan bodies /
+  per-window loops)
+- GL005 obs zero-overhead (ungated registry/span work in hot modules)
+- GL006 atomic-commit discipline (raw ``open(path, "wb")`` on
+  checkpoint/rendezvous paths)
+- GL007 fault-hook purity (``os._exit`` / injected raises outside the
+  fault plan)
+
+Run as ``python -m tools.graftlint``; suppress a finding inline with
+``# graftlint: disable=GLxxx (reason)`` — the reason is mandatory
+(GL000 flags reason-less suppressions). Grandfathered findings live in
+``tools/graftlint/baseline.json``; refresh with ``--write-baseline``.
+"""
+
+from .core import Finding, LintModule, Rule, run_lint  # noqa: F401

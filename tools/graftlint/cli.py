@@ -1,0 +1,140 @@
+"""graftlint CLI: human + JSON output, baseline handling, exit codes.
+
+Exit codes: 0 clean (baseline honored), 1 findings, 2 usage/parse
+errors. The CI gate is literally ``python -m tools.graftlint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .core import load_baseline, run_lint, write_baseline
+from .rules import ALL_RULES, RULE_DOCS
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+DEFAULT_ROOTS = ("gelly_streaming_tpu", "bench.py", "tools")
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(__file__), "baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="repo-specific static analysis (rules GL001-GL007; "
+                    "each encodes a bug this codebase has shipped)",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: %s)"
+                        % " ".join(DEFAULT_ROOTS))
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: tools/graftlint/"
+                        "baseline.json when linting the repo)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report grandfathered findings too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="re-grandfather every current finding and exit")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default all)")
+    p.add_argument("--root", default=None,
+                   help="repo root for relative paths (default: the "
+                        "checkout containing this tool)")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list suppressed/baselined findings")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    t0 = time.perf_counter()
+    root = os.path.abspath(args.root) if args.root else REPO_ROOT
+    default_scan = not args.paths
+    roots = [os.path.join(root, p) for p in DEFAULT_ROOTS] \
+        if default_scan else args.paths
+    roots = [r for r in roots if os.path.exists(r)]
+    if not roots:
+        print("graftlint: nothing to lint", file=sys.stderr)
+        return 2
+
+    rules = list(ALL_RULES)
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in rules if r.id in wanted]
+        if not rules:
+            print(f"graftlint: unknown rules {sorted(wanted)}",
+                  file=sys.stderr)
+            return 2
+
+    baseline = None
+    # the default baseline applies to EVERY scan, partial or full —
+    # baseline keys are repo-relative, so linting one grandfathered
+    # file must agree with the full run (exit 0), not resurrect it
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if not args.no_baseline and \
+            not args.write_baseline and os.path.exists(baseline_path):
+        try:
+            baseline = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graftlint: unreadable baseline {baseline_path}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+
+    res = run_lint(rules, roots, root, baseline=baseline)
+
+    if args.write_baseline:
+        if not default_scan and not args.baseline:
+            # a partial scan sees only a subset of findings; writing it
+            # over the repo-wide default would silently drop every
+            # grandfathered entry outside the given paths
+            print("graftlint: refusing --write-baseline for a partial "
+                  "scan over the default baseline — rerun without "
+                  "paths, or pass --baseline <path> for a scoped one",
+                  file=sys.stderr)
+            return 2
+        path = baseline_path
+        n = write_baseline(path, res.findings)
+        print(f"graftlint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to "
+              f"{os.path.relpath(path, root)}")
+        return 0
+
+    dt = time.perf_counter() - t0
+    if args.json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in res.findings],
+            "suppressed": len(res.suppressed),
+            "baselined": len(res.baselined),
+            "errors": res.errors,
+            "elapsed_s": round(dt, 3),
+        }, indent=1, sort_keys=True))
+    else:
+        for f in res.findings:
+            print(f.render())
+        if args.verbose:
+            for f, sup in res.suppressed:
+                print(f"suppressed: {f.render()}  # {sup.reason}")
+            for f in res.baselined:
+                print(f"baselined:  {f.render()}")
+        for e in res.errors:
+            print(f"error: {e}", file=sys.stderr)
+        by_rule = {}
+        for f in res.findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(
+            f"{r} x{n} ({RULE_DOCS.get(r, '?')})"
+            for r, n in sorted(by_rule.items())
+        ) or "clean"
+        print(f"graftlint: {len(res.findings)} finding"
+              f"{'' if len(res.findings) == 1 else 's'} "
+              f"[{summary}] — {len(res.suppressed)} suppressed, "
+              f"{len(res.baselined)} baselined, {dt:.2f}s")
+    if res.errors:
+        return 2
+    return 1 if res.findings else 0

@@ -1,0 +1,336 @@
+"""Rule framework: findings, suppressions, module parsing, the runner.
+
+Design constraints that shaped this file:
+
+- **Stdlib only.** The container bakes no lint toolchain; everything is
+  ``ast`` + ``re`` so the gate runs anywhere the repo imports.
+- **Line-number-free baselining.** A baseline entry keys on
+  ``(rule, path, symbol, message)`` — messages name the offending
+  symbols but never carry line numbers, so an unrelated edit above a
+  grandfathered finding does not resurrect it.
+- **Suppressions carry a reason.** ``# graftlint: disable=GLxxx`` with
+  no ``(reason)`` is itself a finding (GL000): the suppression file IS
+  the documentation of why an invariant is waived, so an empty one is
+  a waiver of nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"\s*(?:\(([^)]*)\))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    col: int
+    symbol: str  # enclosing Class.method qualname ('' at module level)
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        """Baseline identity: everything but the (brittle) line/col."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def render(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}{where}"
+
+
+@dataclass
+class Suppression:
+    line: int  # line the suppression applies to
+    rules: Set[str]
+    reason: str
+    comment_line: int  # where the comment physically sits
+
+
+class LintModule:
+    """One parsed source file plus the lookups every rule needs."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.suppressions = self._scan_suppressions()
+
+    # -- structure ----------------------------------------------------- #
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def symbol(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(anc.name)
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def in_except_handler(self, node: ast.AST) -> bool:
+        return any(isinstance(a, ast.ExceptHandler)
+                   for a in self.ancestors(node))
+
+    # -- suppressions -------------------------------------------------- #
+    def _scan_suppressions(self) -> List[Suppression]:
+        out: List[Suppression] = []
+        for i, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")}
+            reason = (m.group(2) or "").strip()
+            target = i
+            if text.lstrip().startswith("#"):
+                # standalone comment line: applies to the next
+                # non-blank, non-comment source line
+                for j in range(i, len(self.lines)):
+                    nxt = self.lines[j].strip()
+                    if nxt and not nxt.startswith("#"):
+                        target = j + 1
+                        break
+            out.append(Suppression(target, rules, reason, i))
+        return out
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            symbol=self.symbol(node),
+            message=message,
+        )
+
+
+class Rule:
+    """A single GLxxx check. Subclasses set ``id``/``title`` and
+    implement :meth:`check`. ``scope_suffixes`` (when non-empty)
+    restricts the rule to files whose repo-relative path ends with one
+    of the suffixes — fixtures reproduce scoping by mirroring the
+    directory names."""
+
+    id: str = "GL000"
+    title: str = ""
+    scope_suffixes: Tuple[str, ...] = ()
+
+    def applies(self, mod: LintModule) -> bool:
+        if not self.scope_suffixes:
+            return True
+        return mod.relpath.endswith(self.scope_suffixes)
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Drop any cross-module state. :func:`run_lint` calls this
+        before the first file and again after :meth:`finalize`, so the
+        shared ``ALL_RULES`` instances are safe to reuse across runs."""
+
+    def finalize(self) -> Iterator[Finding]:
+        """Findings that need the whole-scan view (cross-module
+        graphs). :func:`run_lint` collects these after every file's
+        :meth:`check` ran and routes them through the same
+        suppression/baseline matching as per-file findings."""
+        return iter(())
+
+
+# ---------------------------------------------------------------------- #
+# AST helpers shared by the rules
+# ---------------------------------------------------------------------- #
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def last_attr(name: Optional[str]) -> Optional[str]:
+    return None if name is None else name.rsplit(".", 1)[-1]
+
+
+# ---------------------------------------------------------------------- #
+# Runner
+# ---------------------------------------------------------------------- #
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, Suppression]] = field(
+        default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    errors: List[str] = field(default_factory=list)
+
+
+def iter_python_files(roots: Iterable[str], repo_root: str
+                      ) -> Iterator[Tuple[str, str]]:
+    for root in roots:
+        root = os.path.abspath(root)
+        if os.path.isfile(root):
+            yield root, os.path.relpath(root, repo_root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    yield full, os.path.relpath(full, repo_root)
+
+
+def run_lint(
+    rules: Iterable[Rule],
+    roots: Iterable[str],
+    repo_root: str,
+    baseline: Optional[Dict[Tuple[str, str, str, str], int]] = None,
+) -> LintResult:
+    res = LintResult()
+    rules = list(rules)
+    for rule in rules:
+        rule.reset()
+    budget = dict(baseline) if baseline else {}
+    mods: Dict[str, LintModule] = {}
+    for path, rel in iter_python_files(roots, repo_root):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            mod = LintModule(path, rel, source)
+        except (OSError, SyntaxError, ValueError) as e:
+            res.errors.append(f"{rel}: unparseable: {e}")
+            continue
+        mods[mod.relpath] = mod
+        found: List[Finding] = []
+        for rule in rules:
+            if not rule.applies(mod):
+                continue
+            try:
+                found.extend(rule.check(mod))
+            except Exception as e:  # a broken rule must not hide others
+                res.errors.append(f"{rel}: {rule.id} crashed: {e!r}")
+        # reason-less suppressions are findings themselves
+        for sup in mod.suppressions:
+            if not sup.reason:
+                found.append(Finding(
+                    "GL000", mod.relpath, sup.comment_line, 1,
+                    "",
+                    "suppression of %s has no (reason) — a waiver "
+                    "must say why" % ",".join(sorted(sup.rules)),
+                ))
+        for f in found:
+            _route(res, budget, mod, f)
+    # whole-scan findings (e.g. GL002's cross-module lock-order graph)
+    # get the SAME suppression/baseline routing as per-file ones
+    for rule in rules:
+        try:
+            finals = list(rule.finalize())
+        except Exception as e:
+            res.errors.append(f"{rule.id} finalize crashed: {e!r}")
+            continue
+        for f in finals:
+            _route(res, budget, mods.get(f.path), f)
+    for rule in rules:
+        rule.reset()  # drop retained modules/ASTs between runs
+    res.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return res
+
+
+def _route(res: LintResult,
+           budget: Dict[Tuple[str, str, str, str], int],
+           mod: Optional[LintModule], f: Finding) -> None:
+    sup = _matching_suppression(mod, f) if mod is not None else None
+    if sup is not None:
+        res.suppressed.append((f, sup))
+    elif f.rule != "GL000" and budget.get(f.key(), 0) > 0:
+        budget[f.key()] -= 1
+        res.baselined.append(f)
+    else:
+        res.findings.append(f)
+
+
+def _matching_suppression(mod: LintModule, f: Finding
+                          ) -> Optional[Suppression]:
+    if f.rule == "GL000":  # the meta-rule cannot be suppressed
+        return None
+    for sup in mod.suppressions:
+        if sup.line == f.line and f.rule in sup.rules and sup.reason:
+            return sup
+    return None
+
+
+# ---------------------------------------------------------------------- #
+# Baseline I/O
+# ---------------------------------------------------------------------- #
+def load_baseline(path: str) -> Dict[Tuple[str, str, str, str], int]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str, str], int] = {}
+    for entry in data.get("findings", []):
+        key = (entry["rule"], entry["path"], entry.get("symbol", ""),
+               entry["message"])
+        out[key] = out.get(key, 0) + int(entry.get("count", 1))
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> int:
+    counts: Dict[Tuple[str, str, str, str], int] = {}
+    for f in findings:
+        if f.rule == "GL000":
+            # a reason-less waiver can never itself be waived — not by
+            # suppression (enforced in _matching_suppression) and not
+            # by grandfathering either
+            continue
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {"rule": k[0], "path": k[1], "symbol": k[2], "message": k[3],
+         "count": n}
+        for k, n in sorted(counts.items())
+    ]
+    payload = {
+        "comment": "grandfathered graftlint findings; refresh with "
+                   "`python -m tools.graftlint --write-baseline`. "
+                   "New code must be clean — entries here only ever "
+                   "shrink.",
+        "findings": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(entries)

@@ -1,0 +1,23 @@
+"""Rule registry: ALL_RULES is the suite ``python -m tools.graftlint``
+runs. Order is the reporting order inside a line tie."""
+
+from .gl001_donation import DonationAfterUse
+from .gl002_locks import LockDiscipline
+from .gl003_swallow import SilentSwallow
+from .gl004_hostsync import HostSyncInHotPath
+from .gl005_obsgate import ObsZeroOverhead
+from .gl006_atomic import AtomicCommitDiscipline
+from .gl007_faults import FaultHookPurity
+
+ALL_RULES = (
+    DonationAfterUse(),
+    LockDiscipline(),
+    SilentSwallow(),
+    HostSyncInHotPath(),
+    ObsZeroOverhead(),
+    AtomicCommitDiscipline(),
+    FaultHookPurity(),
+)
+
+RULE_DOCS = {r.id: r.title for r in ALL_RULES}
+RULE_DOCS["GL000"] = "graftlint suppression without a reason"

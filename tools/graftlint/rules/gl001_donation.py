@@ -1,0 +1,250 @@
+"""GL001 — donation-after-use.
+
+The shipped bug: ``CCServable._payload`` published an ALIAS of the
+engine's carried summary while ``_superbatch_step`` donated that carry
+to the next dispatch (``donate_argnums=(0,)``) — on TPU/GPU the dispatch
+invalidates the donated buffer and every reader of the alias sees
+garbage (fixed in the PR 3 hardening pass;
+``aggregate/summary.py:_superbatch_step`` documents the discipline).
+
+The invariant: a value passed at a donated position of a
+``jax.jit(..., donate_argnums=...)`` callable is DEAD afterwards. This
+rule finds, per module:
+
+1. donating callables — ``@jax.jit``/``functools.partial(jax.jit, ...)``
+   decorated defs with ``donate_argnums``, names bound to
+   ``jax.jit(fn, donate_argnums=...)``, and names bound to a local
+   factory whose ``return`` is such a ``jax.jit`` call (the
+   ``library/pagerank.py:_build_pr_step`` shape);
+2. call sites of those callables where a donated position receives a
+   plain name (or tuple of names / dotted attribute);
+3. any LOAD of that name after the call in the same function body with
+   no intervening rebind. Rebinds on the call's own statement
+   (``carry = step(carry, ...)``) are the blessed idiom and clear the
+   name.
+
+Linear-by-line within one function body: control flow is not modeled,
+which is exactly the right paranoia level for buffers whose liveness
+must be obvious to a reviewer anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, LintModule, Rule, call_name, dotted
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positions from a jax.jit(...) call, None when the call
+    does not donate. Non-literal donate_argnums (the conditional
+    ``(0,) if donated else ()`` shape) conservatively reads as the
+    positions of every integer literal found inside the expression."""
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            ints = [n.value for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)
+                    and not isinstance(n.value, bool)]
+            return tuple(sorted(set(ints))) if ints else (0,)
+    return None
+
+
+def _is_jax_jit(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name in ("jax.jit", "jit")
+
+
+def _jit_call_in(node: ast.AST) -> Optional[ast.Call]:
+    """The jax.jit(...) call expressed by ``node``: the call itself, or
+    ``functools.partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jax_jit(node):
+        return node
+    name = call_name(node)
+    if name in ("functools.partial", "partial") and node.args:
+        first = node.args[0]
+        if isinstance(first, (ast.Name, ast.Attribute)) and \
+                dotted(first) in ("jax.jit", "jit"):
+            return node
+    return None
+
+
+class DonationAfterUse(Rule):
+    id = "GL001"
+    title = "donated jit buffer read after the donating dispatch"
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        donating = self._collect_donating(mod)
+        if not donating:
+            return
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(mod, fn, donating)
+
+    # -- pass 1: who donates ------------------------------------------ #
+    def _collect_donating(self, mod: LintModule
+                          ) -> Dict[str, Tuple[int, ...]]:
+        """name -> donated positions. Keys are bare callable names; an
+        attribute call ``self._step(...)`` matches on ``_step``."""
+        donating: Dict[str, Tuple[int, ...]] = {}
+        factories: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    jit = _jit_call_in(dec)
+                    if jit is None:
+                        continue
+                    pos = _donate_positions(jit)
+                    if pos is not None:
+                        donating[node.name] = pos
+                # factory shape: `return jax.jit(fn, donate_argnums=..)`
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) and \
+                            isinstance(sub.value, ast.Call) and \
+                            _is_jax_jit(sub.value):
+                        pos = _donate_positions(sub.value)
+                        if pos is not None:
+                            factories[node.name] = pos
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                jit = _jit_call_in(node.value)
+                pos = None
+                if jit is not None:
+                    pos = _donate_positions(jit)
+                else:  # name = donating_factory(...)
+                    fac = call_name(node.value)
+                    if fac is not None:
+                        pos = factories.get(fac.rsplit(".", 1)[-1])
+                if pos is None:
+                    continue
+                for tgt in node.targets:
+                    name = dotted(tgt)
+                    if name is not None:
+                        donating[name.rsplit(".", 1)[-1]] = pos
+        # second sweep: assignments from factories defined later in the
+        # module than the assignment (class bodies above helpers)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                fac = call_name(node.value)
+                if fac is None:
+                    continue
+                pos = factories.get(fac.rsplit(".", 1)[-1])
+                if pos is None:
+                    continue
+                for tgt in node.targets:
+                    name = dotted(tgt)
+                    if name is not None:
+                        donating.setdefault(name.rsplit(".", 1)[-1], pos)
+        return donating
+
+    # -- pass 2: donated-name liveness -------------------------------- #
+    def _check_function(self, mod: LintModule, fn, donating
+                        ) -> Iterator[Finding]:
+        own_nested = {
+            n for sub in ast.walk(fn)
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and sub is not fn
+            for n in ast.walk(sub)
+        }
+
+        calls: List[Tuple[ast.Call, str, Set[str]]] = []
+        loads: List[Tuple[str, ast.AST]] = []
+        stores: List[Tuple[str, int]] = []
+        for node in ast.walk(fn):
+            if node in own_nested:
+                continue  # nested defs have their own timeline
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                if cname is None:
+                    continue
+                short = cname.rsplit(".", 1)[-1]
+                pos = donating.get(short)
+                if pos is None:
+                    continue
+                donated: Set[str] = set()
+                for p in pos:
+                    if p < len(node.args):
+                        donated |= self._arg_names(node.args[p])
+                if donated:
+                    calls.append((node, short, donated))
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.append((node.id, node))
+                else:
+                    stores.append((node.id, node.lineno))
+            elif isinstance(node, ast.Attribute):
+                name = dotted(node)
+                if name is None:
+                    continue
+                if isinstance(node.ctx, ast.Load):
+                    # only track full dotted loads we might have donated
+                    loads.append((name, node))
+                else:
+                    stores.append((name, node.lineno))
+
+        for call, cname, donated in calls:
+            # the rebind window is the whole enclosing STATEMENT: a
+            # multi-line tuple assign puts its targets on lines before
+            # the call ((a, b) = f(a, b) spanning lines)
+            stmt = call
+            for anc in mod.ancestors(call):
+                if isinstance(anc, ast.stmt):
+                    stmt = anc
+                    break
+            start = stmt.lineno
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            # a rebind on the call's own statement (carry = f(carry))
+            rebound_here = {n for n, ln in stores
+                            if start <= ln <= end}
+            for name in sorted(donated - rebound_here):
+                hit = self._first_live_load(
+                    name, end, loads, stores, call)
+                if hit is not None:
+                    yield mod.finding(
+                        "GL001", hit,
+                        f"'{name}' was donated to '{cname}' "
+                        f"(donate_argnums) and read again — the "
+                        f"dispatch invalidates the buffer on "
+                        f"TPU/GPU; copy before donating or rebind "
+                        f"from the call result",
+                    )
+
+    @staticmethod
+    def _arg_names(arg: ast.AST) -> Set[str]:
+        """Names donated by one argument expression: a bare name, a
+        dotted attribute, or a tuple/list of those."""
+        out: Set[str] = set()
+        items = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) \
+            else [arg]
+        for item in items:
+            name = dotted(item)
+            if name is not None:
+                out.add(name)
+        return out
+
+    @staticmethod
+    def _first_live_load(name: str, after_line: int, loads, stores,
+                         call: ast.Call) -> Optional[ast.AST]:
+        """The first load of ``name`` strictly after ``after_line`` not
+        preceded by an intervening store. Loads that are part of the
+        donating call expression itself do not count."""
+        in_call = set(ast.walk(call))
+        candidates = sorted(
+            (node.lineno, node) for n, node in loads
+            if n == name and node.lineno > after_line
+            and node not in in_call
+        )
+        for line, node in candidates:
+            # strictly-before only: in `x = g(x)` the load on the RHS
+            # executes before the store rebinds, so a same-line store
+            # does not save it
+            killed = any(s == name and after_line < ln < line
+                         for s, ln in stores)
+            if killed:
+                return None
+            return node
+        return None

@@ -1,0 +1,240 @@
+"""GL002 — lock discipline.
+
+Two checks over classes that own a ``threading.Lock``/``RLock``/
+``Condition``:
+
+1. **Guarded-attribute inference.** Any ``self.X`` the class writes
+   inside a ``with self.<lock>:`` block is lock-guarded; a write to the
+   same attribute outside that lock (``__init__`` excepted — no second
+   thread exists yet) is a finding. This is the discipline
+   ``serving/server.py`` documents on ``_pending``/``_inflight``: the
+   PR 5 failover work only stayed correct because every mutation of the
+   in-flight bookkeeping happens under ``_lock``.
+
+2. **Acquisition-order graph.** Every lexically nested
+   ``with <lock A>: ... with <lock B>:`` contributes an A→B edge; a
+   cycle in the per-package graph is a static deadlock candidate.
+   ``FailoverServer._plock`` nests ``StreamServer._lock``
+   (``serving/failover.py:promote``) — the day any code path acquires
+   them in the other order, two threads deadlock. Nodes are keyed by
+   attribute name within one top-level package directory (``serving/``,
+   ``obs/``, ...): ``primary._lock`` IS ``StreamServer._lock``, which
+   exactly the attr-name key captures.
+
+The order graph is accumulated across modules by the runner calling
+:meth:`check` per file; :meth:`finalize` reports cycles once per
+package at the end (``run_lint`` drives this, and routes the findings
+through the same suppression/baseline matching as per-file ones).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, LintModule, Rule, call_name, dotted
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+
+def _lock_expr_attr(node: ast.AST) -> Optional[str]:
+    """The lock attribute name acquired by a with-item context expr:
+    ``self._lock`` / ``primary._lock`` -> ``_lock``; bare module-level
+    ``_lock`` -> ``_lock``. None for non-lock-shaped expressions."""
+    name = dotted(node)
+    if name is None:
+        return None
+    short = name.rsplit(".", 1)[-1]
+    if "lock" in short.lower() or short in ("_mu", "_cond", "_condition"):
+        return short
+    return None
+
+
+class LockDiscipline(Rule):
+    id = "GL002"
+    title = "unguarded write to a lock-guarded attribute / lock-order cycle"
+
+    def __init__(self):
+        # package -> list of (edge, module, node) accumulated across
+        # check() calls; order_findings() consumes it
+        self._edges: Dict[str, List[Tuple[Tuple[str, str], LintModule,
+                                          ast.AST]]] = {}
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, node)
+        self._collect_edges(mod)
+
+    # -- guarded attributes ------------------------------------------- #
+    def _check_class(self, mod: LintModule, cls: ast.ClassDef
+                     ) -> Iterator[Finding]:
+        lock_attrs = self._own_locks(cls)
+        if not lock_attrs:
+            return
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        guarded: Set[str] = set()
+        # pass 1: attributes written under any owned lock
+        for m in methods:
+            for w in self._with_lock_blocks(m, lock_attrs):
+                for sub in ast.walk(w):
+                    attr = self._self_attr_write(sub)
+                    if attr is not None:
+                        guarded.add(attr)
+        guarded -= lock_attrs
+        if not guarded:
+            return
+        # pass 2: writes to guarded attributes outside every owned lock
+        for m in methods:
+            if m.name == "__init__":
+                continue  # no concurrent reader can exist yet
+            locked_nodes: Set[ast.AST] = set()
+            for w in self._with_lock_blocks(m, lock_attrs):
+                locked_nodes |= set(ast.walk(w))
+            for sub in ast.walk(m):
+                if sub in locked_nodes:
+                    continue
+                attr = self._self_attr_write(sub)
+                if attr in guarded:
+                    yield mod.finding(
+                        "GL002", sub,
+                        f"'{cls.name}.{attr}' is written under "
+                        f"'self.{self._guard_name(cls, lock_attrs)}' "
+                        f"elsewhere but written here without it "
+                        f"(method '{m.name}')",
+                    )
+
+    @staticmethod
+    def _guard_name(cls: ast.ClassDef, lock_attrs: Set[str]) -> str:
+        return sorted(lock_attrs)[0] if len(lock_attrs) == 1 else \
+            "/".join(sorted(lock_attrs))
+
+    @staticmethod
+    def _own_locks(cls: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    call_name(node.value) in _LOCK_CTORS:
+                for tgt in node.targets:
+                    name = dotted(tgt)
+                    if name is not None and name.startswith("self."):
+                        out.add(name.split(".", 1)[1])
+        return out
+
+    @staticmethod
+    def _with_lock_blocks(fn, lock_attrs: Set[str]) -> Iterator[ast.With]:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    name = dotted(item.context_expr)
+                    if name is not None and name.startswith("self.") and \
+                            name.split(".", 1)[1] in lock_attrs:
+                        yield node
+                        break
+
+    @staticmethod
+    def _self_attr_write(node: ast.AST) -> Optional[str]:
+        tgt = None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                name = dotted(t)
+                if name is not None and name.startswith("self.") and \
+                        name.count(".") == 1:
+                    tgt = name.split(".", 1)[1]
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            name = dotted(node.target)
+            if name is not None and name.startswith("self.") and \
+                    name.count(".") == 1:
+                tgt = name.split(".", 1)[1]
+        return tgt
+
+    # -- acquisition-order graph -------------------------------------- #
+    def _package(self, mod: LintModule) -> str:
+        parts = mod.relpath.split("/")
+        return "/".join(parts[:-1]) if len(parts) > 1 else "."
+
+    def _collect_edges(self, mod: LintModule) -> None:
+        pkg = self._package(mod)
+        withs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.With, ast.AsyncWith))]
+        for outer in withs:
+            o = self._lock_of(outer)
+            if o is None:
+                continue
+            for inner in ast.walk(outer):
+                if inner is outer or not isinstance(
+                        inner, (ast.With, ast.AsyncWith)):
+                    continue
+                i = self._lock_of(inner)
+                if i is not None and i != o:
+                    self._edges.setdefault(pkg, []).append(
+                        ((o, i), mod, inner))
+
+    @staticmethod
+    def _lock_of(node) -> Optional[str]:
+        for item in node.items:
+            attr = _lock_expr_attr(item.context_expr)
+            if attr is not None:
+                return attr
+        return None
+
+    def finalize(self) -> Iterator[Finding]:
+        return self.order_findings()
+
+    def order_findings(self) -> Iterator[Finding]:
+        """Cycle detection over the accumulated per-package graphs.
+        Call after every module's :meth:`check` ran."""
+        for pkg, entries in sorted(self._edges.items()):
+            graph: Dict[str, Set[str]] = {}
+            for (a, b), _, _ in entries:
+                graph.setdefault(a, set()).add(b)
+            cyc = _find_cycle(graph)
+            if cyc is None:
+                continue
+            cyc_edges = set(zip(cyc, cyc[1:]))
+            for (a, b), mod, node in entries:
+                if (a, b) in cyc_edges:
+                    yield mod.finding(
+                        "GL002", node,
+                        f"lock-order cycle in {pkg}/: "
+                        + " -> ".join(cyc)
+                        + " (this acquisition closes the loop; pick "
+                        "ONE global order)",
+                    )
+
+    def reset(self) -> None:
+        self._edges.clear()
+
+
+def _find_cycle(graph: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """Any one cycle as [a, b, ..., a], else None."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color.get(m, WHITE) == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color.get(m, WHITE) == WHITE:
+                got = dfs(m)
+                if got is not None:
+                    return got
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got is not None:
+                return got
+    return None

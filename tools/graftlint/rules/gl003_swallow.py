@@ -1,0 +1,72 @@
+"""GL003 — silent-swallow.
+
+The shipped bugs: PR 4's hardening found an ``except`` tuple-unpack
+that silently killed the serving worker; this PR's audit found the
+worker-thread teardown paths (``serving/server.py``,
+``core/pipeline.py``, ``resilience/coordinated.py``) swallowing ANY
+exception with a bare ``pass`` — in exactly the threads whose deaths
+the resilience layer exists to classify.
+
+The invariant: a broad handler (``except:``, ``except Exception:``,
+``except BaseException:``, or a tuple containing either) may not have a
+body that does nothing. Doing *something* means counting a named
+registry event (``get_registry().counter("...swallowed", site=...)``)
+or re-raising through the ``resilience/errors.py`` taxonomy; a
+genuinely benign swallow keeps a reasoned inline suppression instead.
+
+Narrow handlers (``except queue.Empty: pass``) are fine — they name
+exactly what they expect.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintModule, Rule, last_attr, dotted
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if last_attr(dotted(n)) in _BROAD:
+            return True
+    return False
+
+
+def _body_does_nothing(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant) and \
+                stmt.value.value is Ellipsis:
+            continue
+        if isinstance(stmt, ast.Continue):
+            continue
+        return False
+    return True
+
+
+class SilentSwallow(Rule):
+    id = "GL003"
+    title = "broad except handler that swallows without evidence"
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _body_does_nothing(node):
+                caught = "bare except" if node.type is None else \
+                    f"except {ast.unparse(node.type)}"
+                yield mod.finding(
+                    "GL003", node,
+                    f"{caught} swallows silently — count a registry "
+                    f"event (e.g. counter('...swallowed', site=...)) "
+                    f"or classify via resilience/errors.py",
+                )

@@ -1,0 +1,127 @@
+"""GL004 — host-sync-in-hot-path.
+
+The bug class: one ``.item()`` / ``block_until_ready`` / host round
+trip inside a per-window loop serializes the async dispatch pipeline —
+the exact cliff PR 2's superbatch work flattened (208k -> 5.99M eps at
+1024-edge windows). A host sync inside a ``lax.scan`` body is worse:
+it either crashes on the tracer or silently forces a re-trace.
+
+Two scopes:
+
+1. **scan bodies, any module**: a function passed as the first argument
+   to ``lax.scan`` may not call ``.item()``, ``.block_until_ready()``,
+   ``np.asarray``/``jax.device_get``, or ``float()``/``int()`` on a
+   non-literal (everything in a scan body is traced).
+2. **per-window loops of the named hot modules**
+   (``aggregate/summary.py``, ``core/window.py``,
+   ``summaries/forest.py``): ``for``/``while`` bodies may not call
+   ``.item()`` / ``.block_until_ready()`` / ``jax.device_get`` —
+   these are unconditional device syncs. ``np.asarray``/``float`` are
+   NOT flagged there: the host packing path uses them on host data by
+   design, and the rule cannot see types.
+
+Exempt: except handlers (error paths are cold).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import Finding, LintModule, Rule, call_name
+
+HOT_MODULES = (
+    "aggregate/summary.py",
+    "core/window.py",
+    "summaries/forest.py",
+)
+
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_SYNC_CALLS = {"jax.device_get", "device_get", "jax.block_until_ready"}
+_SCAN_ONLY_CALLS = {"np.asarray", "numpy.asarray", "onp.asarray",
+                    "jnp.asarray"}
+
+
+def _scan_body_names(mod: LintModule) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                call_name(node) in ("lax.scan", "jax.lax.scan", "scan"):
+            if node.args and isinstance(node.args[0], ast.Name):
+                out.add(node.args[0].id)
+    return out
+
+
+def _sync_call_kind(node: ast.Call, in_scan: bool) -> str:
+    """'' when the call is not a host sync in this context."""
+    name = call_name(node)
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _SYNC_ATTRS and not node.args:
+        return f".{node.func.attr}()"
+    if name in _SYNC_CALLS:
+        return name
+    if in_scan:
+        if name in _SCAN_ONLY_CALLS:
+            return name
+        if name in ("float", "int") and node.args and not isinstance(
+                node.args[0], ast.Constant):
+            return f"{name}() on a traced value"
+    return ""
+
+
+class HostSyncInHotPath(Rule):
+    id = "GL004"
+    title = "host synchronization inside a scan body / per-window loop"
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        scan_bodies = _scan_body_names(mod)
+        hot_module = mod.relpath.endswith(HOT_MODULES)
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name in scan_bodies:
+                yield from self._check_scope(
+                    mod, fn, in_scan=True,
+                    where=f"lax.scan body '{fn.name}'")
+        if hot_module:
+            yield from self._check_hot_loops(mod)
+
+    def _check_scope(self, mod: LintModule, scope, in_scan: bool,
+                     where: str) -> Iterator[Finding]:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.in_except_handler(node):
+                continue
+            kind = _sync_call_kind(node, in_scan)
+            if kind:
+                yield mod.finding(
+                    "GL004", node,
+                    f"{kind} inside {where} forces a host sync — "
+                    f"keep the hot path async (move the read to the "
+                    f"emission/consumer side)",
+                )
+
+    def _check_hot_loops(self, mod: LintModule) -> Iterator[Finding]:
+        seen: Set[ast.AST] = set()
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            fn = mod.enclosing_function(loop)
+            if fn is None:
+                continue
+            for node in ast.walk(loop):
+                if node in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(node)
+                if mod.in_except_handler(node):
+                    continue
+                kind = _sync_call_kind(node, in_scan=False)
+                if kind:
+                    yield mod.finding(
+                        "GL004", node,
+                        f"{kind} inside the per-window loop of "
+                        f"'{fn.name}' forces a host sync — "
+                        f"keep the hot path async",
+                    )

@@ -1,0 +1,159 @@
+"""GL005 — obs zero-overhead.
+
+The README's observability contract: disabled overhead ≈ 0 (the PR 3
+bench pinned -0.6% on the 1M-edge identity path). That bound is only
+structural if hot-path modules never do obs work unconditionally —
+PR 5's hardening already had to chase dead memoization and un-gated
+calls back out of the tree.
+
+In the hot modules (the per-window engine core), this rule flags:
+
+1. a registry mutation chain
+   (``...counter(...)/gauge(...)/histogram(...)`` followed by
+   ``.inc()/.set()/.observe()/.add()``) that is not lexically inside a
+   gate — an ``if`` whose test calls ``.on()`` / ``.enabled()`` (or a
+   local alias ``obs = _trace.on()``), and not inside an except
+   handler (error paths are cold by definition);
+2. a ``span(...)`` call whose attrs argument builds a dict
+   unconditionally — the blessed idiom is
+   ``{"k": v} if _trace.on() else None`` (the no-op span itself is
+   free; the attrs dict is the allocation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import Finding, LintModule, Rule, call_name, last_attr
+
+HOT_MODULES = (
+    "core/window.py",
+    "core/stream.py",
+    "core/pipeline.py",
+    "core/emission.py",
+    "core/edgeblock.py",
+    "aggregate/summary.py",
+    "summaries/forest.py",
+    "library/connected_components.py",
+)
+
+_MUTATORS = {"inc", "set", "observe", "add", "record"}
+_FACTORIES = {"counter", "gauge", "histogram"}
+_GATES = {"on", "enabled"}
+
+
+def _gate_aliases(fn) -> Set[str]:
+    """Local names bound from a gate call: ``obs = _trace.on()``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                last_attr(call_name(node.value)) in _GATES:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _test_is_gate(test: ast.AST, aliases: Set[str]) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and \
+                last_attr(call_name(node)) in _GATES:
+            return True
+        if isinstance(node, ast.Name) and node.id in aliases:
+            return True
+    return False
+
+
+class ObsZeroOverhead(Rule):
+    id = "GL005"
+    title = "ungated obs work in a hot-path module"
+    scope_suffixes = HOT_MODULES
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            aliases = _gate_aliases(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._gated(mod, node, aliases) or \
+                        mod.in_except_handler(node):
+                    continue
+                yield from self._check_mutation(mod, node)
+                yield from self._check_span(mod, node, aliases)
+
+    @staticmethod
+    def _gated(mod: LintModule, node: ast.AST, aliases: Set[str]
+               ) -> bool:
+        """Inside the body of an ``if <gate>:`` (not its orelse)."""
+        child = node
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.If) and \
+                    _test_is_gate(anc.test, aliases):
+                if child not in anc.orelse:
+                    return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            child = anc
+        return False
+
+    def _check_mutation(self, mod: LintModule, node: ast.Call
+                        ) -> Iterator[Finding]:
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and isinstance(node.func.value, ast.Call)):
+            return
+        factory = node.func.value
+        # match the factory by its terminal attribute so the dominant
+        # repo idiom `get_registry().counter(...).inc()` is seen too
+        # (an intermediate Call breaks the plain dotted-name lookup)
+        if isinstance(factory.func, ast.Attribute):
+            fname = factory.func.attr
+        elif isinstance(factory.func, ast.Name):
+            fname = factory.func.id
+        else:
+            fname = last_attr(call_name(factory))
+        if fname not in _FACTORIES:
+            return
+        metric = ""
+        if factory.args and isinstance(factory.args[0], ast.Constant):
+            metric = f" ('{factory.args[0].value}')"
+        yield mod.finding(
+            "GL005", node,
+            f"registry {fname} mutation"
+            f"{metric} is not gated on obs being enabled — wrap in "
+            f"'if _trace.on():' so the disabled path stays free",
+        )
+
+    def _check_span(self, mod: LintModule, node: ast.Call,
+                    aliases: Set[str]) -> Iterator[Finding]:
+        if last_attr(call_name(node)) != "span":
+            return
+        attrs = None
+        if len(node.args) >= 2:
+            attrs = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "attrs":
+                    attrs = kw.value
+        if attrs is None:
+            return  # name-only span: the no-op singleton is free
+        if isinstance(attrs, ast.Constant) and attrs.value is None:
+            return
+        if isinstance(attrs, ast.IfExp) and \
+                _test_is_gate(attrs.test, aliases) and \
+                isinstance(attrs.orelse, ast.Constant) and \
+                attrs.orelse.value is None:
+            return  # the blessed `{...} if _trace.on() else None`
+        if isinstance(attrs, ast.Name):
+            return  # prebuilt under some gate we cannot see; allow
+        yield mod.finding(
+            "GL005", node,
+            "span attrs dict is built unconditionally — use "
+            "'{...} if _trace.on() else None' so disabled runs "
+            "allocate nothing",
+        )

@@ -1,0 +1,79 @@
+"""GL006 — atomic-commit discipline.
+
+The resilience layer's whole recovery guarantee (PR 4) rests on
+checkpoint/rendezvous artifacts being either fully committed or
+invisible: write to a tmp name, ``os.replace`` into place, CRC the
+content (``resilience/integrity.py``). A raw ``open(path, "wb")`` on
+the live name re-opens the torn-file window the chaos sweep exists to
+prove closed.
+
+In the checkpoint/rendezvous modules, ``open(X, "wb")`` (or ``"xb"``)
+is flagged unless X is tmp-shaped: a name containing ``tmp``, or an
+expression whose string literals contain ``tmp`` (``path + ".tmp"``,
+f-strings). Route everything else through the integrity helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintModule, Rule, call_name, dotted
+
+CKPT_MODULES = (
+    "aggregate/checkpoint.py",
+    "aggregate/autockpt.py",
+    "resilience/coordinated.py",
+    "resilience/supervisor.py",
+    "resilience/integrity.py",
+    "parallel/multihost.py",
+)
+
+
+def _is_tmp_shaped(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and "tmp" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and "tmp" in node.attr.lower():
+            return True
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and "tmp" in node.value.lower():
+            return True
+    return False
+
+
+class AtomicCommitDiscipline(Rule):
+    id = "GL006"
+    title = "raw binary open on a checkpoint/rendezvous path"
+    scope_suffixes = CKPT_MODULES
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) != "open" or not node.args:
+                continue
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = kw.value
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and "w" in mode.value and "b" in mode.value
+                    or isinstance(mode, ast.Constant)
+                    and mode.value == "xb"):
+                continue
+            target = node.args[0]
+            if _is_tmp_shaped(target):
+                continue
+            name = dotted(target) or ast.unparse(target)
+            yield mod.finding(
+                "GL006", node,
+                f"open({name}, \"wb\") writes the live artifact name "
+                f"directly — a kill mid-write leaves a torn file; "
+                f"write a tmp sibling and commit via "
+                f"integrity.replace_atomic",
+            )

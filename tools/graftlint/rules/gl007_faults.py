@@ -1,0 +1,62 @@
+"""GL007 — fault-hook purity.
+
+The chaos harness's credibility depends on injected failures being
+reachable ONLY through the deterministic ``FaultPlan`` hooks
+(``resilience/faults.py`` ``install``/``fire``): a stray ``os._exit``
+or a hand-raised ``InjectedFault`` in production code is a latent
+kill-switch the sweep would never map. Outside the fault-plan modules
+(``resilience/faults.py``, ``resilience/chaos.py``) this rule flags:
+
+- any call to ``os._exit``;
+- any ``raise`` of ``InjectedFault`` / ``SimulatedCrash``.
+
+Calling the hook API (``_faults.active()`` / ``_faults.fire(...)``) is
+of course fine — that IS the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, LintModule, Rule, call_name, dotted, last_attr
+
+FAULT_PLAN_MODULES = (
+    "resilience/faults.py",
+    "resilience/chaos.py",
+)
+
+_INJECTED = {"InjectedFault", "SimulatedCrash"}
+
+
+class FaultHookPurity(Rule):
+    id = "GL007"
+    title = "os._exit / injected raise outside the fault plan"
+
+    def applies(self, mod: LintModule) -> bool:
+        return not mod.relpath.endswith(FAULT_PLAN_MODULES)
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node) in ("os._exit", "_exit"):
+                yield mod.finding(
+                    "GL007", node,
+                    "os._exit outside resilience/faults.py|chaos.py — "
+                    "process kills must go through FaultPlan hooks so "
+                    "the chaos sweep can map every kill point",
+                )
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call):
+                    name = last_attr(call_name(exc))
+                else:
+                    name = last_attr(dotted(exc))
+                if name in _INJECTED:
+                    yield mod.finding(
+                        "GL007", node,
+                        f"raise {name} outside the fault plan — "
+                        f"injected failures must fire from FaultPlan "
+                        f"hooks only",
+                    )
