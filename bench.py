@@ -1942,18 +1942,26 @@ def main():
         # byte-identical VertexDicts, no mixed-epoch restore at any
         # point, and the serving-replica failover scenario's events in
         # the obs log. Artifact: BENCH_CHAOS_MP_CPU.json.
+        # Both variants now commit *_OBS.jsonl evidence next to their
+        # artifacts (like --serving/--northstar already do): the merged
+        # shard-labeled event stream of every worker across every kill
+        # point (the workers ship events via streaming ShardSinks, so
+        # pre-kill telemetry is included) plus flight-dump markers; the
+        # MP variant also folds the driver's coordination events in.
         from gelly_streaming_tpu.resilience import chaos
 
         if "--multiprocess" in sys.argv:
-            doc = chaos.run_mp_sweep(log=log)
-            doc["platform"] = "cpu-xla"
             artifact = "BENCH_CHAOS_MP_CPU.json"
+            obs_log = "BENCH_CHAOS_MP_CPU_OBS.jsonl"
+            doc = chaos.run_mp_sweep(log=log, obs_log=obs_log)
+            doc["platform"] = "cpu-xla"
             with open(artifact, "w") as f:
                 json.dump(doc, f, indent=2)
             log(f"chaos-mp: ok={doc['ok']} "
                 f"kill_points={doc['kill_points']} "
                 f"cluster_restarts={doc['cluster_restarts_total']} "
                 f"torn_events={doc['epoch_torn_events_total']} "
+                f"flight_dumps={doc['flight_dumps_total']} "
                 f"recovery_p50={doc['recovery_s']['p50']}s")
             print(json.dumps({
                 "metric": "chaos_mp_kill_sweep_recovery_p50_s",
@@ -1961,21 +1969,25 @@ def main():
                 "unit": "seconds",
                 "kill_points": doc["kill_points"],
                 "cluster_restarts_total": doc["cluster_restarts_total"],
+                "flight_dumps_total": doc["flight_dumps_total"],
                 "failover_ok": (doc.get("failover") or {}).get("ok"),
                 "ok": doc["ok"],
                 "artifact": artifact,
+                "obs_log": obs_log,
             }))
             if not doc["ok"]:
                 sys.exit(1)
             return
 
-        doc = chaos.run_sweep(log=log)
-        doc["platform"] = "cpu-xla"
         artifact = "BENCH_CHAOS_CPU.json"
+        obs_log = "BENCH_CHAOS_CPU_OBS.jsonl"
+        doc = chaos.run_sweep(log=log, obs_log=obs_log)
+        doc["platform"] = "cpu-xla"
         with open(artifact, "w") as f:
             json.dump(doc, f, indent=2)
         log(f"chaos: ok={doc['ok']} kill_points={doc['kill_points']} "
             f"rejected={doc['ckpt_rejected_total']} "
+            f"flight_dumps={doc['flight_dumps_total']} "
             f"recovery_p50={doc['recovery_s']['p50']}s")
         print(json.dumps({
             "metric": "chaos_kill_sweep_recovery_p50_s",
@@ -1983,8 +1995,10 @@ def main():
             "unit": "seconds",
             "kill_points": doc["kill_points"],
             "restarts_total": doc["restarts_total"],
+            "flight_dumps_total": doc["flight_dumps_total"],
             "ok": doc["ok"],
             "artifact": artifact,
+            "obs_log": obs_log,
         }))
         if not doc["ok"]:
             sys.exit(1)

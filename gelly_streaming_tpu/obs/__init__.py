@@ -18,6 +18,19 @@ fan-out) read from:
   :func:`~gelly_streaming_tpu.obs.export.replay` reconstructs an
   identical registry), Prometheus text renderer, periodic snapshots
   composable with any emission stream.
+- :mod:`cluster` — the multi-process plane (ISSUE 7): per-shard
+  streaming :class:`~gelly_streaming_tpu.obs.cluster.ShardSink` event
+  shipping merged by
+  :class:`~gelly_streaming_tpu.obs.cluster.ClusterAggregator` into one
+  shard-labeled registry (snapshot == union of per-worker replays).
+- :mod:`endpoint` — stdlib HTTP scrape surface (``/metrics`` /
+  ``/healthz`` / ``/events``) over any registry or aggregator.
+- :mod:`flight` — crash flight recorder: a bounded ring of the last N
+  events, atomically dumped on worker death / fault kills / supervisor
+  restarts and collected into failure reports.
+- :mod:`timeline` — ``python -m gelly_streaming_tpu.obs.timeline
+  <dir>`` merges a run's shard logs + flight dumps into one ordered
+  story.
 
 Usage::
 
@@ -80,6 +93,27 @@ from .export import (
     snapshot_stream,
     write_jsonl,
 )
+from .cluster import (
+    ClusterAggregator,
+    ShardSink,
+    iter_shard_events,
+    shard_events_path,
+)
+from .flight import FlightRecorder, read_dump
+from . import flight as _flight
+
+
+def __getattr__(name: str):
+    # MetricsEndpoint is lazy on purpose: hot-path modules import this
+    # package for get_registry/trace, and the endpoint's http.server /
+    # socketserver chain is startup cost no obs-disabled run should pay
+    # for a scrape surface it never starts (cluster/flight stay eager —
+    # they ARE the always-on sink path).
+    if name == "MetricsEndpoint":
+        from .endpoint import MetricsEndpoint
+
+        return MetricsEndpoint
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def attach_sink(sink) -> None:
@@ -96,20 +130,26 @@ def detach_sink(sink) -> None:
 
 
 def reset() -> None:
-    """Test/bench hygiene: disable tracing, drop all tracer sinks, and
-    install a fresh global registry."""
+    """Test/bench hygiene: disable tracing, drop all tracer sinks,
+    uninstall any flight recorder, and install a fresh global
+    registry."""
     disable()
+    _flight.uninstall()
     for s in _trace.sinks():
         _trace.remove_sink(s)
     set_registry(None)
 
 
 __all__ = [
+    "ClusterAggregator",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JsonlSink",
     "MetricRegistry",
+    "MetricsEndpoint",
+    "ShardSink",
     "NOOP_SPAN",
     "Span",
     "attach_sink",
@@ -120,13 +160,16 @@ __all__ = [
     "enabled",
     "format_key",
     "get_registry",
+    "iter_shard_events",
     "nearest_rank",
     "on",
     "prometheus_text",
+    "read_dump",
     "read_jsonl",
     "replay",
     "reset",
     "set_registry",
+    "shard_events_path",
     "snapshot_stream",
     "span",
     "write_jsonl",

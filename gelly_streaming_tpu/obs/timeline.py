@@ -1,0 +1,183 @@
+"""Causal timeline: merge shard event logs into one ordered story.
+
+A distributed chaos run leaves N per-worker event files plus flight
+dumps in a directory; reconstructing "what actually happened" — who was
+killed when, which epochs committed, where the rendezvous fell back,
+when the standby was promoted — has so far meant hand-interleaving
+JSONL files. This tool does the interleave::
+
+    python -m gelly_streaming_tpu.obs.timeline <dir>        # the story
+    python -m gelly_streaming_tpu.obs.timeline <dir> --all  # every event
+
+It merges every shard event stream under the directory (via
+:func:`~gelly_streaming_tpu.obs.cluster.iter_shard_events` — shard-
+stamped, ``ts``-ordered) plus any flight-recorder dumps, and renders
+one line per event of interest with a run-relative timestamp::
+
+    +0.412s  [kill_003/p1] KILL     resilience.fault_injected{site=chaos.window}
+    +0.907s  [kill_003/p0] COMMIT   resilience.coord_commits
+    ...
+
+The default view filters to the COORDINATION story (kills, restarts,
+epoch commits / selections / fallbacks / torn epochs, checkpoint
+rejections, promotions, worker deaths, flight dumps); ``--all`` renders
+every event including spans and plain metric mutations.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Iterable, List, Optional
+
+from .cluster import iter_shard_events
+
+#: event name -> the tag the story renders it under; this is the
+#: vocabulary of the repo's coordination/failure events (resilience +
+#: serving layers — all always-on, so every run has them)
+STORY = {
+    "resilience.fault_injected": "KILL",
+    "resilience.restarts": "RESTART",
+    "resilience.cluster_restarts": "RESTART*",
+    "resilience.recovery_seconds": "RECOVERED",
+    "resilience.coord_commits": "COMMIT",
+    "resilience.epoch_selected": "SELECT",
+    "resilience.epoch_fallbacks": "FALLBACK",
+    "resilience.epoch_torn": "TORN",
+    "resilience.epoch_incomplete": "INCOMPLETE",
+    "resilience.ckpt_rejected": "REJECTED",
+    "resilience.deduped_windows": "DEDUP",
+    "resilience.poison_windows": "POISON",
+    "serving.failover": "PROMOTE",
+    "serving.failover_requeued": "REQUEUE",
+    "serving.failover_expired": "EXPIRED",
+    "serving.worker_deaths": "DEATH",
+    "serving.promotion_seconds": "PROMOTED",
+    "flight": "BLACKBOX",
+}
+
+
+def load_run(root: str) -> List[dict]:
+    """Every shard event under ``root`` plus one synthetic event per
+    flight dump (kind ``flight``, carrying the dump's reason/shard),
+    globally ``ts``-ordered."""
+    from . import flight as _flight
+
+    events = list(iter_shard_events(root))
+    if os.path.isdir(root):
+        dump_paths = []
+        for dirpath, _dirnames, _filenames in os.walk(root):
+            dump_paths.extend(_flight.find_dumps(dirpath))
+        for p in sorted(set(dump_paths)):
+            try:
+                doc = _flight.read_dump(p)
+            except Exception:
+                # a torn dump is itself evidence; surface it as such
+                events.append({
+                    "kind": "flight", "name": "flight",
+                    "ts": os.path.getmtime(p),
+                    "attrs": {"path": os.path.relpath(p, root),
+                              "unreadable": True},
+                })
+                continue
+            events.append({
+                "kind": "flight",
+                "name": "flight",
+                "ts": doc.get("ts", os.path.getmtime(p)),
+                "shard": (
+                    f"p{doc['shard']}" if doc.get("shard") is not None
+                    else None
+                ),
+                "attrs": {
+                    "reason": doc.get("reason"),
+                    "n_events": doc.get("n_events"),
+                    "path": os.path.relpath(p, root),
+                },
+            })
+    events.sort(key=lambda e: float(e.get("ts") or 0.0))
+    return events
+
+
+def _fmt_labels(e: dict) -> str:
+    labels = dict(e.get("labels") or {})
+    labels.pop("shard", None)  # already the line's [shard] column
+    body = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{{{body}}}" if body else ""
+
+
+def render(events: Iterable[dict], *, all_events: bool = False,
+           t0: Optional[float] = None) -> List[str]:
+    """Format merged events as timeline lines (the CLI's output, and
+    the programmatic surface tests pin)."""
+    events = list(events)
+    if t0 is None:
+        stamps = [
+            float(e["ts"]) for e in events
+            if isinstance(e.get("ts"), (int, float)) and e["ts"]
+        ]
+        t0 = min(stamps) if stamps else 0.0
+    lines = []
+    for e in events:
+        name = e.get("name", "")
+        kind = e.get("kind", "")
+        tag = STORY.get(name) or (STORY.get("flight") if kind == "flight"
+                                  else None)
+        if tag is None and not all_events:
+            continue
+        ts = float(e.get("ts") or 0.0)
+        shard = e.get("shard") or "-"
+        head = f"+{max(0.0, ts - t0):8.3f}s  [{shard:>12}] " \
+               f"{tag or kind.upper():<10} {name}{_fmt_labels(e)}"
+        detail = []
+        if kind == "hist" and "v" in e:
+            detail.append(f"v={e['v']:.4g}")
+        elif kind in ("counter", "gauge") and "v" in e:
+            detail.append(f"v={e['v']:g}")
+        elif kind == "span":
+            detail.append(f"dur={e.get('dur_s', 0):.4g}s")
+        attrs = e.get("attrs")
+        if attrs:
+            detail.append(
+                " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            )
+        if detail:
+            head += "  " + " ".join(detail)
+        lines.append(head)
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    all_events = "--all" in argv
+    roots = [a for a in argv if not a.startswith("--")]
+    if not roots:
+        print(
+            "usage: python -m gelly_streaming_tpu.obs.timeline "
+            "<run-dir|events.jsonl> [--all]",
+            file=sys.stderr,
+        )
+        return 2
+    rc = 0
+    for root in roots:
+        events = load_run(root)
+        lines = render(events, all_events=all_events)
+        if not lines:
+            print(f"{root}: no events", file=sys.stderr)
+            rc = 1
+            continue
+        shown = "all" if all_events else "story"
+        print(f"# {root}: {len(events)} events, {len(lines)} shown "
+              f"({shown})")
+        for line in lines:
+            print(line)
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `... | head` closed the pipe: normal CLI lifecycle, not an
+        # error (devnull dup avoids the interpreter's own flush noise)
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -98,11 +98,35 @@ def digest(emission) -> str:
 # --------------------------------------------------------------------- #
 # Worker (runs in a subprocess; dies hard at the kill point)
 # --------------------------------------------------------------------- #
+def _worker_obs(cfg: dict, shard: Optional[int] = None):
+    """Shared worker telemetry wiring: a streaming :class:`ShardSink`
+    (every event hits disk the moment it is emitted, so the pre-kill
+    story survives ``os._exit`` — the in-memory ``JsonlSink`` these
+    workers used before lost EVERYTHING on a kill run), tracing on
+    (spans + the flight ring's gate), and a flight recorder when the
+    driver asked for one (``cfg["flight"]``). Returns the sink."""
+    from ..obs import flight as obs_flight
+    from ..obs import trace as obs_trace
+    from ..obs.cluster import ShardSink
+    from ..obs.registry import get_registry
+
+    sink = ShardSink(cfg["events"], shard=shard)
+    get_registry().add_sink(sink)
+    obs_trace.add_sink(sink)
+    obs_trace.enable()
+    if cfg.get("flight"):
+        obs_flight.install(obs_flight.FlightRecorder(
+            cfg["flight"], capacity=128, shard=shard,
+        ))
+    return sink
+
+
 def worker_main(cfg: dict) -> None:
     """Drive the supervised CC pipeline once. ``cfg`` keys: ``ckpt``,
     ``digests``, ``events``, ``meta`` (paths), ``kill_after`` (windows
     consumed before ``os._exit(KILL_RC)``; -1 = run to completion),
-    plus the sweep geometry (``windows``/``window_edges``/``superbatch``
+    optionally ``flight`` (flight-recorder dump base path), plus the
+    sweep geometry (``windows``/``window_edges``/``superbatch``
     /``every``/``seed``)."""
     import jax
 
@@ -112,14 +136,12 @@ def worker_main(cfg: dict) -> None:
     from ..core.stream import SimpleEdgeStream
     from ..core.window import CountWindow
     from ..library import ConnectedComponents
-    from ..obs.export import JsonlSink
     from ..obs.registry import get_registry
     from . import faults
     from .supervisor import Supervisor
 
     raw = corpus(cfg["seed"], cfg["windows"] * cfg["window_edges"])
-    sink = JsonlSink(cfg["events"])
-    get_registry().add_sink(sink)
+    sink = _worker_obs(cfg)
 
     def make_stream(vd):
         return SimpleEdgeStream(
@@ -165,7 +187,7 @@ def worker_main(cfg: dict) -> None:
             "first_emission_s": first,
             "total_s": time.perf_counter() - t0,
         }, f)
-    sink.write()
+    sink.close()
     get_registry().remove_sink(sink)
     faults.clear()
 
@@ -213,7 +235,6 @@ def mp_worker_main(cfg: dict) -> None:
     from ..core.vertexdict import VertexDict
     from ..core.window import CountWindow
     from ..library import ConnectedComponents
-    from ..obs.export import JsonlSink
     from ..obs.registry import get_registry
     from ..parallel.multihost import FileExchangeTransport, dict_exchange_encode
     from . import faults
@@ -233,8 +254,7 @@ def mp_worker_main(cfg: dict) -> None:
         os.path.join(cfg["root"], "exchange"), pid, nprocs,
         timeout_s=float(cfg.get("exchange_timeout_s", 60.0)),
     )
-    sink = JsonlSink(cfg["events"])
-    get_registry().add_sink(sink)
+    sink = _worker_obs(cfg, shard=pid)
     seen_vd = {}  # the live stream's vertex dict (for the final CRC)
 
     def make_stream(vd):
@@ -321,7 +341,7 @@ def mp_worker_main(cfg: dict) -> None:
             "first_emission_s": first,
             "total_s": time.perf_counter() - t0,
         }, f)
-    sink.write()
+    sink.close()
     get_registry().remove_sink(sink)
     faults.clear()
 
@@ -342,14 +362,16 @@ def failover_main(cfg: dict) -> None:
     import numpy as np
 
     from ..datasets import IdentityDict
-    from ..obs.export import JsonlSink
+    from ..obs import flight as obs_flight
     from ..obs.registry import get_registry
     from ..serving import ConnectedQuery, FailoverServer
     from . import faults
     from .errors import DeadlineExceeded
 
-    sink = JsonlSink(cfg["events"])
-    get_registry().add_sink(sink)
+    # same wiring as every other chaos worker: streaming ShardSink
+    # (ts-stamped events, kill-proof) + tracing + the flight recorder
+    # whose dump the injected worker death must commit
+    sink = _worker_obs(cfg)
     V = 32
     vd = IdentityDict(V)
     vd.observe(V - 1)
@@ -406,9 +428,17 @@ def failover_main(cfg: dict) -> None:
         "serving.failover", reason="worker_death"
     ).value
     meta["worker_deaths"] = reg.counter("serving.worker_deaths").value
+    meta["promotion_seconds_count"] = reg.histogram(
+        "serving.promotion_seconds"
+    ).count
+    if cfg.get("flight"):
+        meta["flight_dumps"] = [
+            os.path.basename(p)
+            for p in obs_flight.find_dumps(os.path.dirname(cfg["flight"]))
+        ]
     with open(cfg["meta"], "w") as f:
         json.dump(meta, f)
-    sink.write()
+    sink.close()
     get_registry().remove_sink(sink)
 
 
@@ -437,6 +467,41 @@ def _count_rejections(events_path: str) -> int:
     return _count_events(events_path, "resilience.ckpt_rejected")
 
 
+def _ship_events(obs_f, source, point: str) -> int:
+    """Append one run directory's shard events (shard-stamped,
+    ``ts``-ordered, tagged with the sweep point) to the merged obs log,
+    plus one marker line per flight dump found there — the committed
+    ``*_OBS.jsonl`` evidence the bench artifacts reference."""
+    if obs_f is None:
+        return 0
+    from ..obs import flight as obs_flight
+    from ..obs.cluster import iter_shard_events
+
+    n = 0
+    for ev in iter_shard_events(source):
+        ev["point"] = point
+        obs_f.write(json.dumps(ev) + "\n")
+        n += 1
+    root = source if isinstance(source, str) and os.path.isdir(source) \
+        else None
+    if root is not None:
+        for p in obs_flight.find_dumps(root):
+            try:
+                doc = obs_flight.read_dump(p)
+            except Exception:
+                doc = {"reason": "unreadable", "n_events": None}
+            obs_f.write(json.dumps({
+                "kind": "meta", "name": "flight_dump", "point": point,
+                "path": os.path.basename(p),
+                "reason": doc.get("reason"),
+                "n_events": doc.get("n_events"),
+                "ts": os.path.getmtime(p),
+            }) + "\n")
+            n += 1
+    obs_f.flush()
+    return n
+
+
 def run_sweep(
     *,
     windows: int = DEFAULTS["windows"],
@@ -446,6 +511,7 @@ def run_sweep(
     seed: int = DEFAULTS["seed"],
     corrupt: bool = True,
     workdir: Optional[str] = None,
+    obs_log: Optional[str] = None,
     log: Optional[Callable[[str], None]] = None,
 ) -> dict:
     """Kill-at-every-window sweep; returns the artifact document.
@@ -457,6 +523,11 @@ def run_sweep(
     the committed barrier head between kill and resume, proving the
     fallback-to-previous-barrier path end to end (visible as
     ``ckpt_rejected`` counts in those points).
+
+    ``obs_log`` commits the merged event evidence: every point's worker
+    event stream (streamed to disk by the workers' :class:`ShardSink`,
+    so pre-kill events are INCLUDED) plus flight-dump markers, one
+    JSONL file, flushed point by point.
     """
     import shutil
     import tempfile
@@ -465,154 +536,190 @@ def run_sweep(
 
     say = log or (lambda s: print(s, file=sys.stderr, flush=True))
     root = workdir or tempfile.mkdtemp(prefix="chaos_")
-    geometry = dict(
-        windows=windows, window_edges=window_edges,
-        superbatch=superbatch, every=every, seed=seed,
-    )
-
-    def cfg_for(d: str, kill_after: int) -> dict:
-        return dict(
-            geometry,
-            ckpt=os.path.join(d, "c.ckpt"),
-            digests=os.path.join(d, "digests.jsonl"),
-            events=os.path.join(d, "events.jsonl"),
-            meta=os.path.join(d, "meta.json"),
-            kill_after=kill_after,
+    obs_f = open(obs_log, "w") if obs_log else None
+    try:
+        geometry = dict(
+            windows=windows, window_edges=window_edges,
+            superbatch=superbatch, every=every, seed=seed,
         )
 
-    # -- oracle: one uninterrupted run --------------------------------- #
-    oracle_dir = os.path.join(root, "oracle")
-    os.makedirs(oracle_dir, exist_ok=True)
-    say(f"chaos: oracle run ({windows} windows x {window_edges} edges, "
-        f"superbatch={superbatch}, every={every})...")
-    r = _spawn_worker(cfg_for(oracle_dir, -1))
-    if r.returncode != 0:
-        raise RuntimeError(
-            f"chaos oracle run failed rc={r.returncode}: {r.stderr[-2000:]}"
-        )
-    oracle = {
-        line["o"]: line["d"]
-        for line in _read_jsonl(os.path.join(oracle_dir, "digests.jsonl"))
-    }
-    if sorted(oracle) != list(range(windows)):
-        raise RuntimeError(
-            f"chaos oracle covered windows {sorted(oracle)}, "
-            f"expected 0..{windows - 1}"
-        )
-
-    # two corruption points (one per mode), centered in the sweep so a
-    # barrier definitely exists to corrupt
-    corrupt_at = {}
-    if corrupt and windows >= 2 * every + 2:
-        corrupt_at[max(every + 1, windows // 3)] = "flip"
-        corrupt_at[max(every + 2, (2 * windows) // 3)] = "truncate"
-
-    points = []
-    all_ok = True
-    for k in range(1, windows + 1):
-        d = os.path.join(root, f"kill_{k:03d}")
-        os.makedirs(d, exist_ok=True)
-        cfg = cfg_for(d, k)
-        point = {"kill_after": k, "corrupt": corrupt_at.get(k)}
-        r = _spawn_worker(cfg)
-        if r.returncode != KILL_RC:
-            point.update(ok=False, reason=(
-                f"kill run rc={r.returncode} (expected {KILL_RC}): "
-                f"{r.stderr[-500:]}"
-            ))
-            points.append(point)
-            all_ok = False
-            continue
-        mode = corrupt_at.get(k)
-        if mode is not None and os.path.exists(cfg["ckpt"]):
-            from .faults import corrupt_file
-
-            corrupt_file(cfg["ckpt"], mode, seed=seed + k)
-        t0 = time.perf_counter()
-        r = _spawn_worker(dict(cfg, kill_after=-1))
-        resume_s = time.perf_counter() - t0
-        if r.returncode != 0:
-            point.update(ok=False, reason=(
-                f"resume rc={r.returncode}: {r.stderr[-500:]}"
-            ))
-            points.append(point)
-            all_ok = False
-            continue
-        lines = _read_jsonl(cfg["digests"])
-        bad = [
-            line for line in lines if oracle.get(line["o"]) != line["d"]
-        ]
-        covered = sorted({line["o"] for line in lines})
-        with open(cfg["meta"]) as f:
-            meta = json.load(f)
-        point.update(
-            resume_s=round(resume_s, 3),
-            first_emission_s=round(meta["first_emission_s"], 4)
-            if meta["first_emission_s"] is not None else None,
-            resumed_from=meta["resumed_from"],
-            replayed=max(0, k - meta["resumed_from"]),
-            in_process_restarts=meta["restarts"],
-            ckpt_rejected=_count_rejections(cfg["events"]),
-        )
-        ok = not bad and covered == list(range(windows))
-        if mode is not None and meta["resumed_from"] > 0:
-            # a corrupted head must have been REJECTED (visible in the
-            # event log), never loaded
-            ok = ok and point["ckpt_rejected"] >= 1
-        point["ok"] = ok
-        if not ok:
-            point["reason"] = (
-                f"{len(bad)} digest mismatches, covered {len(covered)}/"
-                f"{windows} windows"
+        def cfg_for(d: str, kill_after: int) -> dict:
+            return dict(
+                geometry,
+                ckpt=os.path.join(d, "c.ckpt"),
+                digests=os.path.join(d, "digests.jsonl"),
+                events=os.path.join(d, "events.jsonl"),
+                meta=os.path.join(d, "meta.json"),
+                flight=os.path.join(d, "flight.json"),
+                kill_after=kill_after,
             )
-            all_ok = False
-        points.append(point)
-        say(f"chaos: kill@{k}"
-            + (f"+{mode}" if mode else "")
-            + f" -> resumed_from={point.get('resumed_from')} "
-            f"rejected={point.get('ckpt_rejected')} ok={ok}")
 
-    recov = sorted(
-        p["first_emission_s"] for p in points
-        if p.get("ok") and p.get("first_emission_s") is not None
-    )
-    resumes = sorted(
-        p["resume_s"] for p in points if p.get("ok") and "resume_s" in p
-    )
-    doc = {
-        "config": geometry,
-        "ok": all_ok,
-        "kill_points": len(points),
-        "restarts_total": sum(
-            1 + p.get("in_process_restarts", 0) for p in points
-        ),
-        "ckpt_rejected_total": sum(
-            p.get("ckpt_rejected", 0) for p in points
-        ),
-        "recovery_s": {
-            # supervisor-measured: worker start to first (re-)emission,
-            # i.e. restore + replay, excluding interpreter boot
-            "p50": nearest_rank(recov, 50),
-            "p90": nearest_rank(recov, 90),
-            "max": recov[-1] if recov else None,
-        },
-        "resume_wall_s": {
-            # full relaunch wall time; dominated by interpreter + jax
-            # import on this harness's tiny windows
-            "p50": nearest_rank(resumes, 50),
-            "max": resumes[-1] if resumes else None,
-        },
-        "points": points,
-        "note": (
-            "every kill point must replay to oracle-identical digests "
-            "over full window coverage; corrupt points additionally "
-            "require the torn head to be rejected (ckpt_rejected >= 1) "
-            "with recovery from the previous barrier"
-        ),
-    }
-    if workdir is None:
-        shutil.rmtree(root, ignore_errors=True)
-    return doc
+        # -- oracle: one uninterrupted run --------------------------------- #
+        oracle_dir = os.path.join(root, "oracle")
+        os.makedirs(oracle_dir, exist_ok=True)
+        say(f"chaos: oracle run ({windows} windows x {window_edges} edges, "
+            f"superbatch={superbatch}, every={every})...")
+        r = _spawn_worker(cfg_for(oracle_dir, -1))
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"chaos oracle run failed rc={r.returncode}: {r.stderr[-2000:]}"
+            )
+        oracle = {
+            line["o"]: line["d"]
+            for line in _read_jsonl(os.path.join(oracle_dir, "digests.jsonl"))
+        }
+        if sorted(oracle) != list(range(windows)):
+            raise RuntimeError(
+                f"chaos oracle covered windows {sorted(oracle)}, "
+                f"expected 0..{windows - 1}"
+            )
+        _ship_events(obs_f, oracle_dir, "oracle")
+
+        # two corruption points (one per mode), centered in the sweep so a
+        # barrier definitely exists to corrupt
+        corrupt_at = {}
+        if corrupt and windows >= 2 * every + 2:
+            corrupt_at[max(every + 1, windows // 3)] = "flip"
+            corrupt_at[max(every + 2, (2 * windows) // 3)] = "truncate"
+
+        points = []
+        all_ok = True
+        for k in range(1, windows + 1):
+            d = os.path.join(root, f"kill_{k:03d}")
+            os.makedirs(d, exist_ok=True)
+            cfg = cfg_for(d, k)
+            point = {"kill_after": k, "corrupt": corrupt_at.get(k)}
+            r = _spawn_worker(cfg)
+            if r.returncode != KILL_RC:
+                point.update(ok=False, reason=(
+                    f"kill run rc={r.returncode} (expected {KILL_RC}): "
+                    f"{r.stderr[-500:]}"
+                ))
+                points.append(point)
+                all_ok = False
+                _ship_events(obs_f, d, f"kill_{k:03d}")
+                continue
+            mode = corrupt_at.get(k)
+            if mode is not None and os.path.exists(cfg["ckpt"]):
+                from .faults import corrupt_file
+
+                corrupt_file(cfg["ckpt"], mode, seed=seed + k)
+            t0 = time.perf_counter()
+            # the resume run gets its OWN flight base: the recorder's
+            # no-overwrite suffixing is per-process, so a dump in the fresh
+            # resume process would otherwise replace the kill's black box
+            r = _spawn_worker(dict(
+                cfg, kill_after=-1,
+                flight=os.path.join(d, "flight.resume.json"),
+            ))
+            resume_s = time.perf_counter() - t0
+            if r.returncode != 0:
+                point.update(ok=False, reason=(
+                    f"resume rc={r.returncode}: {r.stderr[-500:]}"
+                ))
+                points.append(point)
+                all_ok = False
+                _ship_events(obs_f, d, f"kill_{k:03d}")
+                continue
+            lines = _read_jsonl(cfg["digests"])
+            bad = [
+                line for line in lines if oracle.get(line["o"]) != line["d"]
+            ]
+            covered = sorted({line["o"] for line in lines})
+            with open(cfg["meta"]) as f:
+                meta = json.load(f)
+            from ..obs import flight as obs_flight
+
+            point.update(
+                resume_s=round(resume_s, 3),
+                first_emission_s=round(meta["first_emission_s"], 4)
+                if meta["first_emission_s"] is not None else None,
+                resumed_from=meta["resumed_from"],
+                replayed=max(0, k - meta["resumed_from"]),
+                in_process_restarts=meta["restarts"],
+                ckpt_rejected=_count_rejections(cfg["events"]),
+                flight_dumps=[
+                    os.path.basename(p) for p in obs_flight.find_dumps(d)
+                ],
+            )
+            # the kill fired under an installed recorder, so the point's
+            # black box must exist — a sweep whose crashes leave no flight
+            # evidence has lost its post-mortem story
+            ok = (not bad and covered == list(range(windows))
+                  and len(point["flight_dumps"]) >= 1)
+            if mode is not None and meta["resumed_from"] > 0:
+                # a corrupted head must have been REJECTED (visible in the
+                # event log), never loaded
+                ok = ok and point["ckpt_rejected"] >= 1
+            point["ok"] = ok
+            if not ok:
+                point["reason"] = (
+                    f"{len(bad)} digest mismatches, covered {len(covered)}/"
+                    f"{windows} windows, "
+                    f"{len(point['flight_dumps'])} flight dumps"
+                )
+                all_ok = False
+            points.append(point)
+            _ship_events(obs_f, d, f"kill_{k:03d}")
+            say(f"chaos: kill@{k}"
+                + (f"+{mode}" if mode else "")
+                + f" -> resumed_from={point.get('resumed_from')} "
+                f"rejected={point.get('ckpt_rejected')} ok={ok}")
+
+        recov = sorted(
+            p["first_emission_s"] for p in points
+            if p.get("ok") and p.get("first_emission_s") is not None
+        )
+        resumes = sorted(
+            p["resume_s"] for p in points if p.get("ok") and "resume_s" in p
+        )
+        doc = {
+            "config": geometry,
+            "ok": all_ok,
+            "kill_points": len(points),
+            "restarts_total": sum(
+                1 + p.get("in_process_restarts", 0) for p in points
+            ),
+            "ckpt_rejected_total": sum(
+                p.get("ckpt_rejected", 0) for p in points
+            ),
+            "flight_dumps_total": sum(
+                len(p.get("flight_dumps", ())) for p in points
+            ),
+            "recovery_s": {
+                # supervisor-measured: worker start to first (re-)emission,
+                # i.e. restore + replay, excluding interpreter boot
+                "p50": nearest_rank(recov, 50),
+                "p90": nearest_rank(recov, 90),
+                "max": recov[-1] if recov else None,
+            },
+            "resume_wall_s": {
+                # full relaunch wall time; dominated by interpreter + jax
+                # import on this harness's tiny windows
+                "p50": nearest_rank(resumes, 50),
+                "max": resumes[-1] if resumes else None,
+            },
+            "points": points,
+            "note": (
+                "every kill point must replay to oracle-identical digests "
+                "over full window coverage AND leave >=1 flight-recorder "
+                "dump (the kill's black box); corrupt points additionally "
+                "require the torn head to be rejected (ckpt_rejected >= 1) "
+                "with recovery from the previous barrier"
+            ),
+        }
+        if obs_f is not None:
+            doc["obs_log"] = os.path.basename(obs_log)
+            obs_f.close()
+        if workdir is None:
+            shutil.rmtree(root, ignore_errors=True)
+        return doc
+    finally:
+        # the obs log handle must not outlive the sweep, even when an
+        # oracle check raises mid-sweep (the kept workdir still holds
+        # the per-point evidence for the post-mortem)
+        if obs_f is not None:
+            obs_f.close()
 
 
 # --------------------------------------------------------------------- #
@@ -629,6 +736,7 @@ def run_mp_sweep(
     corrupt: bool = True,
     failover: bool = True,
     workdir: Optional[str] = None,
+    obs_log: Optional[str] = None,
     log: Optional[Callable[[str], None]] = None,
 ) -> dict:
     """Distributed kill sweep over an N-process coordinated cluster.
@@ -646,318 +754,382 @@ def run_mp_sweep(
     every worker must fall back to the SAME previous epoch. With
     ``failover=True`` the sweep also runs the serving-replica failover
     scenario (:func:`failover_main`) and folds its evidence in.
+
+    ``obs_log`` commits the sweep's MERGED, shard-labeled event stream:
+    every worker's :class:`ShardSink` stream (all points, kills
+    included — streaming sinks survive ``os._exit``), flight-dump
+    markers, and the driver's own coordination events under shard
+    ``driver``.
     """
     import shutil
     import subprocess
     import tempfile
 
-    from ..obs.registry import nearest_rank
+    from ..obs.cluster import ShardSink, shard_events_path
+    from ..obs.registry import get_registry, nearest_rank
     from .coordinated import ClusterSupervisor, select_epoch
 
     say = log or (lambda s: print(s, file=sys.stderr, flush=True))
     root = workdir or tempfile.mkdtemp(prefix="chaos_mp_")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    geometry = dict(
-        processes=processes, windows=windows, window_edges=window_edges,
-        superbatch=superbatch, every=every, seed=seed,
-    )
-
-    def cfg_for(d: str, pid: int, kill_after: int, victim: int) -> dict:
-        return dict(
-            geometry,
-            root=d,
-            process=pid,
-            victim=victim,
-            kill_after=kill_after,
-            digests=os.path.join(d, f"digests.p{pid}.jsonl"),
-            events=os.path.join(d, f"events.p{pid}.jsonl"),
-            meta=os.path.join(d, f"meta.p{pid}.json"),
+    obs_f = open(obs_log, "w") if obs_log else None
+    drv_sink = None
+    if obs_f is not None:
+        # the driver's registry carries the cluster-level half of the
+        # story (cluster_restarts, epoch selection during corruption
+        # probes); ship it as its own shard at the end
+        drv_sink = ShardSink(os.path.join(root, "driver-events.jsonl"))
+        get_registry().add_sink(drv_sink)
+    try:
+        geometry = dict(
+            processes=processes, windows=windows, window_edges=window_edges,
+            superbatch=superbatch, every=every, seed=seed,
         )
 
-    def spawner(d: str, victim: int, kill_after: int):
-        """spawn(pid, attempt) for the ClusterSupervisor: the kill plan
-        rides only the FIRST attempt; relaunches run clean. Worker
-        output goes to per-attempt log files (no pipes — a terminated
-        worker must never deadlock the driver on a full pipe)."""
-
-        def spawn(pid: int, attempt: int):
-            cfg = cfg_for(
-                d, pid,
-                kill_after if attempt == 0 else -1,
-                victim,
+        def cfg_for(d: str, pid: int, kill_after: int, victim: int,
+                    attempt: int = 0) -> dict:
+            return dict(
+                geometry,
+                root=d,
+                process=pid,
+                victim=victim,
+                kill_after=kill_after,
+                digests=os.path.join(d, f"digests.p{pid}.jsonl"),
+                events=shard_events_path(d, pid),
+                meta=os.path.join(d, f"meta.p{pid}.json"),
+                flight=os.path.join(d, f"flight.p{pid}.a{attempt}.json"),
             )
-            log_path = os.path.join(d, f"worker.p{pid}.a{attempt}.log")
-            with open(log_path, "wb") as logf:
-                # the child holds its own dup of the fd; closing the
-                # driver's copy immediately keeps the sweep from
-                # accumulating points x processes x attempts open files
-                p = subprocess.Popen(
-                    [sys.executable, "-c", _worker_code("mp_worker_main"),
-                     json.dumps(cfg)],
-                    stdout=logf, stderr=subprocess.STDOUT, env=env,
+
+        def spawner(d: str, victim: int, kill_after: int):
+            """spawn(pid, attempt) for the ClusterSupervisor: the kill plan
+            rides only the FIRST attempt; relaunches run clean. Worker
+            output goes to per-attempt log files (no pipes — a terminated
+            worker must never deadlock the driver on a full pipe)."""
+
+            def spawn(pid: int, attempt: int):
+                cfg = cfg_for(
+                    d, pid,
+                    kill_after if attempt == 0 else -1,
+                    victim,
+                    attempt=attempt,
                 )
-            p.log_path = log_path  # ClusterError reads its tail
-            return p
+                log_path = os.path.join(d, f"worker.p{pid}.a{attempt}.log")
+                with open(log_path, "wb") as logf:
+                    # the child holds its own dup of the fd; closing the
+                    # driver's copy immediately keeps the sweep from
+                    # accumulating points x processes x attempts open files
+                    p = subprocess.Popen(
+                        [sys.executable, "-c", _worker_code("mp_worker_main"),
+                         json.dumps(cfg)],
+                        stdout=logf, stderr=subprocess.STDOUT, env=env,
+                    )
+                p.log_path = log_path  # ClusterError reads its tail
+                return p
 
-        return spawn
+            return spawn
 
-    def read_point(d: str) -> tuple:
-        """(digest lines per (pid, o), metas per pid) for one point."""
-        lines = {}
-        bad_dupes = []
-        for pid in range(processes):
-            for line in _read_jsonl(
-                os.path.join(d, f"digests.p{pid}.jsonl")
-            ):
-                key = (pid, line["o"])
-                if key in lines and lines[key] != line["d"]:
-                    bad_dupes.append(key)
-                lines[key] = line["d"]
-        metas = {}
-        for pid in range(processes):
-            p = os.path.join(d, f"meta.p{pid}.json")
-            if os.path.exists(p):
-                with open(p) as f:
-                    metas[pid] = json.load(f)
-        return lines, metas, bad_dupes
+        def read_point(d: str) -> tuple:
+            """(digest lines per (pid, o), metas per pid) for one point."""
+            lines = {}
+            bad_dupes = []
+            for pid in range(processes):
+                for line in _read_jsonl(
+                    os.path.join(d, f"digests.p{pid}.jsonl")
+                ):
+                    key = (pid, line["o"])
+                    if key in lines and lines[key] != line["d"]:
+                        bad_dupes.append(key)
+                    lines[key] = line["d"]
+            metas = {}
+            for pid in range(processes):
+                p = os.path.join(d, f"meta.p{pid}.json")
+                if os.path.exists(p):
+                    with open(p) as f:
+                        metas[pid] = json.load(f)
+            return lines, metas, bad_dupes
 
-    # -- oracle: one uninterrupted cluster run ------------------------- #
-    oracle_dir = os.path.join(root, "oracle")
-    os.makedirs(oracle_dir, exist_ok=True)
-    say(f"chaos-mp: oracle cluster ({processes} procs x {windows} "
-        f"windows x {window_edges} edges, superbatch={superbatch}, "
-        f"every={every})...")
-    cs = ClusterSupervisor(
-        spawner(oracle_dir, victim=-1, kill_after=-1), processes,
-        restart_codes=(KILL_RC,), backoff_base_s=0.0,
-    )
-    cs.run()
-    oracle, oracle_metas, dupes = read_point(oracle_dir)
-    want_keys = {
-        (pid, o) for pid in range(processes) for o in range(windows)
-    }
-    if set(oracle) != want_keys or dupes:
-        raise RuntimeError(
-            f"chaos-mp oracle covered {len(oracle)}/{len(want_keys)} "
-            f"(pid, window) points ({len(dupes)} digest conflicts)"
-        )
-    oracle_vd = {m["vd_crc"] for m in oracle_metas.values()}
-    if len(oracle_metas) != processes or len(oracle_vd) != 1:
-        raise RuntimeError(
-            f"chaos-mp oracle VertexDicts disagree across processes: "
-            f"{oracle_vd}"
-        )
-    oracle_vd_crc = next(iter(oracle_vd))
-
-    # the torn-epoch corruption point: late enough that a fallback epoch
-    # exists below the one being torn
-    corrupt_k = max(2 * every + 2, windows // 2) if corrupt else None
-    if corrupt_k is not None and corrupt_k > windows:
-        corrupt_k = None
-
-    points = []
-    all_ok = True
-    for k in range(1, windows + 1):
-        d = os.path.join(root, f"kill_{k:03d}")
-        os.makedirs(d, exist_ok=True)
-        victim = k % processes
-        point = {
-            "kill_after": k,
-            "victim": victim,
-            "corrupt": "flip" if k == corrupt_k else None,
-        }
-        corrupted_epoch = {}
-
-        def before_restart(attempt: int, _d=d, _k=k, _v=victim,
-                           _ce=corrupted_epoch):
-            if _k != corrupt_k or attempt != 1:
-                return
-            ckpt_dir = os.path.join(_d, "ckpt")
-            epoch = select_epoch(ckpt_dir, processes, record=False)
-            if epoch is None:
-                return
-            from .faults import corrupt_file
-
-            shard = os.path.join(
-                ckpt_dir, f"e{epoch:08d}.p{_v}.ckpt"
-            )
-            if os.path.exists(shard):
-                corrupt_file(shard, "flip", seed=seed + _k)
-                _ce["epoch"] = epoch
-
+        # -- oracle: one uninterrupted cluster run ------------------------- #
+        oracle_dir = os.path.join(root, "oracle")
+        os.makedirs(oracle_dir, exist_ok=True)
+        say(f"chaos-mp: oracle cluster ({processes} procs x {windows} "
+            f"windows x {window_edges} edges, superbatch={superbatch}, "
+            f"every={every})...")
         cs = ClusterSupervisor(
-            spawner(d, victim=victim, kill_after=k), processes,
+            spawner(oracle_dir, victim=-1, kill_after=-1), processes,
             restart_codes=(KILL_RC,), backoff_base_s=0.0,
-            before_restart=before_restart,
+            flight_dir=oracle_dir,
         )
-        t0 = time.perf_counter()
-        try:
-            res = cs.run()
-        except Exception as e:
-            # one unrecoverable point (a worker bug outside the
-            # restart codes, an exhausted restart budget) must not
-            # throw away the evidence of every point already measured
-            # — record it failed and keep sweeping, like run_sweep
-            point.update(
-                resume_s=round(time.perf_counter() - t0, 3),
-                ok=False,
-                reason=f"cluster did not recover: {e!r:.800}",
-            )
-            all_ok = False
-            points.append(point)
-            say(f"chaos-mp: kill@{k} victim=p{victim} -> "
-                f"UNRECOVERED: {type(e).__name__}")
-            continue
-        resume_s = time.perf_counter() - t0
-        lines, metas, dupes = read_point(d)
-        bad = [
-            key for key, dg in lines.items() if oracle.get(key) != dg
-        ]
-        covered_ok = set(lines) >= want_keys
-        resumed = {m["resumed_epoch"] for m in metas.values()}
-        vd_crcs = {m.get("vd_crc") for m in metas.values()}
-        killed = [e for e in res["worker_exits"] if e[1] == KILL_RC]
-        point.update(
-            resume_s=round(resume_s, 3),
-            cluster_restarts=res["restarts"],
-            worker_exits=res["worker_exits"],
-            resumed_epochs=sorted(resumed),
-            first_emission_s=min(
-                (m["first_emission_s"] for m in metas.values()
-                 if m.get("first_emission_s") is not None),
-                default=None,
-            ),
-            epoch_torn_events=sum(
-                _count_events(
-                    os.path.join(d, f"events.p{p}.jsonl"),
-                    "resilience.epoch_torn",
-                )
-                for p in range(processes)
-            ),
-        )
-        # the contract, point by point: oracle-identical digests over
-        # full coverage; every relaunched worker restored from A
-        # complete epoch; byte-identical dictionaries; the injected
-        # kill really landed. Workers USUALLY agree on one epoch, but
-        # agreement is time-of-scan dependent, not guaranteed: a fast
-        # worker that restores from epoch e and replays forward
-        # re-commits its shards along the way, and that healing commit
-        # can COMPLETE a newer epoch (its peer's shard persisted from
-        # before the kill) before a slower-booting peer runs its own
-        # rendezvous — the peer then selects the newer epoch. Both
-        # restores are complete-epoch restores (never mixed within a
-        # process), and deterministic replay + digest dedupe make the
-        # outcome identical, so skew is recorded (``epoch_agreed``)
-        # but only CORRECTNESS failures fail the point.
-        ok = (
-            not bad and not dupes and covered_ok
-            and len(metas) == processes
-            and bool(resumed)
-            and vd_crcs == {oracle_vd_crc}
-            and killed and killed[0][0] == victim
-            and res["restarts"] >= 1
-        )
-        point["epoch_agreed"] = len(resumed) == 1
-        if k == corrupt_k and "epoch" in corrupted_epoch:
-            # the FIRST rendezvous after the corruption must have
-            # skipped the torn epoch (fallback strictly below it) and
-            # visibly rejected it; a later selector may land back on
-            # the corrupted ordinal only after a healing re-commit
-            ok = ok and min(resumed) < corrupted_epoch["epoch"]
-            ok = ok and point["epoch_torn_events"] >= 1
-            point["corrupted_epoch"] = corrupted_epoch["epoch"]
-        point["ok"] = ok
-        if not ok:
-            point["reason"] = (
-                f"{len(bad)} digest mismatches ({len(dupes)} conflicting "
-                f"dupes), covered={len(set(lines) & want_keys)}/"
-                f"{len(want_keys)}, resumed_epochs={sorted(resumed)}, "
-                f"vd_match={vd_crcs == {oracle_vd_crc}}, "
-                f"exits={res['worker_exits']}"
-            )
-            all_ok = False
-        points.append(point)
-        say(f"chaos-mp: kill@{k} victim=p{victim}"
-            + ("+flip" if k == corrupt_k else "")
-            + f" -> resumed_epoch={sorted(resumed)} "
-            f"restarts={res['restarts']} ok={ok}")
-
-    # -- serving replica failover point -------------------------------- #
-    failover_doc = None
-    if failover:
-        fd = os.path.join(root, "failover")
-        os.makedirs(fd, exist_ok=True)
-        cfg = {
-            "events": os.path.join(fd, "events.jsonl"),
-            "meta": os.path.join(fd, "meta.json"),
-            "seed": seed,
+        cs.run()
+        oracle, oracle_metas, dupes = read_point(oracle_dir)
+        want_keys = {
+            (pid, o) for pid in range(processes) for o in range(windows)
         }
-        say("chaos-mp: serving failover scenario...")
-        r = _spawn_worker(cfg, entry="failover_main")
-        if r.returncode != 0:
-            failover_doc = {
-                "ok": False,
-                "reason": f"rc={r.returncode}: {r.stderr[-800:]}",
-            }
-            all_ok = False
-        else:
-            with open(cfg["meta"]) as f:
-                meta = json.load(f)
-            fo_ok = (
-                meta["promoted"] and meta["reanswered"] == 2
-                and meta["expired"] == 1 and meta["post"] == 1
-                and meta["failover_events"] >= 1
-                and _count_events(cfg["events"], "serving.failover") >= 1
+        if set(oracle) != want_keys or dupes:
+            raise RuntimeError(
+                f"chaos-mp oracle covered {len(oracle)}/{len(want_keys)} "
+                f"(pid, window) points ({len(dupes)} digest conflicts)"
             )
-            failover_doc = {"ok": fo_ok, **meta}
-            all_ok = all_ok and fo_ok
-        say(f"chaos-mp: failover ok={failover_doc['ok']}")
+        oracle_vd = {m["vd_crc"] for m in oracle_metas.values()}
+        if len(oracle_metas) != processes or len(oracle_vd) != 1:
+            raise RuntimeError(
+                f"chaos-mp oracle VertexDicts disagree across processes: "
+                f"{oracle_vd}"
+            )
+        oracle_vd_crc = next(iter(oracle_vd))
+        _ship_events(obs_f, oracle_dir, "oracle")
 
-    recov = sorted(
-        p["first_emission_s"] for p in points
-        if p.get("ok") and p.get("first_emission_s") is not None
-    )
-    resumes = sorted(
-        p["resume_s"] for p in points if p.get("ok") and "resume_s" in p
-    )
-    doc = {
-        "config": geometry,
-        "ok": all_ok,
-        "kill_points": len(points),
-        "cluster_restarts_total": sum(
-            p.get("cluster_restarts", 0) for p in points
-        ),
-        "epoch_torn_events_total": sum(
-            p.get("epoch_torn_events", 0) for p in points
-        ),
-        "recovery_s": {
-            # worker start to first (re-)emission after relaunch:
-            # rendezvous + restore + replay, excluding interpreter boot
-            "p50": nearest_rank(recov, 50),
-            "p90": nearest_rank(recov, 90),
-            "max": recov[-1] if recov else None,
-        },
-        "resume_wall_s": {
-            "p50": nearest_rank(resumes, 50),
-            "max": resumes[-1] if resumes else None,
-        },
-        "points": points,
-        "failover": failover_doc,
-        "note": (
-            "every kill-one-of-N point must replay to oracle-identical "
-            "digests over full per-process coverage, with every worker "
-            "resumed from a COMPLETE epoch (mixed-epoch restores are "
-            "rejected by construction; cross-worker agreement is "
-            "recorded per point as epoch_agreed) and byte-identical "
-            "VertexDicts; "
-            "the corrupt point must skip the torn epoch on every worker; "
-            "the failover scenario must promote the standby with expired "
-            "queries failing DeadlineExceeded and the rest re-answered"
-        ),
-    }
-    if workdir is None:
-        shutil.rmtree(root, ignore_errors=True)
-    return doc
+        # the torn-epoch corruption point: late enough that a fallback epoch
+        # exists below the one being torn
+        corrupt_k = max(2 * every + 2, windows // 2) if corrupt else None
+        if corrupt_k is not None and corrupt_k > windows:
+            corrupt_k = None
+
+        points = []
+        all_ok = True
+        for k in range(1, windows + 1):
+            d = os.path.join(root, f"kill_{k:03d}")
+            os.makedirs(d, exist_ok=True)
+            victim = k % processes
+            point = {
+                "kill_after": k,
+                "victim": victim,
+                "corrupt": "flip" if k == corrupt_k else None,
+            }
+            corrupted_epoch = {}
+
+            def before_restart(attempt: int, _d=d, _k=k, _v=victim,
+                               _ce=corrupted_epoch):
+                if _k != corrupt_k or attempt != 1:
+                    return
+                ckpt_dir = os.path.join(_d, "ckpt")
+                epoch = select_epoch(ckpt_dir, processes, record=False)
+                if epoch is None:
+                    return
+                from .faults import corrupt_file
+
+                shard = os.path.join(
+                    ckpt_dir, f"e{epoch:08d}.p{_v}.ckpt"
+                )
+                if os.path.exists(shard):
+                    corrupt_file(shard, "flip", seed=seed + _k)
+                    _ce["epoch"] = epoch
+
+            cs = ClusterSupervisor(
+                spawner(d, victim=victim, kill_after=k), processes,
+                restart_codes=(KILL_RC,), backoff_base_s=0.0,
+                before_restart=before_restart,
+                flight_dir=d,
+            )
+            t0 = time.perf_counter()
+            try:
+                res = cs.run()
+            except Exception as e:
+                # one unrecoverable point (a worker bug outside the
+                # restart codes, an exhausted restart budget) must not
+                # throw away the evidence of every point already measured
+                # — record it failed and keep sweeping, like run_sweep
+                point.update(
+                    resume_s=round(time.perf_counter() - t0, 3),
+                    ok=False,
+                    reason=f"cluster did not recover: {e!r:.800}",
+                    flight_dumps=[
+                        os.path.basename(p) for p in cs.flight_dumps
+                    ],
+                )
+                all_ok = False
+                points.append(point)
+                _ship_events(obs_f, d, f"kill_{k:03d}")
+                say(f"chaos-mp: kill@{k} victim=p{victim} -> "
+                    f"UNRECOVERED: {type(e).__name__}")
+                continue
+            resume_s = time.perf_counter() - t0
+            lines, metas, dupes = read_point(d)
+            bad = [
+                key for key, dg in lines.items() if oracle.get(key) != dg
+            ]
+            covered_ok = set(lines) >= want_keys
+            resumed = {m["resumed_epoch"] for m in metas.values()}
+            vd_crcs = {m.get("vd_crc") for m in metas.values()}
+            killed = [e for e in res["worker_exits"] if e[1] == KILL_RC]
+            point.update(
+                resume_s=round(resume_s, 3),
+                cluster_restarts=res["restarts"],
+                worker_exits=res["worker_exits"],
+                resumed_epochs=sorted(resumed),
+                first_emission_s=min(
+                    (m["first_emission_s"] for m in metas.values()
+                     if m.get("first_emission_s") is not None),
+                    default=None,
+                ),
+                epoch_torn_events=sum(
+                    _count_events(
+                        shard_events_path(d, p),
+                        "resilience.epoch_torn",
+                    )
+                    for p in range(processes)
+                ),
+                flight_dumps=[
+                    os.path.basename(p) for p in res["flight_dumps"]
+                ],
+            )
+            # the contract, point by point: oracle-identical digests over
+            # full coverage; every relaunched worker restored from A
+            # complete epoch; byte-identical dictionaries; the injected
+            # kill really landed. Workers USUALLY agree on one epoch, but
+            # agreement is time-of-scan dependent, not guaranteed: a fast
+            # worker that restores from epoch e and replays forward
+            # re-commits its shards along the way, and that healing commit
+            # can COMPLETE a newer epoch (its peer's shard persisted from
+            # before the kill) before a slower-booting peer runs its own
+            # rendezvous — the peer then selects the newer epoch. Both
+            # restores are complete-epoch restores (never mixed within a
+            # process), and deterministic replay + digest dedupe make the
+            # outcome identical, so skew is recorded (``epoch_agreed``)
+            # but only CORRECTNESS failures fail the point.
+            ok = (
+                not bad and not dupes and covered_ok
+                and len(metas) == processes
+                and bool(resumed)
+                and vd_crcs == {oracle_vd_crc}
+                and killed and killed[0][0] == victim
+                and res["restarts"] >= 1
+                # the victim's kill fired under an installed flight
+                # recorder; its dump is the point's black box and must be
+                # in the ClusterSupervisor's failure report
+                and len(point["flight_dumps"]) >= 1
+            )
+            point["epoch_agreed"] = len(resumed) == 1
+            if k == corrupt_k and "epoch" in corrupted_epoch:
+                # the FIRST rendezvous after the corruption must have
+                # skipped the torn epoch (fallback strictly below it) and
+                # visibly rejected it; a later selector may land back on
+                # the corrupted ordinal only after a healing re-commit
+                ok = ok and min(resumed) < corrupted_epoch["epoch"]
+                ok = ok and point["epoch_torn_events"] >= 1
+                point["corrupted_epoch"] = corrupted_epoch["epoch"]
+            point["ok"] = ok
+            if not ok:
+                point["reason"] = (
+                    f"{len(bad)} digest mismatches ({len(dupes)} conflicting "
+                    f"dupes), covered={len(set(lines) & want_keys)}/"
+                    f"{len(want_keys)}, resumed_epochs={sorted(resumed)}, "
+                    f"vd_match={vd_crcs == {oracle_vd_crc}}, "
+                    f"exits={res['worker_exits']}, "
+                    f"flight_dumps={len(point['flight_dumps'])}"
+                )
+                all_ok = False
+            points.append(point)
+            _ship_events(obs_f, d, f"kill_{k:03d}")
+            say(f"chaos-mp: kill@{k} victim=p{victim}"
+                + ("+flip" if k == corrupt_k else "")
+                + f" -> resumed_epoch={sorted(resumed)} "
+                f"restarts={res['restarts']} ok={ok}")
+
+        # -- serving replica failover point -------------------------------- #
+        failover_doc = None
+        if failover:
+            fd = os.path.join(root, "failover")
+            os.makedirs(fd, exist_ok=True)
+            cfg = {
+                "events": os.path.join(fd, "events.jsonl"),
+                "meta": os.path.join(fd, "meta.json"),
+                "flight": os.path.join(fd, "flight.json"),
+                "seed": seed,
+            }
+            say("chaos-mp: serving failover scenario...")
+            r = _spawn_worker(cfg, entry="failover_main")
+            if r.returncode != 0:
+                failover_doc = {
+                    "ok": False,
+                    "reason": f"rc={r.returncode}: {r.stderr[-800:]}",
+                }
+                all_ok = False
+            else:
+                with open(cfg["meta"]) as f:
+                    meta = json.load(f)
+                fo_ok = (
+                    meta["promoted"] and meta["reanswered"] == 2
+                    and meta["expired"] == 1 and meta["post"] == 1
+                    and meta["failover_events"] >= 1
+                    and _count_events(cfg["events"], "serving.failover") >= 1
+                    # the promotion's latency is now measured, and the dead
+                    # worker left its black box
+                    and meta.get("promotion_seconds_count", 0) >= 1
+                    and len(meta.get("flight_dumps", ())) >= 1
+                )
+                failover_doc = {"ok": fo_ok, **meta}
+                all_ok = all_ok and fo_ok
+            _ship_events(obs_f, fd, "failover")
+            say(f"chaos-mp: failover ok={failover_doc['ok']}")
+
+        recov = sorted(
+            p["first_emission_s"] for p in points
+            if p.get("ok") and p.get("first_emission_s") is not None
+        )
+        resumes = sorted(
+            p["resume_s"] for p in points if p.get("ok") and "resume_s" in p
+        )
+        doc = {
+            "config": geometry,
+            "ok": all_ok,
+            "kill_points": len(points),
+            "cluster_restarts_total": sum(
+                p.get("cluster_restarts", 0) for p in points
+            ),
+            "epoch_torn_events_total": sum(
+                p.get("epoch_torn_events", 0) for p in points
+            ),
+            "flight_dumps_total": sum(
+                len(p.get("flight_dumps", ())) for p in points
+            ),
+            "recovery_s": {
+                # worker start to first (re-)emission after relaunch:
+                # rendezvous + restore + replay, excluding interpreter boot
+                "p50": nearest_rank(recov, 50),
+                "p90": nearest_rank(recov, 90),
+                "max": recov[-1] if recov else None,
+            },
+            "resume_wall_s": {
+                "p50": nearest_rank(resumes, 50),
+                "max": resumes[-1] if resumes else None,
+            },
+            "points": points,
+            "failover": failover_doc,
+            "note": (
+                "every kill-one-of-N point must replay to oracle-identical "
+                "digests over full per-process coverage, with every worker "
+                "resumed from a COMPLETE epoch (mixed-epoch restores are "
+                "rejected by construction; cross-worker agreement is "
+                "recorded per point as epoch_agreed) and byte-identical "
+                "VertexDicts; "
+                "the corrupt point must skip the torn epoch on every worker; "
+                "every kill point must leave >=1 flight-recorder dump in "
+                "the ClusterSupervisor report; "
+                "the failover scenario must promote the standby (promotion "
+                "latency measured) with expired queries failing "
+                "DeadlineExceeded and the rest re-answered"
+            ),
+        }
+        if obs_f is not None:
+            get_registry().remove_sink(drv_sink)
+            drv_sink.close()
+            _ship_events(obs_f, {"driver": drv_sink.path}, "driver")
+            doc["obs_log"] = os.path.basename(obs_log)
+            obs_f.close()
+        if workdir is None:
+            shutil.rmtree(root, ignore_errors=True)
+        return doc
+    finally:
+        # never leave the driver sink attached to the process-global
+        # registry or the obs log handle open when an oracle check or
+        # ClusterError aborts the sweep (both releases are idempotent
+        # with the success path above; the kept workdir still holds
+        # every black box)
+        if drv_sink is not None:
+            get_registry().remove_sink(drv_sink)
+            drv_sink.close()
+        if obs_f is not None:
+            obs_f.close()
 
 
 if __name__ == "__main__":

@@ -431,6 +431,15 @@ class ClusterSupervisor:
     bounded by ``max_restarts`` (then
     :class:`~.errors.RestartBudgetExceeded`), with the supervisor's
     bounded-exponential backoff rule between attempts.
+
+    ``flight_dir`` names the directory the workers' flight recorders
+    dump into (each worker installs its own
+    :class:`~gelly_streaming_tpu.obs.flight.FlightRecorder`; a
+    ``FaultPlan`` kill or an in-worker supervisor restart commits the
+    ring there). Every worker death's newly-appeared dumps are
+    collected into the failure report: the ``flight_dumps`` list of
+    :meth:`run`'s result, and the :class:`ClusterError` message for
+    non-restartable deaths — the restart carries its black box.
     """
 
     def __init__(
@@ -447,6 +456,7 @@ class ClusterSupervisor:
         poll_s: float = 0.02,
         terminate_grace_s: float = 5.0,
         before_restart: Optional[Callable[[int], None]] = None,
+        flight_dir: Optional[str] = None,
     ):
         self._spawn = spawn
         self.num_processes = int(num_processes)
@@ -459,11 +469,47 @@ class ClusterSupervisor:
         self.poll_s = float(poll_s)
         self.terminate_grace_s = float(terminate_grace_s)
         self._before_restart = before_restart
+        self.flight_dir = flight_dir
         #: restarts performed by the most recent :meth:`run`
         self.restarts = 0
         #: (pid, exit_code) of every worker death that triggered a
         #: restart, in order — the sweep's evidence of WHO was killed
         self.worker_exits: List[Tuple[int, int]] = []
+        #: flight-recorder dump paths collected across the run, in
+        #: discovery order (newest deaths last)
+        self.flight_dumps: List[str] = []
+
+    def _collect_flight_dumps(self) -> List[str]:
+        """Newly-appeared dumps in ``flight_dir`` since the last
+        collection (the per-death sweep of the workers' black boxes)."""
+        if self.flight_dir is None:
+            return []
+        from ..obs import flight as _flight
+
+        fresh = [
+            p for p in _flight.find_dumps(self.flight_dir)
+            if p not in self.flight_dumps
+        ]
+        self.flight_dumps.extend(fresh)
+        return fresh
+
+    @staticmethod
+    def _describe_dumps(paths: List[str]) -> str:
+        """One line per dump for a failure report: path, reason, ring
+        size — readable without opening the files."""
+        from ..obs import flight as _flight
+
+        out = []
+        for p in paths:
+            try:
+                doc = _flight.read_dump(p)
+                out.append(
+                    f"{p} (reason={doc.get('reason')}, "
+                    f"{doc.get('n_events')} events)"
+                )
+            except Exception:
+                out.append(f"{p} (unreadable)")
+        return "; ".join(out)
 
     def _teardown(self, procs: list) -> None:
         for p in procs:
@@ -481,10 +527,12 @@ class ClusterSupervisor:
 
     def run(self) -> dict:
         """Drive the cluster to an all-zero exit; returns
-        ``{"restarts": n, "worker_exits": [(pid, rc), ...]}``."""
+        ``{"restarts": n, "worker_exits": [(pid, rc), ...],
+        "flight_dumps": [path, ...]}``."""
         reg = get_registry()
         self.restarts = 0
         self.worker_exits = []
+        self.flight_dumps = []
         attempt = 0
         while True:
             procs = [
@@ -505,9 +553,11 @@ class ClusterSupervisor:
                 if live and failed is None:
                     time.sleep(self.poll_s)
             if failed is None:
+                self._collect_flight_dumps()
                 return {
                     "restarts": self.restarts,
                     "worker_exits": list(self.worker_exits),
+                    "flight_dumps": list(self.flight_dumps),
                 }
             pid, rc = failed
             self.worker_exits.append((pid, rc))
@@ -516,6 +566,9 @@ class ClusterSupervisor:
             # worker bug and restarting would loop on it
             transient = rc < 0 or rc in self.restart_codes
             self._teardown(procs)
+            # the dead worker's black box: collected AFTER teardown so
+            # a dump committed in its dying instants is on disk
+            fresh_dumps = self._collect_flight_dumps()
             if not transient:
                 # spawners that pipe stderr expose it on the Popen;
                 # spawners that redirect to a log file (the in-repo
@@ -544,11 +597,17 @@ class ClusterSupervisor:
                 raise ClusterError(
                     f"worker {pid} exited rc={rc} (not a restartable "
                     f"code): {err[-2000:].decode(errors='replace')}"
+                    + (f"\nflight dumps: "
+                       f"{self._describe_dumps(fresh_dumps)}"
+                       if fresh_dumps else "")
                 )
             if self.restarts >= self.max_restarts:
                 raise RestartBudgetExceeded(
                     f"{self.restarts} cluster restarts exhausted "
                     f"(worker {pid} rc={rc})"
+                    + (f"; flight dumps: "
+                       f"{self._describe_dumps(self.flight_dumps)}"
+                       if self.flight_dumps else "")
                 )
             self.restarts += 1
             reg.counter(

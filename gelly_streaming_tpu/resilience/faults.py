@@ -118,6 +118,15 @@ class FaultPlan:
                 and self._once(("kill", self.kill_at_window))
             ):
                 self._count(site)
+                # the black box goes down WITH the plane: an os._exit
+                # kill gives no later hook, so the installed flight
+                # recorder (if any) commits its ring right here —
+                # the fault_injected count above is the last event in it
+                from ..obs import flight as _flight
+
+                _flight.dump_installed(
+                    f"fault_kill:{site}", index=index,
+                )
                 if self.kill_exit_code is not None:
                     os._exit(self.kill_exit_code)
                 raise SimulatedCrash(

@@ -42,6 +42,7 @@ import copy
 import time
 from typing import Any, Callable, Iterator, Optional
 
+from ..obs import flight as _flight
 from ..obs.registry import get_registry
 from .errors import (
     InjectedFault,
@@ -181,6 +182,18 @@ class Supervisor:
                 raise
             except BaseException as e:
                 kind = self._classify(e)
+                # every failure commits the black box BEFORE any
+                # restart decision: the ring holds the events that led
+                # here, and the dump path rides the failure report
+                # (PoisonWindowError / RestartBudgetExceeded) so a
+                # post-mortem starts from telemetry, not from grep
+                dump_path = _flight.dump_installed(
+                    f"supervisor:{kind}",
+                    ordinal=ordinal,
+                    error=repr(e)[:200],
+                )
+                if dump_path is not None:
+                    reg.counter("resilience.flight_dumps").inc()
                 if kind == "fatal":
                     raise
                 # poison counting tracks WINDOW-classified failures
@@ -200,6 +213,8 @@ class Supervisor:
                     raise RestartBudgetExceeded(
                         f"{self.restarts} restarts exhausted at window "
                         f"{ordinal} ({kind}: {e!r})"
+                        + (f"; flight dump: {dump_path}"
+                           if dump_path else "")
                     ) from e
                 attempt = self.restarts
                 self.restarts += 1

@@ -37,8 +37,10 @@ layer (``resilience/supervisor.py``, ``resilience/coordinated.py``).
 
 Every promotion is visible in the obs registry:
 ``serving.failover{reason=...}``, ``serving.failover_requeued``,
-``serving.failover_expired``, plus the ``serving.worker_deaths`` the
-server itself records.
+``serving.failover_expired``, the ``serving.promotion_seconds``
+takeover-latency histogram (plus a ``serving.promotion`` span when
+tracing is on), and the ``serving.worker_deaths`` the server itself
+records.
 """
 
 from __future__ import annotations
@@ -47,6 +49,7 @@ import threading
 import time
 from typing import Iterator, Optional, Tuple
 
+from ..obs import trace as _trace
 from ..obs.registry import get_registry
 from .query import Answer, Query
 from .server import StreamServer
@@ -160,52 +163,76 @@ class FailoverServer:
         re-homed: entries past their deadline fail ``DeadlineExceeded``
         (they are late no matter who answers), the rest are adopted by
         the standby and re-answered from its newest snapshot with their
-        original submit times and deadlines intact."""
+        original submit times and deadlines intact.
+
+        Promotion LATENCY is first-class telemetry: the whole takeover
+        (admission fence to active-replica switch) is timed into the
+        ``serving.promotion_seconds`` histogram and, when tracing is
+        on, a ``serving.promotion`` span — worker deaths were counted
+        before this, but how long clients waited on the switch was
+        invisible."""
+        t_promo = time.perf_counter()
         with self._plock:
             if self.promoted or self._closed:
                 return
             reg = get_registry()
-            reg.counter("serving.failover", reason=reason).inc()
-            primary = self.primary
-            # refuse stragglers at the primary's admission gate; the
-            # flag flips under ITS lock so no submit can slip between
-            # the queue steal below and the reroute of self._active
-            with primary._lock:
-                primary._closing = True
-                entries = list(primary._pending)
-                primary._pending.clear()
-            self.standby.start()
-            # the in-flight batch: if the primary worker is still
-            # alive (a MANUAL switchover), it is mid-answer on exactly
-            # these entries — adopting them too would compute every
-            # query twice and double-record stats. Give the worker a
-            # short grace to settle, then steal whatever remains (the
-            # worker-death path skips the wait entirely; for a wedged
-            # worker the futures' done() guards make any late
-            # primary-side settle harmless).
-            deadline = time.monotonic() + self.INFLIGHT_GRACE_S
-            while (primary.worker_alive() and primary._inflight
-                   and time.monotonic() < deadline):
-                time.sleep(0.001)
-            with primary._lock:
-                entries.extend(primary._inflight_entries)
-                primary._inflight = 0
-                primary._inflight_entries = []
-            now = time.perf_counter()
-            keep = []
-            for q, f, t0, dl in entries:
-                if f.done():
-                    continue
-                if dl is not None and now > dl:
-                    StreamServer._expire(q, f, t0, dl, "failed over after")
-                    reg.counter("serving.failover_expired").inc()
-                else:
-                    keep.append((q, f, t0, dl))
-            self.standby._adopt(keep)
-            if keep:
-                reg.counter("serving.failover_requeued").inc(len(keep))
-            self._active = self.standby
-            self.promoted = True
+            with _trace.span(
+                "serving.promotion",
+                {"reason": reason} if _trace.on() else None,
+            ):
+                reg.counter("serving.failover", reason=reason).inc()
+                primary = self.primary
+                # refuse stragglers at the primary's admission gate;
+                # the flag flips under ITS lock so no submit can slip
+                # between the queue steal below and the reroute of
+                # self._active
+                with primary._lock:
+                    primary._closing = True
+                    entries = list(primary._pending)
+                    primary._pending.clear()
+                self.standby.start()
+                # the in-flight batch: if the primary worker is still
+                # alive (a MANUAL switchover), it is mid-answer on
+                # exactly these entries — adopting them too would
+                # compute every query twice and double-record stats.
+                # Give the worker a short grace to settle, then steal
+                # whatever remains (the worker-death path skips the
+                # wait entirely; for a wedged worker the futures'
+                # done() guards make any late primary-side settle
+                # harmless).
+                deadline = time.monotonic() + self.INFLIGHT_GRACE_S
+                while (primary.worker_alive() and primary._inflight
+                       and time.monotonic() < deadline):
+                    time.sleep(0.001)
+                with primary._lock:
+                    entries.extend(primary._inflight_entries)
+                    primary._inflight = 0
+                    primary._inflight_entries = []
+                now = time.perf_counter()
+                keep = []
+                for q, f, t0, dl in entries:
+                    if f.done():
+                        continue
+                    if dl is not None and now > dl:
+                        StreamServer._expire(
+                            q, f, t0, dl, "failed over after"
+                        )
+                        reg.counter("serving.failover_expired").inc()
+                    else:
+                        keep.append((q, f, t0, dl))
+                self.standby._adopt(keep)
+                if keep:
+                    reg.counter(
+                        "serving.failover_requeued"
+                    ).inc(len(keep))
+                self._active = self.standby
+                self.promoted = True
+            # client-visible takeover latency: admission fence to
+            # active-replica switch (always on — a promotion is
+            # operational truth, like every resilience event)
+            reg.histogram("serving.promotion_seconds").observe(
+                time.perf_counter() - t_promo
+            )
 
     # ------------------------------------------------------------------ #
     # Query surface (routed to the active replica)
@@ -214,6 +241,15 @@ class FailoverServer:
     def active(self) -> StreamServer:
         with self._plock:
             return self._active
+
+    @property
+    def active_nowait(self) -> StreamServer:
+        """The active replica WITHOUT waiting out an in-flight
+        promotion (``active`` does, and promote() holds the lock
+        through its in-flight grace wait): a liveness probe must
+        answer immediately mid-failover, and the reference swap is
+        atomic — momentarily stale is a correct liveness answer."""
+        return self._active
 
     def submit(self, query: Query, **kw):
         srv = self.active
@@ -240,6 +276,14 @@ class FailoverServer:
 
     def snapshot(self) -> Optional[PublishedSnapshot]:
         return self.store.latest()
+
+    def metrics_endpoint(self, **kw):
+        """Start a scrape endpoint wired to the replica set:
+        ``/healthz`` reports the ACTIVE replica's liveness plus the
+        promotion state. See ``StreamServer.metrics_endpoint``."""
+        from ..obs.endpoint import MetricsEndpoint
+
+        return MetricsEndpoint.for_server(self, **kw).start()
 
     def join(self, timeout: Optional[float] = None) -> None:
         self.primary.join(timeout)

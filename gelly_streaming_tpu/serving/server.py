@@ -31,6 +31,7 @@ from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from typing import Iterator, Optional, Tuple
 
+from ..obs import flight as _flight
 from ..obs import trace as _trace
 from ..obs.registry import get_registry
 from ..resilience import faults as _faults
@@ -488,12 +489,18 @@ class StreamServer:
             # — the death is the experiment, the failover monitor's
             # promotion is the observable)
             get_registry().counter("serving.worker_deaths").inc()
-        except BaseException:
+            _flight.dump_installed("serving.worker_death:injected")
+        except BaseException as e:
             # the loop's answer path already survives everything; an
             # exception HERE is real worker death (a drain-path bug) —
             # record it so the failover monitor can promote a standby,
-            # and let the thread traceback surface
+            # commit the flight recorder's ring (the events that led
+            # here are this death's black box), and let the thread
+            # traceback surface
             get_registry().counter("serving.worker_deaths").inc()
+            _flight.dump_installed(
+                "serving.worker_death", error=repr(e)[:200]
+            )
             raise
 
     def worker_alive(self) -> bool:
@@ -501,6 +508,18 @@ class StreamServer:
         signal the failover monitor polls."""
         t = self._worker_thread
         return t is not None and t.is_alive()
+
+    def metrics_endpoint(self, **kw):
+        """Start a scrape endpoint wired to this server:
+        ``/metrics`` renders the process registry, ``/healthz`` reports
+        worker liveness / pending depth / ingest state. Keyword args
+        pass through to
+        :class:`~gelly_streaming_tpu.obs.endpoint.MetricsEndpoint`
+        (``port=0`` binds an ephemeral port). The caller owns
+        ``close()``."""
+        from ..obs.endpoint import MetricsEndpoint
+
+        return MetricsEndpoint.for_server(self, **kw).start()
 
     def _adopt(self, entries: list) -> None:
         """Enqueue already-admitted ``(query, future, t0, deadline)``

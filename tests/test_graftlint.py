@@ -453,6 +453,48 @@ def test_gl005_gated_and_out_of_scope_are_clean(tmp_path):
     assert "GL005" not in rule_ids(res)
 
 
+# The PR 7 extension: the flight recorder's event ring rides the
+# always-on sink path (resilience counters fire with obs disabled), so
+# the ring append itself must sit behind the obs.enable() gate — an
+# ungated append buffers telemetry every disabled run pays for.
+GL005_RING_TP = {
+    "obs/flight.py": """
+    class FlightRecorder:
+        def emit(self, event):
+            self._ring.append(event)
+    """,
+}
+
+GL005_RING_NEG = {
+    "obs/flight.py": """
+    class FlightRecorder:
+        def emit(self, event):
+            if _trace.on():
+                self._ring.append(event)
+
+        def snapshot(self):
+            return list(self._ring)  # a read, not a ring write
+    """,
+    # an append on some other buffer in a hot module is not a ring write
+    "obs/cluster.py": """
+    class ShardSink:
+        def emit(self, event):
+            self._batch.append(event)
+    """,
+}
+
+
+def test_gl005_ungated_ring_append_fires(tmp_path):
+    res = lint_files(tmp_path, GL005_RING_TP)
+    msgs = [f.message for f in res.findings if f.rule == "GL005"]
+    assert len(msgs) == 1 and "ring append" in msgs[0]
+
+
+def test_gl005_gated_ring_append_and_reads_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL005_RING_NEG)
+    assert "GL005" not in rule_ids(res)
+
+
 # --------------------------------------------------------------------- #
 # GL006 atomic-commit discipline
 # --------------------------------------------------------------------- #

@@ -1,0 +1,555 @@
+"""Cluster observability plane (ISSUE 7): per-shard event shipping,
+merged-registry aggregation, the HTTP scrape endpoint, the crash flight
+recorder, and the causal timeline tool.
+
+The load-bearing identities pinned here:
+
+- the :class:`ClusterAggregator`'s merged snapshot EQUALS the union of
+  per-worker ``replay()`` results (the PR 3 replay implementation is
+  the independent oracle — the union is computed with it directly);
+- a ``FaultPlan`` kill / supervisor restart / serving worker death
+  commits the flight ring atomically, and a
+  :class:`ClusterSupervisor`'s failure report carries its workers'
+  dumps;
+- the scrape endpoint's ``/metrics`` stays parseable under concurrent
+  mutation and, quiesced, equals ``prometheus_text(registry)`` exactly.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import obs
+from gelly_streaming_tpu.obs import cluster, endpoint, flight, timeline
+from gelly_streaming_tpu.obs.cluster import (
+    ClusterAggregator,
+    ShardSink,
+    iter_shard_events,
+    label_shard,
+    shard_events_path,
+)
+from gelly_streaming_tpu.obs.export import prometheus_text, replay
+from gelly_streaming_tpu.obs.registry import MetricRegistry
+from gelly_streaming_tpu.resilience.errors import CheckpointCorrupt
+
+
+@pytest.fixture(autouse=True)
+def _obs_hygiene():
+    """Full reset around every test: registry, tracing, sinks, AND the
+    installed flight recorder (obs.reset covers all of them)."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# --------------------------------------------------------------------- #
+# ShardSink: streaming per-worker event shipping
+# --------------------------------------------------------------------- #
+def test_shard_sink_streams_events_to_disk_immediately(tmp_path):
+    reg = MetricRegistry()
+    sink = ShardSink(shard_events_path(str(tmp_path), 3), shard=3)
+    reg.add_sink(sink)
+    reg.counter("w.edges").inc(5)
+    # the event is on disk NOW — not at close/write time (this is what
+    # lets a killed worker keep its pre-crash story)
+    lines = open(sink.path).read().splitlines()
+    assert len(lines) == 1
+    e = json.loads(lines[0])
+    assert e["name"] == "w.edges" and e["v"] == 5
+    assert e["shard"] == "p3" and isinstance(e["ts"], float)
+    reg.gauge("w.depth").set(2)
+    assert len(open(sink.path).read().splitlines()) == 2
+    sink.close()
+    # append mode: a restarted worker continues the shard stream
+    sink2 = ShardSink(sink.path, shard=3)
+    reg2 = MetricRegistry()
+    reg2.add_sink(sink2)
+    reg2.counter("w.edges").inc(1)
+    assert len(open(sink.path).read().splitlines()) == 3
+    sink2.close()
+
+
+# --------------------------------------------------------------------- #
+# ClusterAggregator: merged registry == union of per-worker replays
+# --------------------------------------------------------------------- #
+def _run_worker(directory, pid, n=40):
+    """One simulated worker: its own private registry, streaming its
+    events to its shard file — the per-process shape of a real
+    multi-process run, minus the fork."""
+    reg = MetricRegistry()
+    sink = ShardSink(shard_events_path(directory, pid), shard=pid)
+    reg.add_sink(sink)
+    rng = np.random.default_rng(100 + pid)
+    for i in range(n):
+        reg.counter("w.windows").inc()
+        reg.counter("w.edges", kind="raw").inc(int(rng.integers(1, 9)))
+        reg.gauge("w.depth").set(i % 5)
+        reg.histogram("w.pack_s").observe(float(rng.random()))
+    sink.close()
+    return reg
+
+
+def test_merged_registry_equals_union_of_worker_replays(tmp_path):
+    """THE tentpole identity, across 3 workers: the aggregator's merged
+    snapshot equals what the PR 3 ``replay()`` reconstructs from each
+    shard's log with the shard label folded in — same instruments, same
+    counts, same percentiles."""
+    d = str(tmp_path)
+    live = {pid: _run_worker(d, pid) for pid in range(3)}
+    agg = ClusterAggregator(d)
+    n = agg.poll()
+    assert n == sum(
+        len(open(shard_events_path(d, p)).read().splitlines())
+        for p in range(3)
+    )
+    # the union oracle: per-shard replay through the INDEPENDENT PR 3
+    # implementation, shard labels attached event by event
+    union = MetricRegistry()
+    for pid in range(3):
+        events = [
+            json.loads(line)
+            for line in open(shard_events_path(d, pid))
+        ]
+        replay([label_shard(e, f"p{pid}") for e in events], union)
+    assert agg.registry.snapshot() == union.snapshot()
+    # and each shard's slice of the merged registry matches the live
+    # worker registry it was shipped from (label added, values equal)
+    merged = agg.registry.snapshot()
+    for pid, reg in live.items():
+        for key, val in reg.snapshot()["counters"].items():
+            name, _, labels = key.partition("{")
+            want = labels.rstrip("}").split(",") if labels else []
+            want = ",".join(sorted(want + [f"shard=p{pid}"]))
+            assert merged["counters"][f"{name}{{{want}}}"] == val
+
+
+def test_aggregator_tails_incrementally_and_handles_partial_lines(
+    tmp_path,
+):
+    d = str(tmp_path)
+    _run_worker(d, 0, n=5)
+    agg = ClusterAggregator(d)
+    first = agg.poll()
+    assert first > 0 and agg.poll() == 0  # no new events, no re-merge
+    # a partial trailing line (live writer mid-append / killed worker)
+    # is NOT consumed...
+    path = shard_events_path(d, 0)
+    with open(path, "a") as f:
+        f.write('{"kind":"counter","name":"w.windows","v":1')
+    assert agg.poll() == 0
+    # ...until completed; then exactly one event lands
+    with open(path, "a") as f:
+        f.write("}\n")
+    assert agg.poll() == 1
+    # late-joining shard files are discovered by the re-glob
+    _run_worker(d, 1, n=3)
+    assert agg.poll() > 0
+    snap = agg.registry.snapshot()
+    assert any("shard=p1" in k for k in snap["counters"])
+
+
+def test_aggregator_snapshot_and_events_surface(tmp_path):
+    d = str(tmp_path)
+    _run_worker(d, 0, n=4)
+    agg = ClusterAggregator(d)
+    snap = agg.snapshot()  # polls internally
+    assert snap["counters"]["w.windows{shard=p0}"] == 4
+    evs = agg.events(last=3)
+    assert len(evs) == 3 and all(e["shard"] == "p0" for e in evs)
+
+
+# --------------------------------------------------------------------- #
+# Flight recorder
+# --------------------------------------------------------------------- #
+def test_flight_ring_gates_on_enable_and_bounds_capacity(tmp_path):
+    rec = flight.FlightRecorder(
+        str(tmp_path / "flight.json"), capacity=4, shard=1
+    )
+    flight.install(rec)
+    reg = obs.get_registry()
+    # obs DISABLED: the ring must stay empty (the always-on sink path
+    # delivers the events; the gate is inside emit — the GL005 bound)
+    reg.counter("a").inc()
+    assert len(rec) == 0
+    obs.enable()
+    for _ in range(10):
+        reg.counter("a").inc()
+    assert len(rec) == 4  # bounded: the last N only
+
+
+def test_flight_dump_atomic_checksummed_roundtrip(tmp_path):
+    obs.enable()
+    rec = flight.FlightRecorder(str(tmp_path / "flight.json"), shard=2)
+    flight.install(rec)
+    reg = obs.get_registry()
+    reg.counter("w.windows").inc(3)
+    reg.histogram("w.lat").observe(0.5)
+    p = rec.dump("test_reason", ordinal=7)
+    doc = flight.read_dump(p)
+    assert doc["reason"] == "test_reason" and doc["shard"] == 2
+    assert doc["attrs"] == {"ordinal": 7}
+    assert doc["n_events"] == 2 == len(doc["events"])
+    assert doc["events"][0]["name"] == "w.windows"
+    # later dumps never overwrite earlier black boxes
+    p2 = rec.dump("again")
+    assert p2 != p and os.path.exists(p) and os.path.exists(p2)
+    assert flight.find_dumps(str(tmp_path)) == [p, p2]
+    # the container is validated: bit rot is CheckpointCorrupt, not
+    # garbage JSON
+    from gelly_streaming_tpu.resilience.faults import corrupt_file
+
+    corrupt_file(p, "flip", seed=9)
+    with pytest.raises(CheckpointCorrupt):
+        flight.read_dump(p)
+
+
+def test_flight_dump_on_injected_faultplan_kill(tmp_path):
+    """The acceptance path: a FaultPlan kill fires under an installed
+    recorder -> the black box is committed BEFORE the crash surfaces,
+    and its last event is the kill's own fault_injected count."""
+    from gelly_streaming_tpu.resilience import faults
+    from gelly_streaming_tpu.resilience.errors import SimulatedCrash
+
+    obs.enable()
+    rec = flight.FlightRecorder(str(tmp_path / "flight.json"), capacity=8)
+    flight.install(rec)
+    reg = obs.get_registry()
+    reg.counter("w.windows").inc()
+    with faults.injected(faults.FaultPlan(kill_at_window=0)):
+        with pytest.raises(SimulatedCrash):
+            faults.fire("chaos.window", index=0)
+    dumps = flight.find_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    doc = flight.read_dump(dumps[0])
+    assert doc["reason"] == "fault_kill:chaos.window"
+    assert doc["events"][-1]["name"] == "resilience.fault_injected"
+
+
+@pytest.mark.chaos_fast
+def test_supervisor_restart_commits_black_box(tmp_path):
+    """Every supervisor restart dumps the installed recorder: kill the
+    supervised CC pipeline in-process, recover, and find the restart's
+    flight dump on disk with the pre-kill telemetry inside."""
+    from gelly_streaming_tpu.aggregate.autockpt import AutoCheckpoint
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+    from gelly_streaming_tpu.resilience import FaultPlan, Supervisor, faults
+
+    obs.enable()
+    flight.install(flight.FlightRecorder(
+        str(tmp_path / "flight.json"), capacity=64
+    ))
+    rng = np.random.default_rng(7)
+    raw = [
+        (int(a) * 3 + 5, int(b) * 3 + 5, 0.0)
+        for a, b in rng.integers(0, 50, size=(8 * 16, 2))
+    ]
+
+    def make_stream(vd):
+        s = SimpleEdgeStream(raw, window=CountWindow(16), vertex_dict=vd)
+        orig = s._block_source
+
+        def wrapped():
+            for i, b in enumerate(orig()):
+                yield b
+                if faults.active():
+                    faults.fire("chaos.window", index=i)
+
+        s._block_source = wrapped
+        return s
+
+    sup = Supervisor(
+        AutoCheckpoint(str(tmp_path / "c.ckpt"), every=2, keep=3),
+        backoff_base_s=0.0, jitter=0.0,
+    )
+    with faults.injected(FaultPlan(kill_at_window=4)):
+        outs = list(sup.run(make_stream, ConnectedComponents))
+    assert len(outs) == 8 and sup.restarts == 1
+    dumps = flight.find_dumps(str(tmp_path))
+    # one dump from the kill hook itself, one from the supervisor's
+    # restart classification — both black boxes of the same failure
+    assert len(dumps) == 2
+    reasons = {flight.read_dump(p)["reason"] for p in dumps}
+    assert "fault_kill:chaos.window" in reasons
+    assert "supervisor:transient" in reasons
+    assert obs.get_registry().counter(
+        "resilience.flight_dumps"
+    ).value == 1
+
+
+@pytest.mark.chaos_fast
+def test_cluster_supervisor_report_carries_worker_dumps(tmp_path):
+    """The distributed half of the acceptance: a worker of 2 dies (rc in
+    restart_codes) having committed its flight dump; the relaunched
+    cluster finishes and the ClusterSupervisor's run() report lists the
+    dump. A non-restartable death raises ClusterError CARRYING the dump
+    description."""
+    from gelly_streaming_tpu.resilience.coordinated import (
+        ClusterError,
+        ClusterSupervisor,
+    )
+
+    d = str(tmp_path)
+    script = r"""
+import sys
+sys.path.insert(0, {root!r})
+from gelly_streaming_tpu import obs
+from gelly_streaming_tpu.obs import flight
+
+pid, attempt, d, rc = sys.argv[1], int(sys.argv[2]), sys.argv[3], int(sys.argv[4])
+obs.enable()
+rec = flight.install(flight.FlightRecorder(
+    d + f"/flight.p{{pid}}.a{{attempt}}.json", shard=int(pid)))
+obs.get_registry().counter("w.windows").inc(3)
+if rc:
+    flight.dump_installed("test_kill")
+import os
+os._exit(rc)
+""".format(root="/root/repo")
+
+    def spawner(fail_rc):
+        def spawn(pid, attempt):
+            # worker 1 dies on its first attempt only
+            rc = fail_rc if (pid == 1 and attempt == 0) else 0
+            return subprocess.Popen(
+                [sys.executable, "-c", script,
+                 str(pid), str(attempt), d, str(rc)],
+            )
+
+        return spawn
+
+    cs = ClusterSupervisor(
+        spawner(17), 2, restart_codes=(17,), backoff_base_s=0.0,
+        flight_dir=d,
+    )
+    res = cs.run()
+    assert res["restarts"] == 1
+    assert len(res["flight_dumps"]) == 1
+    doc = flight.read_dump(res["flight_dumps"][0])
+    assert doc["reason"] == "test_kill" and doc["shard"] == 1
+    # non-restartable: ClusterError carries the black box description
+    for f in flight.find_dumps(d):
+        os.remove(f)
+    cs2 = ClusterSupervisor(
+        spawner(9), 2, restart_codes=(17,), backoff_base_s=0.0,
+        flight_dir=d,
+    )
+    with pytest.raises(ClusterError, match="flight dumps.*test_kill"):
+        cs2.run()
+
+
+# --------------------------------------------------------------------- #
+# Scrape endpoint
+# --------------------------------------------------------------------- #
+def test_endpoint_metrics_parse_under_concurrent_mutation():
+    """Scrapes racing live mutation must always return well-formed
+    exposition text; quiesced, the scrape equals prometheus_text."""
+    reg = MetricRegistry()
+    stop = threading.Event()
+
+    def mutate(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            reg.counter("q.count", lane=str(seed % 3)).inc()
+            reg.histogram("q.lat").observe(float(rng.random()))
+            reg.gauge("q.depth").set(int(rng.integers(0, 9)))
+
+    threads = [
+        threading.Thread(target=mutate, args=(s,), daemon=True)
+        for s in range(4)
+    ]
+    line_re = re.compile(
+        r"^(# TYPE \w+ (counter|gauge|summary))$"
+        r"|^\w+(\{[^{}]*\})? [0-9.eE+-]+$"
+    )
+    with endpoint.MetricsEndpoint(reg) as ep:
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(10):
+                status, body = _get(f"{ep.url}/metrics")
+                assert status == 200
+                for line in body.strip().splitlines():
+                    assert line_re.match(line), f"unparseable: {line!r}"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(5)
+        status, body = _get(f"{ep.url}/metrics")
+        assert body == prometheus_text(reg)  # quiesced: exact equality
+        status, hz = _get(f"{ep.url}/healthz")
+        hz = json.loads(hz)
+        assert status == 200 and hz["ok"] is True and "uptime_s" in hz
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{ep.url}/unknown")
+        assert ei.value.code == 404
+
+
+def test_endpoint_over_aggregator_serves_merged_cluster_view(tmp_path):
+    d = str(tmp_path)
+    for pid in range(2):
+        _run_worker(d, pid, n=6)
+    agg = ClusterAggregator(d)
+    with endpoint.MetricsEndpoint(aggregator=agg) as ep:
+        _, body = _get(f"{ep.url}/metrics")  # scrape polls on demand
+        assert 'w_windows{shard="p0"} 6' in body
+        assert 'w_windows{shard="p1"} 6' in body
+        _, ev = _get(f"{ep.url}/events?n=4")
+        lines = [json.loads(x) for x in ev.strip().splitlines()]
+        assert len(lines) == 4 and all("shard" in e for e in lines)
+        _, hz = _get(f"{ep.url}/healthz")
+        assert json.loads(hz)["shards_consumed_events"] > 0
+
+
+def test_endpoint_attaches_to_stream_server():
+    from gelly_streaming_tpu.serving.server import StreamServer
+
+    srv = StreamServer(iter(()), None).start()
+    try:
+        ep = srv.metrics_endpoint()
+        try:
+            _, hz = _get(f"{ep.url}/healthz")
+            hz = json.loads(hz)
+            assert hz["ok"] is True and hz["worker_alive"] is True
+            assert "pending" in hz and "ingest_finished" in hz
+            status, body = _get(f"{ep.url}/metrics")
+            assert status == 200
+        finally:
+            ep.close()
+    finally:
+        srv.close()
+
+
+def test_endpoint_smoke_matches_ci_gate():
+    """The CI step runs `python -m ...endpoint --smoke`; its in-process
+    body must hold (scrape == render, healthz ok)."""
+    assert endpoint.smoke(verbose=False)
+
+
+# --------------------------------------------------------------------- #
+# Promotion latency (failover satellite)
+# --------------------------------------------------------------------- #
+def test_promotion_records_latency_histogram_and_span():
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.serving import FailoverServer
+
+    obs.enable()
+    sink = obs.JsonlSink()
+    obs.attach_sink(sink)
+    vd = IdentityDict(8)
+    vd.observe(7)
+    labels = np.zeros(8, dtype=np.int32)
+    fs = FailoverServer(
+        iter([({"labels": labels, "vdict": vd}, 1)]), None,
+        monitor_s=None, max_pending=8,
+    ).start()
+    try:
+        fs.store.wait_for(1, timeout=30)
+        fs.promote(reason="manual")
+        assert fs.promoted
+        reg = obs.get_registry()
+        h = reg.histogram("serving.promotion_seconds")
+        assert h.count == 1 and h.sum > 0
+        spans = [
+            e for e in sink.events
+            if e.get("kind") == "span"
+            and e.get("name") == "serving.promotion"
+        ]
+        assert len(spans) == 1
+        assert spans[0]["attrs"] == {"reason": "manual"}
+        # promotion is one-shot: a second call must not re-observe
+        fs.promote(reason="manual")
+        assert h.count == 1
+    finally:
+        obs.detach_sink(sink)
+        fs.close()
+
+
+# --------------------------------------------------------------------- #
+# Timeline tool
+# --------------------------------------------------------------------- #
+def _write_events(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_timeline_merges_shards_into_one_ordered_story(tmp_path, capsys):
+    d = str(tmp_path)
+    t0 = time.time()
+    _write_events(os.path.join(d, "events.p0.jsonl"), [
+        {"kind": "counter", "name": "resilience.coord_commits", "v": 1,
+         "ts": t0 + 0.1},
+        {"kind": "counter", "name": "w.edges", "v": 64, "ts": t0 + 0.2},
+        {"kind": "counter", "name": "resilience.epoch_torn", "v": 1,
+         "ts": t0 + 2.0},
+    ])
+    _write_events(os.path.join(d, "events.p1.jsonl"), [
+        {"kind": "counter", "name": "resilience.fault_injected", "v": 1,
+         "labels": {"site": "chaos.window"}, "ts": t0 + 0.5},
+        {"kind": "counter", "name": "resilience.cluster_restarts", "v": 1,
+         "labels": {"reason": "kill"}, "ts": t0 + 1.0},
+    ])
+    events = timeline.load_run(d)
+    # globally ts-ordered with in-shard order preserved
+    assert [e["ts"] for e in events] == sorted(e["ts"] for e in events)
+    lines = timeline.render(events)
+    # the story filter: coordination events in, plain metrics out
+    assert len(lines) == 4
+    assert "KILL" in lines[1] and "[p1]" in lines[1].replace(" ", "")
+    assert lines.index(next(x for x in lines if "KILL" in x)) < \
+        lines.index(next(x for x in lines if "RESTART*" in x))
+    assert not any("w.edges" in x for x in lines)
+    assert any("TORN" in x for x in lines)
+    # --all renders every event
+    assert len(timeline.render(events, all_events=True)) == 5
+    # the CLI surface
+    assert timeline.main([d]) == 0
+    out = capsys.readouterr().out
+    assert "KILL" in out and "RESTART*" in out
+    assert timeline.main([]) == 2
+
+
+def test_timeline_folds_flight_dumps_in(tmp_path):
+    d = str(tmp_path)
+    _write_events(os.path.join(d, "events.p0.jsonl"), [
+        {"kind": "counter", "name": "resilience.coord_commits", "v": 1,
+         "ts": time.time()},
+    ])
+    obs.enable()
+    rec = flight.FlightRecorder(os.path.join(d, "flight.p0.json"), shard=0)
+    flight.install(rec)
+    obs.get_registry().counter("w.windows").inc()
+    rec.dump("kill")
+    lines = timeline.render(timeline.load_run(d))
+    assert any("BLACKBOX" in x and "reason=kill" in x for x in lines)
+
+
+def test_timeline_orders_ts_less_metric_events_by_carry_forward(tmp_path):
+    """Old JsonlSink logs carry no ts on metric events; they inherit
+    the last span timestamp in their shard file so ordering degrades
+    gracefully instead of collapsing to t=0."""
+    d = str(tmp_path)
+    t0 = time.time()
+    _write_events(os.path.join(d, "events.p0.jsonl"), [
+        {"kind": "span", "name": "s", "ts": t0 + 1.0, "dur_s": 0.1,
+         "sid": 1, "depth": 0},
+        {"kind": "counter", "name": "resilience.ckpt_rejected", "v": 1},
+    ])
+    events = list(iter_shard_events(d))
+    assert events[1]["ts"] == t0 + 1.0

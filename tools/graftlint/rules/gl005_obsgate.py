@@ -6,7 +6,10 @@ structural if hot-path modules never do obs work unconditionally —
 PR 5's hardening already had to chase dead memoization and un-gated
 calls back out of the tree.
 
-In the hot modules (the per-window engine core), this rule flags:
+In the hot modules (the per-window engine core, plus the PR 7 cluster
+observability plane — ``obs/cluster.py``/``obs/flight.py`` sit on the
+always-on sink path, so an ungated allocation there is paid by every
+disabled run), this rule flags:
 
 1. a registry mutation chain
    (``...counter(...)/gauge(...)/histogram(...)`` followed by
@@ -17,7 +20,12 @@ In the hot modules (the per-window engine core), this rule flags:
 2. a ``span(...)`` call whose attrs argument builds a dict
    unconditionally — the blessed idiom is
    ``{"k": v} if _trace.on() else None`` (the no-op span itself is
-   free; the attrs dict is the allocation).
+   free; the attrs dict is the allocation);
+3. a flight-recorder ring write (``...._ring.append(...)``) that is
+   not behind the gate — the recorder is attached as an ALWAYS-ON sink
+   (resilience counters fire with obs disabled), so the ring append
+   itself must gate on ``obs.enable()`` or disabled runs buffer
+   telemetry they were promised not to pay for.
 """
 
 from __future__ import annotations
@@ -36,6 +44,11 @@ HOT_MODULES = (
     "aggregate/summary.py",
     "summaries/forest.py",
     "library/connected_components.py",
+    # the cluster observability plane rides the always-on sink path:
+    # every event emitted anywhere flows through these call sites, so
+    # their disabled-mode cost is part of the ≈0 overhead bound
+    "obs/cluster.py",
+    "obs/flight.py",
 )
 
 _MUTATORS = {"inc", "set", "observe", "add", "record"}
@@ -85,6 +98,7 @@ class ObsZeroOverhead(Rule):
                     continue
                 yield from self._check_mutation(mod, node)
                 yield from self._check_span(mod, node, aliases)
+                yield from self._check_ring_write(mod, node)
 
     @staticmethod
     def _gated(mod: LintModule, node: ast.AST, aliases: Set[str]
@@ -127,6 +141,24 @@ class ObsZeroOverhead(Rule):
             f"registry {fname} mutation"
             f"{metric} is not gated on obs being enabled — wrap in "
             f"'if _trace.on():' so the disabled path stays free",
+        )
+
+    def _check_ring_write(self, mod: LintModule, node: ast.Call
+                          ) -> Iterator[Finding]:
+        """Check #3: an ungated append onto a ``*._ring`` buffer — the
+        flight recorder's event ring rides the always-on sink path, so
+        the append must sit behind the ``obs.enable()`` gate."""
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == "_ring"):
+            return
+        yield mod.finding(
+            "GL005", node,
+            "flight-recorder ring append is not gated on obs being "
+            "enabled — the recorder is an always-on sink, so wrap the "
+            "write in 'if _trace.on():' to keep disabled runs "
+            "allocation-free",
         )
 
     def _check_span(self, mod: LintModule, node: ast.Call,
