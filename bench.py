@@ -2030,6 +2030,64 @@ def main():
         }))
         return
 
+    if "--serving" in sys.argv and "--rpc" in sys.argv:
+        # wire-level serving resilience (ISSUE 8): a primary + standby
+        # serving BINARY pair on a shared snapshot directory, a
+        # multi-connection RPC load generator sustaining batched query
+        # traffic, and a FaultPlan kill of the primary mid-run. The
+        # acceptance bar is availability, client-measured: ZERO
+        # client-visible query failures across the kill (every query
+        # answered or cleanly DeadlineExceeded per its own budget),
+        # p50/p99 reported separately for steady state and for the
+        # promotion window, serving.promotion_seconds recorded from the
+        # standby's event stream, and the dead primary's
+        # flight-recorder black box present. CPU-pinned by construction
+        # (both replica subprocesses pin jax_platforms=cpu).
+        import tempfile
+
+        from gelly_streaming_tpu.resilience.chaos import run_rpc_scenario
+
+        artifact = "BENCH_SERVING_RPC_CPU.json"
+        obs_log = "BENCH_SERVING_RPC_CPU_OBS.jsonl"
+        root = tempfile.mkdtemp(prefix="bench_rpc_")
+        obs_f = open(obs_log, "w")
+        try:
+            doc = run_rpc_scenario(
+                root,
+                clients=4, batch=16, pace_s=0.005,
+                kill_at_sweep=1500, post_kill_batches=150,
+                log=log, obs_f=obs_f,
+            )
+        finally:
+            obs_f.close()
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+        doc["platform"] = "cpu-xla"
+        doc["obs_log"] = obs_log
+        with open(artifact, "w") as f:
+            json.dump(doc, f, indent=2)
+        log(f"serving-rpc: ok={doc['ok']} batches={doc['batches']} "
+            f"failures={doc['failures']} outage={doc.get('outage_s')}s "
+            f"steady_p99={doc['steady']['p99_ms']}ms "
+            f"promo_p99={doc['promotion_window']['p99_ms']}ms")
+        print(json.dumps({
+            "metric": "serving_rpc_steady_p99_ms",
+            "value": doc["steady"]["p99_ms"],
+            "unit": "milliseconds",
+            "promotion_window_p99_ms": doc["promotion_window"]["p99_ms"],
+            "outage_s": doc.get("outage_s"),
+            "promotion_seconds": doc.get("serving_promotion_seconds"),
+            "queries": doc["queries"],
+            "failures": doc["failures"],
+            "ok": doc["ok"],
+            "artifact": artifact,
+            "obs_log": obs_log,
+        }))
+        if not doc["ok"]:
+            sys.exit(1)
+        return
+
     if "--serving" in sys.argv:
         # query serving under concurrent ingest (ISSUE 1): p50/p99 query
         # latency + staleness + ingest overhead vs the no-server path.

@@ -213,7 +213,10 @@ class MetricsEndpoint:
         """An endpoint wired to a serving replica set: ``/healthz``
         reports worker liveness, pending depth, ingest state, and (for
         a :class:`~gelly_streaming_tpu.serving.failover.FailoverServer`)
-        promotion state; ``ok`` is False once no replica can answer.
+        the replica ROLE (``primary``/``standby``), promotion state,
+        and heartbeat age — the fields an external probe needs to tell
+        a healthy standby takeover from a wedged primary (alive thread,
+        stale beat); ``ok`` is False once no replica can answer.
         Accepts a ``StreamServer`` or ``FailoverServer``."""
 
         def health() -> dict:
@@ -226,9 +229,17 @@ class MetricsEndpoint:
                 "ingest_finished": bool(active.ingest_finished()),
                 "pending": len(getattr(active, "_pending", ())),
             }
+            role = getattr(server, "role", None)
+            if role is not None:
+                doc["role"] = str(role)
             promoted = getattr(server, "promoted", None)
             if promoted is not None:
                 doc["promoted"] = bool(promoted)
+            beat = getattr(server, "heartbeat_age_s", None)
+            if beat is not None:
+                age = beat()
+                if age is not None:
+                    doc["heartbeat_age_s"] = round(age, 4)
             started = active._worker_thread is not None
             doc["ok"] = bool(active.worker_alive() or not started)
             return doc

@@ -53,6 +53,14 @@ STORY = {
     "serving.failover_expired": "EXPIRED",
     "serving.worker_deaths": "DEATH",
     "serving.promotion_seconds": "PROMOTED",
+    # the RPC story (PR 8): connection lifecycle + heartbeat-lease
+    # failover, so a cross-process serving kill renders as one causal
+    # line sequence — CONNECT, DISCONNECT (the kill), LEASE-LAPSE,
+    # PROMOTE/PROMOTED — alongside the black-box and death lines above
+    "rpc.connects": "CONNECT",
+    "rpc.disconnects": "DISCONNECT",
+    "rpc.malformed": "MALFORMED",
+    "serving.lease_lapse": "LEASE-LAPSE",
     "flight": "BLACKBOX",
 }
 

@@ -443,6 +443,281 @@ def failover_main(cfg: dict) -> None:
 
 
 # --------------------------------------------------------------------- #
+# RPC cross-process failover scenario (kill the serving BINARY under
+# live multi-connection wire traffic)
+# --------------------------------------------------------------------- #
+def run_rpc_scenario(
+    root: str,
+    *,
+    seed: int = MP_DEFAULTS["seed"],
+    clients: int = 3,
+    batch: int = 8,
+    pace_s: float = 0.01,
+    kill_at_sweep: int = 120,
+    lease_s: float = 0.4,
+    deadline_s: float = 30.0,
+    post_kill_batches: int = 25,
+    vcap: int = 64,
+    log: Optional[Callable[[str], None]] = None,
+    obs_f=None,
+) -> dict:
+    """The wire-level availability proof (ISSUE 8): a primary + standby
+    serving BINARY pair on a shared snapshot directory, a
+    multi-connection client load generator sustaining batched query
+    traffic, and a ``FaultPlan`` kill (``serving.worker`` site,
+    ``os._exit`` with the flight recorder's black box dumped first) of
+    the primary mid-run. The standby promotes on heartbeat-lease lapse;
+    clients reconnect and resubmit under their original batch ids.
+
+    Asserted: ZERO client-visible query failures — every submitted
+    query resolves to an answer or a clean ``DeadlineExceeded`` within
+    its own budget — plus the promotion evidence (``serving.failover``
+    with ``reason=lease_lapse`` and a ``serving.promotion_seconds``
+    observation in the standby's event stream) and the dead primary's
+    flight dump. Client-MEASURED batch latency is reported separately
+    for steady state and for the promotion window (batches whose life
+    overlapped the outage), which is the artifact's headline.
+    """
+    import threading
+
+    from ..obs.cluster import shard_events_path
+    from ..obs.registry import nearest_rank
+    from ..serving.client import RpcClient
+    from ..serving.query import ConnectedQuery
+    from ..serving.rpc import spawn_replica, wait_portfile
+    from .errors import DeadlineExceeded
+
+    say = log or (lambda s: print(s, file=sys.stderr, flush=True))
+    os.makedirs(root, exist_ok=True)
+    shared = os.path.join(root, "shared")
+    base = dict(
+        dir=shared, lease_s=lease_s, windows=1 << 20, pace_s=0.01,
+        vcap=vcap, run_s=600.0, seed=seed,
+    )
+    primary = spawn_replica(dict(
+        base, role="primary", shard=0,
+        kill_at_sweep=kill_at_sweep,
+        portfile=os.path.join(root, "primary.port"),
+        events=shard_events_path(root, 0),
+        flight=os.path.join(root, "flight.p0.json"),
+    ))
+    standby = spawn_replica(dict(
+        base, role="standby", shard=1,
+        portfile=os.path.join(root, "standby.port"),
+        events=shard_events_path(root, 1),
+    ))
+    doc: dict = {
+        "config": dict(
+            clients=clients, batch=batch, pace_s=pace_s,
+            kill_at_sweep=kill_at_sweep, lease_s=lease_s,
+            deadline_s=deadline_s, seed=seed,
+        ),
+    }
+    try:
+        p_port = wait_portfile(os.path.join(root, "primary.port"))
+        s_port = wait_portfile(os.path.join(root, "standby.port"))
+        addrs = [f"127.0.0.1:{p_port}", f"127.0.0.1:{s_port}"]
+        say(f"chaos-rpc: primary :{p_port} (kill@sweep {kill_at_sweep}), "
+            f"standby :{s_port}, {clients} client connections x "
+            f"{batch}-query batches")
+
+        kill_seen = [None]  # perf_counter stamp of the observed death
+
+        def watch_primary():
+            primary.wait()
+            kill_seen[0] = time.perf_counter()
+
+        watcher = threading.Thread(target=watch_primary, daemon=True)
+        watcher.start()
+
+        # (submit_ts, settle_ts, ok, deadline, error_repr) per batch
+        records: list = []
+        rec_lock = threading.Lock()
+        client_errs: list = []
+
+        def drive(ci: int) -> None:
+            # one CONNECTION per driver thread: the multi-connection
+            # half of the contract, each with its own reconnect loop
+            import numpy as np
+
+            rng = np.random.default_rng(seed + ci)
+            cl = RpcClient(addrs, seed=seed + ci)
+            try:
+                post = 0
+                while post < post_kill_batches:
+                    qs = [
+                        ConnectedQuery(int(a), int(b))
+                        for a, b in rng.integers(0, vcap, (batch, 2))
+                    ]
+                    t0 = time.perf_counter()
+                    futs = cl.submit_batch(qs, deadline_s=deadline_s)
+                    n_dead = 0
+                    err = None
+                    for f in futs:
+                        try:
+                            f.result(deadline_s + 30)
+                        except DeadlineExceeded:
+                            n_dead += 1
+                        except BaseException as e:
+                            err = err or repr(e)[:200]
+                    t1 = time.perf_counter()
+                    with rec_lock:
+                        records.append(
+                            (t0, t1, err is None, n_dead, err)
+                        )
+                    if kill_seen[0] is not None and t1 > kill_seen[0]:
+                        post += 1
+                    if pace_s:
+                        time.sleep(pace_s)
+            except BaseException as e:
+                # a dead load generator would under-report the outage;
+                # its failure is the scenario's failure
+                client_errs.append(repr(e)[:400])
+            finally:
+                cl.close()
+
+        threads = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        watcher.join(60)
+        t_kill = kill_seen[0]
+        primary_rc = primary.returncode
+
+        # -- classify batches: steady vs promotion window --------------- #
+        answered = sum(1 for r in records if r[2])
+        failures = sum(1 for r in records if not r[2])
+        deadline_expired = sum(r[3] for r in records)
+        t_back = None
+        if t_kill is not None:
+            settled_after = sorted(
+                r[1] for r in records if r[2] and r[1] > t_kill
+            )
+            t_back = settled_after[0] if settled_after else None
+        steady, promo = [], []
+        for t0, t1, ok_b, _nd, _e in records:
+            if not ok_b:
+                continue
+            lat = (t1 - t0) * 1000.0
+            if (
+                t_kill is not None and t_back is not None
+                and t1 >= t_kill and t0 <= t_back
+            ):
+                promo.append(lat)
+            else:
+                steady.append(lat)
+        steady.sort()
+        promo.sort()
+
+        # -- promotion evidence from the standby's event stream --------- #
+        sb_events = _read_jsonl(shard_events_path(root, 1))
+        promoted = any(
+            e.get("name") == "serving.failover"
+            and (e.get("labels") or {}).get("reason") == "lease_lapse"
+            for e in sb_events
+        )
+        promotion_obs = [
+            float(e["v"]) for e in sb_events
+            if e.get("name") == "serving.promotion_seconds"
+            and "v" in e
+        ]
+        from ..obs import flight as obs_flight
+
+        flight_dumps = [
+            os.path.basename(p) for p in obs_flight.find_dumps(root)
+        ]
+        ok = (
+            not client_errs
+            and failures == 0
+            and t_kill is not None
+            and primary_rc == KILL_RC
+            and t_back is not None
+            and promoted
+            and len(promotion_obs) >= 1
+            and len(flight_dumps) >= 1
+        )
+        doc.update(
+            ok=ok,
+            batches=len(records),
+            queries=len(records) * batch,
+            queries_answered=answered * batch - deadline_expired,
+            failures=failures,
+            client_errors=client_errs,
+            deadline_expired=deadline_expired,
+            primary_rc=primary_rc,
+            kill_wall_s=(
+                round(t_kill - t_start, 3) if t_kill is not None
+                else None
+            ),
+            outage_s=(
+                round(t_back - t_kill, 3)
+                if t_kill is not None and t_back is not None else None
+            ),
+            steady={
+                "batches": len(steady),
+                "p50_ms": round(nearest_rank(steady, 50), 3),
+                "p99_ms": round(nearest_rank(steady, 99), 3),
+            },
+            promotion_window={
+                "batches": len(promo),
+                "p50_ms": round(nearest_rank(promo, 50), 3),
+                "p99_ms": round(nearest_rank(promo, 99), 3),
+                "max_ms": round(promo[-1], 3) if promo else None,
+            },
+            serving_promotion_seconds=(
+                round(promotion_obs[0], 4) if promotion_obs else None
+            ),
+            promoted=promoted,
+            flight_dumps=flight_dumps,
+            note=(
+                "client-measured batch latency over live wire traffic "
+                "across a primary serving-binary kill: zero failures "
+                "means every query was answered or cleanly "
+                "DeadlineExceeded within its own budget; the promotion "
+                "window covers batches whose life overlapped the outage"
+            ),
+        )
+        if not ok:
+            doc["reason"] = (
+                f"failures={failures}, client_errs={len(client_errs)}, "
+                f"primary_rc={primary_rc}, recovered={t_back is not None}, "
+                f"promoted={promoted}, "
+                f"promotion_obs={len(promotion_obs)}, "
+                f"flight_dumps={len(flight_dumps)}"
+            )
+        say(f"chaos-rpc: ok={ok} batches={len(records)} "
+            f"failures={failures} outage={doc.get('outage_s')}s "
+            f"steady_p99={doc['steady']['p99_ms']}ms "
+            f"promo_p99={doc['promotion_window']['p99_ms']}ms")
+        return doc
+    finally:
+        for p in (primary, standby):
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(20)
+                except Exception:
+                    _kill_replica(p)
+        _ship_events(obs_f, root, "rpc_failover")
+
+
+def _kill_replica(p) -> None:
+    """Last-resort teardown for a replica that ignored SIGTERM: counted
+    so a wedged shutdown is visible in the driver's event stream."""
+    from ..obs.registry import get_registry
+
+    get_registry().counter(
+        "rpc.swallowed", site="scenario_teardown"
+    ).inc()
+    p.kill()
+
+
+# --------------------------------------------------------------------- #
 # Driver
 # --------------------------------------------------------------------- #
 def _read_jsonl(path: str) -> list:
@@ -735,6 +1010,7 @@ def run_mp_sweep(
     seed: int = MP_DEFAULTS["seed"],
     corrupt: bool = True,
     failover: bool = True,
+    rpc: bool = True,
     workdir: Optional[str] = None,
     obs_log: Optional[str] = None,
     log: Optional[Callable[[str], None]] = None,
@@ -753,7 +1029,11 @@ def run_mp_sweep(
     whole epoch must be skipped (torn, visible in the event logs) and
     every worker must fall back to the SAME previous epoch. With
     ``failover=True`` the sweep also runs the serving-replica failover
-    scenario (:func:`failover_main`) and folds its evidence in.
+    scenario (:func:`failover_main`) and folds its evidence in;
+    ``rpc=True`` additionally runs the CROSS-PROCESS wire scenario
+    (:func:`run_rpc_scenario` — kill the primary serving binary under
+    live multi-connection RPC traffic, standby promoted on lease
+    lapse, zero client-visible failures).
 
     ``obs_log`` commits the sweep's MERGED, shard-labeled event stream:
     every worker's :class:`ShardSink` stream (all points, kills
@@ -1062,6 +1342,21 @@ def run_mp_sweep(
             _ship_events(obs_f, fd, "failover")
             say(f"chaos-mp: failover ok={failover_doc['ok']}")
 
+        # -- cross-process RPC failover point ------------------------------ #
+        rpc_doc = None
+        if rpc:
+            say("chaos-mp: rpc cross-process failover scenario...")
+            try:
+                rpc_doc = run_rpc_scenario(
+                    os.path.join(root, "rpc"),
+                    seed=seed, clients=2, batch=8,
+                    post_kill_batches=15, kill_at_sweep=100,
+                    log=say, obs_f=obs_f,
+                )
+            except Exception as e:
+                rpc_doc = {"ok": False, "reason": f"{e!r:.800}"}
+            all_ok = all_ok and rpc_doc["ok"]
+
         recov = sorted(
             p["first_emission_s"] for p in points
             if p.get("ok") and p.get("first_emission_s") is not None
@@ -1095,6 +1390,7 @@ def run_mp_sweep(
             },
             "points": points,
             "failover": failover_doc,
+            "rpc_failover": rpc_doc,
             "note": (
                 "every kill-one-of-N point must replay to oracle-identical "
                 "digests over full per-process coverage, with every worker "
@@ -1107,7 +1403,10 @@ def run_mp_sweep(
                 "the ClusterSupervisor report; "
                 "the failover scenario must promote the standby (promotion "
                 "latency measured) with expired queries failing "
-                "DeadlineExceeded and the rest re-answered"
+                "DeadlineExceeded and the rest re-answered; "
+                "the rpc_failover scenario must kill the primary serving "
+                "BINARY under live wire traffic with zero client-visible "
+                "failures and the standby promoted on lease lapse"
             ),
         }
         if obs_f is not None:

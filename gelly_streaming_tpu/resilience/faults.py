@@ -70,6 +70,9 @@ class FaultPlan:
     stall_site: Optional[str] = None       # e.g. "serving.worker"
     stall_at_index: int = 0
     stall_s: float = 0.0
+    # -- rpc wire faults (frame ordinals on the socket path) ------------ #
+    rpc_disconnect_at_frame: Optional[int] = None
+    rpc_truncate_at_frame: Optional[int] = None
     # -- source perturbation (record ordinals) ------------------------- #
     disconnect_at_record: Optional[int] = None
     drop_records: Tuple[int, ...] = ()
@@ -132,6 +135,21 @@ class FaultPlan:
                 raise SimulatedCrash(
                     f"injected kill after window {index} ({site})"
                 )
+        elif site == "rpc.frame":
+            # the serving RPC read paths (server handler + client
+            # reader) consult this after every complete frame: a
+            # mid-stream disconnect is the wire analog of
+            # disconnect_at_record, counted at the same one-shot
+            # discipline (frame ordinals are per-connection)
+            if (
+                self.rpc_disconnect_at_frame is not None
+                and index == self.rpc_disconnect_at_frame
+                and self._once(("rpc_disconnect", index))
+            ):
+                self._count(site)
+                raise ConnectionResetError(
+                    f"injected disconnect at frame {index}"
+                )
         elif site == "source.record":
             if (
                 self.disconnect_at_record is not None
@@ -151,6 +169,22 @@ class FaultPlan:
             ):
                 self._count(site)
                 corrupt_file(path, self.corrupt_mode, seed=self.seed)
+
+    # ------------------------------------------------------------------ #
+    def truncate_frame(self, index: Optional[int]) -> bool:
+        """True when the RPC send path should commit only HALF of frame
+        ``index`` and drop the connection — the torn-write shape on the
+        wire (the socket analog of ``corrupt_mode="truncate"``).
+        One-shot, counted as site ``rpc.send``; a pure query, so the
+        send path stays in charge of its own socket teardown."""
+        if (
+            self.rpc_truncate_at_frame is not None
+            and index == self.rpc_truncate_at_frame
+            and self._once(("rpc_truncate", index))
+        ):
+            self._count("rpc.send")
+            return True
+        return False
 
     # ------------------------------------------------------------------ #
     def perturb_records(self, records: Iterator) -> Iterator:
@@ -259,6 +293,13 @@ def fire(site: str, *, index: Optional[int] = None,
     p = _PLAN
     if p is not None:
         p.fire(site, index=index, path=path)
+
+
+def rpc_truncate(index: Optional[int]) -> bool:
+    """Module-level dispatch for :meth:`FaultPlan.truncate_frame`;
+    False when no plan is installed (the production-path answer)."""
+    p = _PLAN
+    return p is not None and p.truncate_frame(index)
 
 
 class injected:

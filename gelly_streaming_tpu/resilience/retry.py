@@ -59,3 +59,21 @@ class RetryPolicy:
             exp_backoff(attempt, self.base_s, self.max_s),
             self.jitter, self.seed, attempt,
         )
+
+    def delay_before(
+        self, attempt: int, remaining_s: Optional[float] = None
+    ) -> Optional[float]:
+        """:meth:`delay_s` clamped to a remaining deadline budget.
+
+        The RPC client retries ``Overloaded`` wire rejections under a
+        per-query deadline; sleeping past the budget would turn a
+        would-be answer into a guaranteed ``DeadlineExceeded``, so the
+        delay is capped at ``remaining_s`` and a spent budget returns
+        None (give up NOW, fail the deadline cleanly) — same contract
+        shape as :meth:`delay_s`."""
+        d = self.delay_s(attempt)
+        if d is None or remaining_s is None:
+            return d
+        if remaining_s <= 0:
+            return None
+        return min(d, float(remaining_s))

@@ -50,8 +50,36 @@ from ..resilience.errors import DeadlineExceeded
 from ..resilience.retry import RetryPolicy
 from .failover import FailoverServer
 from .server import Overloaded, Servable, Shed, StreamServer
-from .snapshot_store import PublishedSnapshot, SnapshotStore
+from .snapshot_store import (
+    PublishedSnapshot,
+    SnapshotMirror,
+    SnapshotStore,
+    follow_snapshots,
+)
 from .stats import ServingStats
+
+#: PEP 562 lazy exports: the RPC modules are runnable CLIs
+#: (``python -m gelly_streaming_tpu.serving.rpc --smoke``), and an
+#: eager package-level import would double-import them under runpy
+_LAZY = {
+    "HeartbeatLease": ".rpc",
+    "ReplicaServer": ".rpc",
+    "RpcServer": ".rpc",
+    "RpcClient": ".client",
+    "RpcError": ".client",
+}
+
+
+def __getattr__(name):
+    rel = _LAZY.get(name)
+    if rel is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    from importlib import import_module
+
+    return getattr(import_module(rel, __name__), name)
+
 
 __all__ = [
     "Answer",
@@ -60,15 +88,22 @@ __all__ = [
     "DeadlineExceeded",
     "DegreeQuery",
     "FailoverServer",
+    "HeartbeatLease",
     "Overloaded",
     "PublishedSnapshot",
     "Query",
     "QueryEngine",
     "RankQuery",
+    "ReplicaServer",
     "RetryPolicy",
+    "RpcClient",
+    "RpcError",
+    "RpcServer",
     "Servable",
     "ServingStats",
     "Shed",
+    "SnapshotMirror",
     "SnapshotStore",
     "StreamServer",
+    "follow_snapshots",
 ]

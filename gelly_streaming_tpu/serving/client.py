@@ -1,0 +1,512 @@
+"""RPC query client: reconnect-and-resubmit over the serving wire.
+
+The client half of :mod:`~gelly_streaming_tpu.serving.rpc`. One
+:class:`RpcClient` owns one framed connection at a time to a list of
+replica addresses and gives callers the SAME future surface as a local
+``StreamServer.submit`` — the wire is an implementation detail:
+
+- ``submit_batch`` registers the batch under an idempotent client id
+  and sends one REQ frame; answers settle the futures whenever the
+  server's RESP arrives (async, out of submission order).
+- ``overloaded`` wire rejections honor the client's
+  :class:`~gelly_streaming_tpu.resilience.RetryPolicy` — bounded,
+  jittered, deadline-clamped re-asks (``rpc.client_retries``); ``shed``
+  is TERMINAL and never retried (the server sheds that class to lose
+  exactly this traffic); ``not_primary`` retries with its own backoff
+  while a standby finishes promoting.
+- On disconnect the client reconnects (cycling the address list under
+  bounded exponential backoff) and RESUBMITS every pending batch under
+  its original id; the server's dedupe cache absorbs double delivery,
+  so a serving-process kill is visible only as a latency blip. Batches
+  whose own ``deadline_s`` lapses mid-outage fail
+  :class:`~gelly_streaming_tpu.resilience.errors.DeadlineExceeded`
+  cleanly (``rpc.client_deadline_expired``) — every submitted query is
+  ALWAYS answered or cleanly expired, never lost.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket as _socket
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import List, Optional, Sequence, Tuple, Union
+
+from ..obs.registry import get_registry
+from ..resilience.errors import DeadlineExceeded
+from ..resilience.retry import RetryPolicy, exp_backoff, jittered
+from .query import Answer, Query
+from .rpc import (
+    BAD_REQUEST,
+    DEFAULT_MAX_FRAME,
+    Disconnect,
+    MalformedFrame,
+    NOT_PRIMARY,
+    OK,
+    OVERLOADED,
+    SHED,
+    T_REQ,
+    T_RESP,
+    Wire,
+    encode_queries,
+    pack_frame,
+)
+from .server import Overloaded, Shed
+
+
+class RpcError(RuntimeError):
+    """Terminal wire-level failure (server error / bad request / spent
+    routing budget). Never retried by the client."""
+
+
+class _Batch:
+    """One pending wire batch (client side)."""
+
+    __slots__ = ("id", "enc", "futures", "deadline_abs",
+                 "attempts", "routes")
+
+    def __init__(self, qid: str, enc: list, futures: list,
+                 deadline_abs: Optional[float]):
+        self.id = qid
+        self.enc = enc
+        self.futures = futures
+        self.deadline_abs = deadline_abs
+        self.attempts = 0   # overloaded re-asks
+        self.routes = 0     # not_primary re-asks
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_abs is None:
+            return None
+        return self.deadline_abs - time.monotonic()
+
+
+class RpcClient:
+    """Framed-socket client for one serving replica set.
+
+    ``addresses`` is one ``"host:port"`` (or ``(host, port)``) or a
+    list of them — give it BOTH replicas of a failover pair and the
+    reconnect loop finds whichever currently serves. ``retry_policy``
+    governs ``overloaded`` re-asks (default: the stock
+    :class:`RetryPolicy`); pass None explicitly via
+    ``retry_policy=RetryPolicy(attempts=0)`` semantics if rejections
+    should surface immediately.
+    """
+
+    #: deadline sweep cadence (client-side expiry during outages)
+    SWEEP_S = 0.02
+    #: not_primary re-ask backoff shape (a standby mid-promotion)
+    ROUTE_BASE_S = 0.02
+    ROUTE_MAX_S = 0.25
+
+    def __init__(
+        self,
+        addresses: Union[str, Tuple[str, int], Sequence],
+        *,
+        retry_policy: Optional[RetryPolicy] = None,
+        reconnect_base_s: float = 0.02,
+        reconnect_max_s: float = 1.0,
+        connect_timeout_s: float = 5.0,
+        route_attempts: int = 512,
+        max_frame: int = DEFAULT_MAX_FRAME,
+        seed: int = 0,
+    ):
+        if isinstance(addresses, str) or (
+            isinstance(addresses, tuple)
+            and len(addresses) == 2
+            and isinstance(addresses[1], int)
+        ):
+            addresses = [addresses]
+        self._addrs = [self._parse(a) for a in addresses]
+        if not self._addrs:
+            raise ValueError("at least one replica address is required")
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy()
+        self.reconnect_base_s = float(reconnect_base_s)
+        self.reconnect_max_s = float(reconnect_max_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.route_attempts = int(route_attempts)
+        self.max_frame = int(max_frame)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._pending: dict = {}
+        self._wire: Optional[Wire] = None
+        self._addr_i = 0
+        self._closing = threading.Event()
+        self._counter = itertools.count()
+        self._id_prefix = f"{os.getpid():x}.{os.urandom(3).hex()}"
+        self._io_thread = threading.Thread(
+            target=self._io_loop, name="rpc-client-io", daemon=True
+        )
+        self._sweep_thread = threading.Thread(
+            target=self._sweep, name="rpc-client-sweep", daemon=True
+        )
+        self._io_thread.start()
+        self._sweep_thread.start()
+
+    @staticmethod
+    def _parse(addr) -> Tuple[str, int]:
+        if isinstance(addr, tuple):
+            return str(addr[0]), int(addr[1])
+        host, _, port = str(addr).rpartition(":")
+        return host or "127.0.0.1", int(port)
+
+    def __enter__(self) -> "RpcClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------ #
+    # Submission surface
+    # ------------------------------------------------------------------ #
+    def submit_batch(
+        self,
+        queries: Sequence[Query],
+        *,
+        deadline_s: Optional[float] = None,
+    ) -> List["Future[Answer]"]:
+        """Send one query batch; one future per query. ``deadline_s``
+        bounds each query's TOTAL budget — network, retries, reconnects,
+        and the server-side wait all spend it; expiry fails the future
+        with :class:`DeadlineExceeded` (client- or server-side,
+        whichever notices first)."""
+        if self._closing.is_set():
+            raise RuntimeError("rpc client is closed")
+        enc = encode_queries(queries)
+        qid = f"{self._id_prefix}-{next(self._counter)}"
+        futures: List["Future[Answer]"] = [Future() for _ in queries]
+        deadline_abs = (
+            None if deadline_s is None
+            else time.monotonic() + float(deadline_s)
+        )
+        batch = _Batch(qid, enc, futures, deadline_abs)
+        with self._lock:
+            self._pending[qid] = batch
+        wire = self._wire
+        if wire is not None:
+            try:
+                self._send_batch(wire, batch)
+            except OSError:
+                # the reconnect loop owns recovery; the batch is
+                # registered and will be resubmitted on the next
+                # connection — count the undelivered first send
+                get_registry().counter(
+                    "rpc.swallowed", site="client_submit_send"
+                ).inc()
+        return futures
+
+    def submit(self, query: Query, *,
+               deadline_s: Optional[float] = None) -> "Future[Answer]":
+        return self.submit_batch([query], deadline_s=deadline_s)[0]
+
+    def ask_batch(
+        self,
+        queries: Sequence[Query],
+        *,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Answer]:
+        return [
+            f.result(timeout)
+            for f in self.submit_batch(queries, deadline_s=deadline_s)
+        ]
+
+    def ask(self, query: Query, timeout: Optional[float] = None,
+            deadline_s: Optional[float] = None) -> Answer:
+        return self.submit(query, deadline_s=deadline_s).result(timeout)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # ------------------------------------------------------------------ #
+    # Wire plumbing
+    # ------------------------------------------------------------------ #
+    def _send_batch(self, wire: Wire, batch: _Batch) -> None:
+        doc = {"id": batch.id, "q": batch.enc}
+        remaining = batch.remaining_s()
+        if remaining is not None:
+            # ship the REMAINING budget, not the original one: a
+            # resubmit after an outage must not grant the server a
+            # fresh full deadline the client no longer has
+            doc["deadline_s"] = max(0.001, remaining)
+        wire.send(pack_frame(T_REQ, json.dumps(doc).encode("utf-8")))
+
+    def _io_loop(self) -> None:
+        reg = get_registry()
+        while not self._closing.is_set():
+            wire = self._connect()
+            if wire is None:
+                return
+            self._wire = wire
+            reg.counter("rpc.client_connects").inc()
+            self._resubmit_all(wire)
+            self._read_loop(wire)
+            self._wire = None
+            wire.close()
+            reg.counter("rpc.client_disconnects").inc()
+
+    def _connect(self) -> Optional[Wire]:
+        """Cycle the address list under bounded exponential backoff
+        until a connection lands (or the client closes)."""
+        attempt = 0
+        while not self._closing.is_set():
+            for off in range(len(self._addrs)):
+                i = (self._addr_i + off) % len(self._addrs)
+                host, port = self._addrs[i]
+                try:
+                    sock = _socket.create_connection(
+                        (host, port), timeout=self.connect_timeout_s
+                    )
+                except OSError:
+                    continue
+                sock.settimeout(None)
+                sock.setsockopt(
+                    _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+                )
+                self._addr_i = i
+                return Wire(sock)
+            delay = jittered(
+                exp_backoff(
+                    attempt, self.reconnect_base_s, self.reconnect_max_s
+                ),
+                0.5, self.seed, attempt,
+            )
+            get_registry().counter("rpc.client_reconnects").inc()
+            self._closing.wait(delay)
+            attempt += 1
+        return None
+
+    def _resubmit_all(self, wire: Wire) -> None:
+        with self._lock:
+            batches = list(self._pending.values())
+        if not batches:
+            return
+        get_registry().counter(
+            "rpc.client_resubmitted"
+        ).inc(len(batches))
+        for b in batches:
+            try:
+                self._send_batch(wire, b)
+            except OSError:
+                # this connection is already dead; the loop will build
+                # a new one and resubmit again — visible, not fatal
+                get_registry().counter(
+                    "rpc.swallowed", site="client_resubmit_send"
+                ).inc()
+                return
+
+    def _read_loop(self, wire: Wire) -> None:
+        reg = get_registry()
+        while not self._closing.is_set():
+            try:
+                ftype, payload = wire.read(max_frame=self.max_frame)
+            except Disconnect:
+                return
+            except MalformedFrame as e:
+                reg.counter("rpc.malformed", kind=e.kind).inc()
+                return
+            except ConnectionResetError:
+                # injected rpc.frame disconnect or a real peer reset
+                return
+            except OSError:
+                reg.counter(
+                    "rpc.swallowed", site="client_read"
+                ).inc()
+                return
+            if ftype != T_RESP:
+                reg.counter("rpc.malformed", kind="type").inc()
+                return
+            try:
+                doc = json.loads(payload.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                reg.counter("rpc.malformed", kind="json").inc()
+                continue
+            self._handle_resp(doc)
+
+    # ------------------------------------------------------------------ #
+    # Response handling
+    # ------------------------------------------------------------------ #
+    def _handle_resp(self, doc: dict) -> None:
+        reg = get_registry()
+        qid = doc.get("id")
+        if qid is None:
+            # a server-side notification about an unidentifiable frame
+            # (our own malformed send, in practice): nothing to settle
+            reg.counter("rpc.client_anon_errors").inc()
+            return
+        with self._lock:
+            batch = self._pending.get(qid)
+        if batch is None:
+            return  # late duplicate of an already-settled batch
+        status = doc.get("status")
+        if status == OK:
+            self._settle_ok(batch, doc.get("answers"))
+        elif status == OVERLOADED:
+            attempt = batch.attempts
+            batch.attempts = attempt + 1
+            remaining = batch.remaining_s()
+            if remaining is not None and remaining <= 0:
+                # the DEADLINE spent the budget, not the retry policy:
+                # defer to the sweeper so the batch fails
+                # DeadlineExceeded, as the module contract promises
+                return
+            delay = self.retry_policy.delay_before(attempt, remaining)
+            if delay is None:
+                self._fail(batch, Overloaded(
+                    doc.get("error") or "server overloaded "
+                    "(client retry budget spent)"
+                ))
+            else:
+                reg.counter("rpc.client_retries").inc()
+                self._schedule_resend(batch, delay)
+        elif status == NOT_PRIMARY:
+            routes = batch.routes
+            batch.routes = routes + 1
+            if routes >= self.route_attempts:
+                self._fail(batch, RpcError(
+                    "no replica would serve (routing budget spent)"
+                ))
+                return
+            remaining = batch.remaining_s()
+            if remaining is not None and remaining <= 0:
+                return  # the sweeper expires it
+            delay = jittered(
+                exp_backoff(routes, self.ROUTE_BASE_S, self.ROUTE_MAX_S),
+                0.5, self.seed, routes,
+            )
+            if remaining is not None:
+                delay = min(delay, max(0.001, remaining))
+            reg.counter("rpc.client_reroutes").inc()
+            self._schedule_resend(batch, delay)
+        elif status == SHED:
+            self._fail(batch, Shed(
+                doc.get("error") or "query class shed under pressure"
+            ))
+        elif status == BAD_REQUEST:
+            self._fail(batch, RpcError(
+                doc.get("error") or "bad request"
+            ))
+        else:
+            self._fail(batch, RpcError(
+                doc.get("error") or f"server error (status {status!r})"
+            ))
+
+    def _schedule_resend(self, batch: _Batch, delay: float) -> None:
+        t = threading.Timer(delay, self._resend, args=(batch,))
+        t.daemon = True
+        t.start()
+
+    def _resend(self, batch: _Batch) -> None:
+        if self._closing.is_set():
+            return
+        with self._lock:
+            if batch.id not in self._pending:
+                return
+        wire = self._wire
+        if wire is None:
+            return  # the reconnect path resubmits every pending batch
+        try:
+            self._send_batch(wire, batch)
+        except OSError:
+            get_registry().counter(
+                "rpc.swallowed", site="client_resend"
+            ).inc()
+
+    def _settle_ok(self, batch: _Batch, answers) -> None:
+        with self._lock:
+            self._pending.pop(batch.id, None)
+        if not isinstance(answers, list) or \
+                len(answers) != len(batch.futures):
+            err = RpcError(
+                f"answer count mismatch ({answers!r:.120})"
+            )
+            for f in batch.futures:
+                self._set_exc(f, err)
+            return
+        for f, a in zip(batch.futures, answers):
+            try:
+                if a[0] == "ok":
+                    self._set_res(f, Answer(
+                        value=a[1], window=int(a[2]),
+                        watermark=int(a[3]), staleness=int(a[4]),
+                    ))
+                elif a[0] == "deadline":
+                    self._set_exc(f, DeadlineExceeded(str(a[1])))
+                else:
+                    self._set_exc(f, RpcError(str(a[1])))
+            except (IndexError, TypeError, ValueError):
+                get_registry().counter(
+                    "rpc.malformed", kind="answer"
+                ).inc()
+                self._set_exc(f, RpcError(f"malformed answer {a!r:.120}"))
+
+    def _fail(self, batch: _Batch, exc: BaseException) -> None:
+        with self._lock:
+            self._pending.pop(batch.id, None)
+        for f in batch.futures:
+            self._set_exc(f, exc)
+
+    @staticmethod
+    def _set_res(f: Future, ans: Answer) -> None:
+        if not f.done():
+            try:
+                f.set_result(ans)
+            except InvalidStateError:
+                get_registry().counter(
+                    "rpc.swallowed", site="client_settle_race"
+                ).inc()
+
+    @staticmethod
+    def _set_exc(f: Future, exc: BaseException) -> None:
+        if not f.done():
+            try:
+                f.set_exception(exc)
+            except InvalidStateError:
+                get_registry().counter(
+                    "rpc.swallowed", site="client_settle_race"
+                ).inc()
+
+    # ------------------------------------------------------------------ #
+    # Deadline sweeper (client-side expiry survives a dead server)
+    # ------------------------------------------------------------------ #
+    def _sweep(self) -> None:
+        while not self._closing.wait(self.SWEEP_S):
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for qid, b in list(self._pending.items()):
+                    if b.deadline_abs is not None and \
+                            now > b.deadline_abs:
+                        expired.append(self._pending.pop(qid))
+            for b in expired:
+                get_registry().counter(
+                    "rpc.client_deadline_expired"
+                ).inc()
+                exc = DeadlineExceeded(
+                    "query batch unanswered within its deadline "
+                    "(server unreachable or slow)"
+                )
+                for f in b.futures:
+                    self._set_exc(f, exc)
+
+    # ------------------------------------------------------------------ #
+    def close(self) -> None:
+        if self._closing.is_set():
+            return
+        self._closing.set()
+        wire = self._wire
+        if wire is not None:
+            wire.close()
+        self._io_thread.join(5.0)
+        self._sweep_thread.join(5.0)
+        with self._lock:
+            leftovers = list(self._pending.values())
+            self._pending.clear()
+        exc = RpcError("rpc client closed with the batch pending")
+        for b in leftovers:
+            for f in b.futures:
+                self._set_exc(f, exc)

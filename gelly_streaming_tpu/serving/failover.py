@@ -251,6 +251,19 @@ class FailoverServer:
         atomic — momentarily stale is a correct liveness answer."""
         return self._active
 
+    @property
+    def role(self) -> str:
+        """Which replica is serving: ``primary`` until a promotion,
+        ``standby`` after — the label an external probe needs to tell
+        a healthy standby takeover from normal operation."""
+        return "standby" if self.promoted else "primary"
+
+    def heartbeat_age_s(self) -> float:
+        """The ACTIVE replica's worker-beat age (see
+        ``StreamServer.heartbeat_age_s``); read without waiting out an
+        in-flight promotion, for the same reason as ``active_nowait``."""
+        return self.active_nowait.heartbeat_age_s()
+
     def submit(self, query: Query, **kw):
         srv = self.active
         try:

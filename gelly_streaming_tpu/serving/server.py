@@ -509,6 +509,13 @@ class StreamServer:
         t = self._worker_thread
         return t is not None and t.is_alive()
 
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the worker last completed (started) a sweep —
+        the liveness AGE an external probe reads to tell a wedged
+        worker (old beat, thread alive) from a healthy idle one (fresh
+        beat): ``worker_alive`` alone cannot make that distinction."""
+        return max(0.0, time.monotonic() - self._worker_beat)
+
     def metrics_endpoint(self, **kw):
         """Start a scrape endpoint wired to this server:
         ``/metrics`` renders the process registry, ``/healthz`` reports

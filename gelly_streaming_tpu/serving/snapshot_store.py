@@ -24,10 +24,12 @@ on ingest — the same discipline as the producer loop's zero-D2H rule
 
 from __future__ import annotations
 
+import os
+import pickle
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Mapping, Optional
+from typing import Any, Iterator, Mapping, Optional, Tuple
 
 from ..obs.registry import get_registry
 
@@ -96,6 +98,7 @@ class SnapshotStore:
         self._recent: tuple = ()  # newest-first, immutable (atomic swap)
         self._cond = threading.Condition()
         self._closed = False
+        self._listeners: tuple = ()  # immutable, swapped whole
 
     # -- read side ----------------------------------------------------- #
     def latest(self, prefer_ready: bool = False) -> Optional[PublishedSnapshot]:
@@ -170,7 +173,28 @@ class SnapshotStore:
         self._current = snap
         with self._cond:
             self._cond.notify_all()
+        for cb in self._listeners:
+            try:
+                cb(snap)
+            except Exception:
+                # a listener failure (a full disk under the snapshot
+                # mirror, say) must never take the ingest thread down
+                # with it — the local snapshot is already published
+                get_registry().counter(
+                    "serving.swallowed", site="publish_listener"
+                ).inc()
         return snap
+
+    def add_listener(self, cb) -> None:
+        """Call ``cb(snapshot)`` on the WRITER's thread after every
+        publish — the hook the cross-process failover mirror uses to
+        persist each snapshot. Listeners run inline with ingest, so
+        they must be cheap or throttle themselves; a raising listener
+        is counted and skipped, never fatal."""
+        self._listeners = (*self._listeners, cb)
+
+    def remove_listener(self, cb) -> None:
+        self._listeners = tuple(x for x in self._listeners if x is not cb)
 
     def close(self) -> None:
         """Release any ``wait_for`` sleepers; the last snapshot stays
@@ -178,3 +202,177 @@ class SnapshotStore:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+
+# --------------------------------------------------------------------- #
+# Cross-process half: the shared snapshot directory
+# --------------------------------------------------------------------- #
+# A standby serving BINARY cannot share an in-memory store with its
+# primary; what it can share is a directory. The mirror persists each
+# published snapshot with the checkpoint commit discipline
+# (``resilience/integrity.py``: CRC-framed container, temp-and-replace —
+# a kill at any byte leaves the previous snapshot fully loadable), and
+# the follower turns that directory back into a ``(payload, watermark)``
+# emission iterator a standby ``StreamServer`` ingests like any other
+# servable. Torn or bit-rotted files are REJECTED (counted, warned) and
+# the follower falls back to the newest older snapshot — the standby
+# never serves a half-written table.
+
+#: snapshot file name prefix in a shared serving directory
+SNAP_PREFIX = "snap.v"
+
+
+def _snap_path(dirpath: str, version: int) -> str:
+    return os.path.join(dirpath, f"{SNAP_PREFIX}{version:010d}.bin")
+
+
+def _snap_versions(dirpath: str) -> list:
+    """Committed snapshot versions under ``dirpath``, newest first."""
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        if n.startswith(SNAP_PREFIX) and n.endswith(".bin"):
+            try:
+                out.append(int(n[len(SNAP_PREFIX):-len(".bin")]))
+            except ValueError:
+                continue
+    out.sort(reverse=True)
+    return out
+
+
+class SnapshotMirror:
+    """Primary-side disk mirror: persist every Nth published snapshot.
+
+    Attach via ``store.add_listener(mirror)``; runs on the ingest
+    thread, so ``every`` throttles the disk cost for fast windows. With
+    ``every > 1`` up to ``every - 1`` TRAILING windows are not on disk
+    at any instant — a primary killed mid-stride fails over to the
+    newest committed stride, the bounded-staleness trade the knob buys.
+    :meth:`flush` closes the gap at the points where it can be closed:
+    the replica runtime calls it when ingest ENDS and on clean close,
+    so the final published snapshot always lands then. Payload values
+    must be picklable — numpy/JAX arrays are materialized to host
+    numpy at write time; a payload that cannot be pickled (an exotic
+    vertex dict holding native state) cannot be disk-mirrored and
+    should publish a host-shaped payload instead.
+    """
+
+    def __init__(self, dirpath: str, *, keep: int = 2, every: int = 1):
+        self.dirpath = dirpath
+        self.keep = max(1, int(keep))
+        self.every = max(1, int(every))
+        self._written = -1  # newest version committed by THIS mirror
+        os.makedirs(dirpath, exist_ok=True)
+
+    def __call__(self, snap: PublishedSnapshot) -> None:
+        if snap.version % self.every == 0:
+            self.write(snap)
+
+    def flush(self, store: "SnapshotStore") -> None:
+        """Commit the store's newest snapshot if the stride skipped it.
+        Idempotent per version; a concurrent listener write of the same
+        version is harmless (same content, atomic replace)."""
+        snap = store.latest()
+        if snap is not None and snap.version > self._written:
+            self.write(snap)
+
+    def write(self, snap: PublishedSnapshot) -> str:
+        """Commit one snapshot atomically; returns the committed path."""
+        import numpy as np
+
+        from ..resilience import integrity
+
+        payload = {}
+        for k, v in snap.payload.items():
+            # arrays go to host now (a disk mirror of a device buffer
+            # is a copy either way); non-array values (the vdict) ride
+            # pickle as-is
+            payload[k] = np.asarray(v) if hasattr(v, "shape") else v
+        doc = {
+            "window": snap.window,
+            "watermark": snap.watermark,
+            "version": snap.version,
+            "payload": payload,
+        }
+        data = integrity.wrap_checksummed(pickle.dumps(doc, protocol=4))
+        path = _snap_path(self.dirpath, snap.version)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        integrity.replace_atomic(tmp, path)
+        if snap.version > self._written:
+            self._written = snap.version
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        for v in _snap_versions(self.dirpath)[self.keep:]:
+            try:
+                os.unlink(_snap_path(self.dirpath, v))
+            except OSError:
+                # a standby may have the file open mid-read; the next
+                # prune sweeps it — visible, not fatal
+                get_registry().counter(
+                    "serving.swallowed", site="snapshot_prune"
+                ).inc()
+
+
+def load_newest_snapshot(
+    dirpath: str, *, newer_than: int = -1
+) -> Optional[dict]:
+    """The newest COMMITTED-AND-VALID snapshot doc in ``dirpath`` with
+    ``version > newer_than`` (or None). Torn/corrupt files are rejected
+    through :func:`~gelly_streaming_tpu.resilience.integrity.record_rejection`
+    and the scan falls back to the next older one — the same
+    newest-first-with-fallback discipline as barrier restore."""
+    from ..resilience import integrity
+    from ..resilience.errors import CheckpointCorrupt
+
+    for v in _snap_versions(dirpath):
+        if v <= newer_than:
+            return None
+        path = _snap_path(dirpath, v)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            doc = pickle.loads(
+                integrity.unwrap_checksummed(
+                    data, origin=f"serving snapshot {path}"
+                )
+            )
+        except FileNotFoundError:
+            continue  # pruned between listdir and read: benign race
+        except (CheckpointCorrupt, OSError, pickle.UnpicklingError,
+                EOFError, AttributeError) as e:
+            integrity.record_rejection(path, repr(e))
+            continue
+        if doc.get("payload") is None:
+            integrity.record_rejection(path, "no payload in snapshot doc")
+            continue
+        return doc
+    return None
+
+
+def follow_snapshots(
+    dirpath: str,
+    stop: threading.Event,
+    *,
+    poll_s: float = 0.05,
+) -> Iterator[Tuple[dict, int]]:
+    """Standby-side emission iterator over a shared snapshot directory:
+    yields ``(payload, watermark)`` once per NEW committed snapshot
+    version until ``stop`` is set. Plug it into a ``StreamServer`` as a
+    bare servable (``source=None``) and the standby serves whatever the
+    primary last mirrored — including after the primary dies (the
+    keep-serving-from-final-state contract, now across processes)."""
+    last = -1
+    while not stop.is_set():
+        doc = load_newest_snapshot(dirpath, newer_than=last)
+        if doc is None:
+            stop.wait(poll_s)
+            continue
+        last = int(doc["version"])
+        yield doc["payload"], int(doc["watermark"])

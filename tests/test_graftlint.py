@@ -327,6 +327,80 @@ def test_gl003_bare_and_tuple_broad_handlers_fire(tmp_path):
     assert rule_ids(res).count("GL003") == 2
 
 
+# Check #2 (PR 8): the threaded-socket scope. TRUE POSITIVE — a socket
+# handler that catches everything, tears down its connection, and moves
+# on has done "something" (the base check passes it) but destroyed the
+# only evidence a wire fault happened; in serving/rpc.py that shape is
+# a finding.
+GL003_SOCKET_POS = {
+    "serving/rpc.py": """
+    class RpcServer:
+        def _handle(self, conn):
+            while True:
+                try:
+                    frame = conn.read()
+                except Exception:
+                    conn.close()
+                    break
+    """,
+}
+
+# NEAR-MISSES: (a) the same handler counting rpc.malformed is clean in
+# scope; (b) the IDENTICAL uncounted shape outside the socket modules
+# stays clean (check #2 is scoped; elsewhere real recovery action
+# without a count remains acceptable).
+GL003_SOCKET_NEG = {
+    "serving/rpc.py": """
+    class RpcServer:
+        def _handle(self, conn):
+            while True:
+                try:
+                    frame = conn.read()
+                except Exception as e:
+                    get_registry().counter(
+                        "rpc.malformed", kind="truncated"
+                    ).inc()
+                    conn.close()
+                    break
+    """,
+    "core/pipeline.py": """
+    class Prefetcher:
+        def _drain(self, conn):
+            while True:
+                try:
+                    item = conn.read()
+                except Exception:
+                    conn.close()
+                    break
+    """,
+}
+
+
+def test_gl003_socket_scope_uncounted_teardown_fires(tmp_path):
+    res = lint_files(tmp_path, GL003_SOCKET_POS)
+    hits = [f for f in res.findings if f.rule == "GL003"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "RpcServer._handle"
+    assert "threaded socket code" in hits[0].message
+
+
+def test_gl003_socket_scope_counting_and_out_of_scope_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL003_SOCKET_NEG)
+    assert "GL003" not in rule_ids(res)
+
+
+def test_gl003_socket_scope_reraise_is_evidence(tmp_path):
+    res = lint_files(tmp_path, {"serving/client.py": """
+    class RpcClient:
+        def _read_loop(self, wire):
+            try:
+                return wire.read()
+            except Exception as e:
+                raise RpcError(str(e)) from e
+    """})
+    assert "GL003" not in rule_ids(res)
+
+
 # --------------------------------------------------------------------- #
 # GL004 host-sync-in-hot-path
 # --------------------------------------------------------------------- #

@@ -16,6 +16,18 @@ genuinely benign swallow keeps a reasoned inline suppression instead.
 
 Narrow handlers (``except queue.Empty: pass``) are fine — they name
 exactly what they expect.
+
+**Threaded socket code is held to a STRICTER bar** (PR 8): in
+:data:`THREADED_SOCKET_MODULES` — the RPC server's per-connection
+handler threads and the client's io/reader threads — a broad handler
+must count a registry event or re-raise EVEN WHEN its body does other
+work. The shipped-bug shape this encodes: a socket handler that
+catches everything, closes its connection, and moves on has destroyed
+the only evidence a wire fault ever happened; the fuzz contract
+("every malformed frame is a counted ``rpc.malformed{kind}``") is only
+structural if no broad handler on the socket path can swallow
+uncounted. Elsewhere, a handler that takes real recovery action
+remains fine without a count.
 """
 
 from __future__ import annotations
@@ -26,6 +38,39 @@ from typing import Iterator
 from ..core import Finding, LintModule, Rule, last_attr, dotted
 
 _BROAD = {"Exception", "BaseException"}
+
+#: modules whose worker/handler threads sit directly on sockets; broad
+#: handlers here must leave registry evidence (check #2)
+THREADED_SOCKET_MODULES = (
+    "serving/rpc.py",
+    "serving/client.py",
+)
+
+#: calls that count as "left registry evidence": instrument factories
+#: (the ``get_registry().counter(...).inc()`` idiom) and the shared
+#: rejection recorder
+_EVIDENCE_CALLS = {"counter", "gauge", "histogram", "record_rejection"}
+
+
+def _leaves_evidence(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises or makes a registry call.
+    The factory is matched by its TERMINAL attribute so the dominant
+    idiom ``get_registry().counter(...).inc()`` is seen too (the
+    intermediate Call breaks a plain dotted-name lookup — the same
+    shape GL005's mutation matcher handles)."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            else:
+                fname = last_attr(dotted(node.func))
+            if fname in _EVIDENCE_CALLS:
+                return True
+    return False
 
 
 def _is_broad(handler: ast.ExceptHandler) -> bool:
@@ -58,15 +103,28 @@ class SilentSwallow(Rule):
     title = "broad except handler that swallows without evidence"
 
     def check(self, mod: LintModule) -> Iterator[Finding]:
+        socket_scope = mod.relpath.endswith(THREADED_SOCKET_MODULES)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue
-            if _is_broad(node) and _body_does_nothing(node):
-                caught = "bare except" if node.type is None else \
-                    f"except {ast.unparse(node.type)}"
+            if not _is_broad(node):
+                continue
+            caught = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            if _body_does_nothing(node):
                 yield mod.finding(
                     "GL003", node,
                     f"{caught} swallows silently — count a registry "
                     f"event (e.g. counter('...swallowed', site=...)) "
                     f"or classify via resilience/errors.py",
+                )
+            elif socket_scope and not _leaves_evidence(node):
+                # check #2: threaded socket code — doing "something"
+                # (closing the connection, breaking the loop) is not
+                # evidence; the wire fault must be counted or re-raised
+                yield mod.finding(
+                    "GL003", node,
+                    f"{caught} in threaded socket code swallows without "
+                    f"registry evidence — count an rpc.* event (e.g. "
+                    f"counter('rpc.malformed', kind=...)) or re-raise",
                 )
