@@ -2071,6 +2071,23 @@ def main():
             f"failures={doc['failures']} outage={doc.get('outage_s')}s "
             f"steady_p99={doc['steady']['p99_ms']}ms "
             f"promo_p99={doc['promotion_window']['p99_ms']}ms")
+        # the per-stage attribution table (ISSUE 9): where an answered
+        # batch's milliseconds went, steady vs promotion window, from
+        # the merged trace spans in the OBS log
+        attr = doc.get("attribution") or {}
+        for bucket in ("steady", "promotion_window"):
+            b = attr.get(bucket) or {}
+            log(f"serving-rpc attribution[{bucket}]: "
+                f"traces={b.get('traces')} "
+                f"e2e_p50={((b.get('e2e_ms') or {}).get('p50'))}ms "
+                f"stages_ms={b.get('stages_ms')} "
+                f"client_wait={b.get('client_wait_ms')}ms "
+                f"coverage_p50={b.get('coverage_p50')}")
+        log(f"serving-rpc traces: completed="
+            f"{attr.get('traces_completed')} kill_crossing="
+            f"{attr.get('kill_crossing_traces')} example="
+            f"{attr.get('example_kill_crossing_trace')} "
+            f"p99_exemplar={doc.get('wire_p99_exemplar_trace')}")
         print(json.dumps({
             "metric": "serving_rpc_steady_p99_ms",
             "value": doc["steady"]["p99_ms"],
@@ -2080,6 +2097,10 @@ def main():
             "promotion_seconds": doc.get("serving_promotion_seconds"),
             "queries": doc["queries"],
             "failures": doc["failures"],
+            "kill_crossing_traces": attr.get("kill_crossing_traces"),
+            "attribution_coverage_p50": (
+                (attr.get("steady") or {}).get("coverage_p50")
+            ),
             "ok": doc["ok"],
             "artifact": artifact,
             "obs_log": obs_log,

@@ -77,11 +77,17 @@ from .registry import (
 from .trace import (
     NOOP_SPAN,
     Span,
+    TraceContext,
+    activate,
+    current_context,
     current_span,
     disable,
     enable,
     enabled,
+    new_trace_id,
+    next_sid,
     on,
+    record_span,
     span,
 )
 from . import trace as _trace
@@ -97,6 +103,7 @@ from .cluster import (
     ClusterAggregator,
     ShardSink,
     iter_shard_events,
+    iter_trace_events,
     shard_events_path,
 )
 from .flight import FlightRecorder, read_dump
@@ -152,7 +159,10 @@ __all__ = [
     "ShardSink",
     "NOOP_SPAN",
     "Span",
+    "TraceContext",
+    "activate",
     "attach_sink",
+    "current_context",
     "current_span",
     "detach_sink",
     "disable",
@@ -161,9 +171,13 @@ __all__ = [
     "format_key",
     "get_registry",
     "iter_shard_events",
+    "iter_trace_events",
     "nearest_rank",
+    "new_trace_id",
+    "next_sid",
     "on",
     "prometheus_text",
+    "record_span",
     "read_dump",
     "read_jsonl",
     "replay",

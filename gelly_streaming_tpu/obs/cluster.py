@@ -353,3 +353,19 @@ def iter_shard_events(root, *, order: bool = True) -> Iterator[dict]:
         out.sort(key=lambda t: (t[0], t[1]))
     for _, _, e in out:
         yield e
+
+
+def iter_trace_events(root, trace_id: str,
+                      *, order: bool = True) -> Iterator[dict]:
+    """One trace's events across every shard under ``root`` — the
+    span/metric events stamped with ``trace == trace_id``, shard-
+    stamped and ``ts``-ordered. This is the cross-process join the
+    trace surface stands on: a client process's batch span, a dead
+    primary's partial decode/admit spans, and the promoted standby's
+    answer spans all carry the same trace id, so this filter over the
+    merged stream IS the causal story (rendered by
+    ``obs.timeline --trace <id>``, served by the endpoint's
+    ``/trace/<id>``)."""
+    for e in iter_shard_events(root, order=order):
+        if e.get("trace") == trace_id:
+            yield e

@@ -21,6 +21,10 @@ Routes:
 - ``GET /events`` — the newest N merged events as JSON lines (aggregator
   or flight-recorder tail), ``?n=`` bounded; the quick look a human
   takes before reaching for the timeline tool.
+- ``GET /trace/<id>`` — the newest events of ONE trace as ndjson (the
+  merged tail filtered to ``trace == id``): paste a latency exemplar's
+  trace id and read that query's causal path live, without waiting for
+  the committed logs.
 
 Attachment points: :meth:`MetricsEndpoint.for_server` wires a
 ``StreamServer`` or ``FailoverServer`` (their ``metrics_endpoint()``
@@ -42,6 +46,19 @@ from typing import Callable, Optional
 
 from .export import prometheus_text
 from .registry import MetricRegistry, get_registry
+
+
+def _query_n(query: str) -> Optional[int]:
+    """The ``?n=`` tail bound shared by /events and /trace/<id>;
+    None (the endpoint default) when absent or non-numeric."""
+    n = None
+    for part in query.split("&"):
+        if part.startswith("n="):
+            try:
+                n = int(part[2:])
+            except ValueError:
+                n = None
+    return n
 
 
 class MetricsEndpoint:
@@ -120,6 +137,27 @@ class MetricsEndpoint:
             return self.aggregator.events(last=n)
         return []
 
+    def render_trace(self, trace_id: str,
+                     n: Optional[int] = None) -> list:
+        """The newest events of ONE trace (``/trace/<id>``): the
+        merged event tail filtered to ``trace == trace_id``. Served
+        from the aggregator's bounded event window (or the ``events``
+        callable's tail), so it is the LIVE tail of a trace, not an
+        archival lookup — the full story belongs to
+        ``obs.timeline --trace`` over the committed logs."""
+        n = self.events_tail if n is None else max(0, int(n))
+        if self._events is not None:
+            # ask the callable for its whole available tail; the trace
+            # filter below does the narrowing
+            evs = list(self._events(1 << 20))
+        elif self.aggregator is not None:
+            self.aggregator.poll()
+            evs = self.aggregator.events()
+        else:
+            return []
+        hits = [e for e in evs if e.get("trace") == trace_id]
+        return hits[-n:] if n > 0 else []
+
     # ------------------------------------------------------------------ #
     def start(self) -> "MetricsEndpoint":
         endpoint = self
@@ -152,16 +190,18 @@ class MetricsEndpoint:
                             "application/json",
                         )
                     elif path == "/events":
-                        n = None
-                        for part in query.split("&"):
-                            if part.startswith("n="):
-                                try:
-                                    n = int(part[2:])
-                                except ValueError:
-                                    n = None
                         body = "".join(
                             json.dumps(e) + "\n"
-                            for e in endpoint.render_events(n)
+                            for e in endpoint.render_events(
+                                _query_n(query))
+                        ).encode()
+                        self._send(200, body, "application/x-ndjson")
+                    elif path.startswith("/trace/"):
+                        trace_id = path[len("/trace/"):]
+                        body = "".join(
+                            json.dumps(e) + "\n"
+                            for e in endpoint.render_trace(
+                                trace_id, _query_n(query))
                         ).encode()
                         self._send(200, body, "application/x-ndjson")
                     else:

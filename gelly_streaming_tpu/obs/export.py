@@ -115,7 +115,7 @@ def replay(events: Iterable[dict],
                 e["name"],
                 max_samples=e.get("max_samples", DEFAULT_MAX_SAMPLES),
                 **labels,
-            ).observe(e["v"])
+            ).observe(e["v"], exemplar=e.get("ex"))
         # spans / meta: evidence only, not registry state
     return reg
 

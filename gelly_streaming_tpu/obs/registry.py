@@ -131,9 +131,21 @@ class Histogram(_Instrument):
     the full lifetime. Eviction is deterministic in the observation
     sequence — replaying the same observations reconstructs the same
     sample list, hence identical percentiles.
+
+    EXEMPLARS (ISSUE 9): ``observe(v, exemplar=trace_id)`` attaches a
+    trace id to the observation; the histogram keeps the
+    :data:`MAX_EXEMPLARS` LARGEST exemplar-carrying observations, so a
+    p99 bucket in a latency histogram links to a concrete trace a human
+    can pull up with ``obs.timeline --trace <id>``. Selection is
+    deterministic in the observation sequence (stable sort, first-seen
+    wins ties), so replay reconstructs identical exemplars.
     """
 
-    __slots__ = ("max_samples", "count", "sum", "min", "max", "_samples")
+    #: how many largest exemplar-carrying observations are retained
+    MAX_EXEMPLARS = 4
+
+    __slots__ = ("max_samples", "count", "sum", "min", "max", "_samples",
+                 "_exemplars")
     kind = "hist"
 
     def __init__(self, name, labels, registry,
@@ -145,8 +157,9 @@ class Histogram(_Instrument):
         self.min = 0.0
         self.max = 0.0
         self._samples: List[float] = []
+        self._exemplars: List[Tuple[float, str]] = []
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             if len(self._samples) >= self.max_samples:
@@ -161,7 +174,21 @@ class Histogram(_Instrument):
                     self.max = v
             self.count += 1
             self.sum += v
-            self._registry._emit(self, v)
+            if exemplar is not None:
+                ex = self._exemplars
+                ex.append((v, str(exemplar)))
+                # stable sort, largest first: equal values keep their
+                # arrival order, so eviction is a pure function of the
+                # observation sequence (the replay-identity contract)
+                ex.sort(key=lambda p: -p[0])
+                del ex[self.MAX_EXEMPLARS:]
+            self._registry._emit(self, v, ex=exemplar)
+
+    def exemplars(self) -> List[Tuple[float, str]]:
+        """``(value, trace_id)`` pairs for the largest exemplar-carrying
+        observations, largest first (copy, taken under the lock)."""
+        with self._lock:
+            return list(self._exemplars)
 
     def samples(self) -> List[float]:
         """Copy of the bounded sample window (taken under the lock)."""
@@ -257,7 +284,8 @@ class MetricRegistry:
             if sink in self._sinks:
                 self._sinks.remove(sink)
 
-    def _emit(self, instrument: _Instrument, value: float) -> None:
+    def _emit(self, instrument: _Instrument, value: float,
+              ex: Optional[str] = None) -> None:
         if not self._sinks:
             return
         event = {
@@ -270,6 +298,10 @@ class MetricRegistry:
         if (instrument.kind == "hist"
                 and instrument.max_samples != DEFAULT_MAX_SAMPLES):
             event["max_samples"] = instrument.max_samples
+        if ex is not None:
+            # the exemplar trace id rides the event, so replay()
+            # reconstructs identical exemplar state from the log
+            event["ex"] = ex
         for s in self._sinks:
             s.emit(event)
 
@@ -290,7 +322,7 @@ class MetricRegistry:
             else:
                 xs = m.samples()
                 xs.sort()
-                out["histograms"][m.key()] = {
+                doc = {
                     "count": m.count,
                     "sum": m.sum,
                     "min": m.min,
@@ -301,6 +333,12 @@ class MetricRegistry:
                         for q in SNAPSHOT_QUANTILES
                     },
                 }
+                exemplars = m.exemplars()
+                if exemplars:
+                    doc["exemplars"] = [
+                        {"v": v, "trace": t} for v, t in exemplars
+                    ]
+                out["histograms"][m.key()] = doc
         return out
 
     def stream(self) -> Iterator[dict]:

@@ -22,6 +22,20 @@ The default view filters to the COORDINATION story (kills, restarts,
 epoch commits / selections / fallbacks / torn epochs, checkpoint
 rejections, promotions, worker deaths, flight dumps); ``--all`` renders
 every event including spans and plain metric mutations.
+
+``--trace <id>`` (ISSUE 9) renders ONE query's causal path instead:
+every event stamped with that trace id — the client's batch root +
+retry/resubmit spans and each replica's decode/admit/dispatch/reply
+spans, across processes, in one ``ts``-ordered story. Trace ids come
+from latency-histogram exemplars, the ``/trace/<id>`` endpoint, or any
+span line in ``--all`` output.
+
+``--since <ts>`` / ``--until <ts>`` window the merged stream before
+rendering (the chaos OBS logs run to thousands of events; a kill
+point's neighborhood should not need grep). Values are absolute unix
+timestamps, or run-relative seconds when prefixed with ``+``
+(``--since +12 --until +14`` shows the two seconds after +12s, in the
+same clock the rendered ``+...s`` column uses).
 """
 
 from __future__ import annotations
@@ -106,6 +120,43 @@ def load_run(root: str) -> List[dict]:
     return events
 
 
+def run_t0(events: Iterable[dict]) -> float:
+    """The run's earliest real timestamp (0.0 when none) — the zero
+    point of the rendered ``+...s`` column and of relative ``--since``/
+    ``--until`` values."""
+    stamps = [
+        float(e["ts"]) for e in events
+        if isinstance(e.get("ts"), (int, float)) and e["ts"]
+    ]
+    return min(stamps) if stamps else 0.0
+
+
+def filter_events(
+    events: Iterable[dict],
+    *,
+    since: Optional[float] = None,
+    until: Optional[float] = None,
+    trace: Optional[str] = None,
+) -> List[dict]:
+    """Window/trace filter over a merged event stream (the pure core of
+    the ``--since``/``--until``/``--trace`` CLI flags). ``since``/
+    ``until`` are ABSOLUTE timestamps (the CLI resolves ``+N``
+    relative forms against :func:`run_t0` first); bounds are inclusive.
+    ``trace`` keeps only events stamped with that trace id."""
+    out = []
+    for e in events:
+        if trace is not None and e.get("trace") != trace:
+            continue
+        ts = e.get("ts")
+        ts = float(ts) if isinstance(ts, (int, float)) else 0.0
+        if since is not None and ts < since:
+            continue
+        if until is not None and ts > until:
+            continue
+        out.append(e)
+    return out
+
+
 def _fmt_labels(e: dict) -> str:
     labels = dict(e.get("labels") or {})
     labels.pop("shard", None)  # already the line's [shard] column
@@ -119,11 +170,7 @@ def render(events: Iterable[dict], *, all_events: bool = False,
     the programmatic surface tests pin)."""
     events = list(events)
     if t0 is None:
-        stamps = [
-            float(e["ts"]) for e in events
-            if isinstance(e.get("ts"), (int, float)) and e["ts"]
-        ]
-        t0 = min(stamps) if stamps else 0.0
+        t0 = run_t0(events)
     lines = []
     for e in events:
         name = e.get("name", "")
@@ -154,26 +201,72 @@ def render(events: Iterable[dict], *, all_events: bool = False,
     return lines
 
 
+def _take_value(argv: List[str], flag: str) -> Optional[str]:
+    """Pop ``--flag value`` (or ``--flag=value``) out of argv."""
+    for i, a in enumerate(argv):
+        if a == flag:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{flag} needs a value")
+            value = argv[i + 1]
+            del argv[i:i + 2]
+            return value
+        if a.startswith(flag + "="):
+            del argv[i]
+            return a[len(flag) + 1:]
+    return None
+
+
+def _resolve_ts(raw: Optional[str], t0: float, flag: str
+                ) -> Optional[float]:
+    """``+N`` is run-relative seconds; anything else an absolute
+    timestamp."""
+    if raw is None:
+        return None
+    try:
+        if raw.startswith("+"):
+            return t0 + float(raw[1:])
+        return float(raw)
+    except ValueError:
+        raise SystemExit(f"{flag} wants a number, got {raw!r}") from None
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    trace = _take_value(argv, "--trace")
+    since_raw = _take_value(argv, "--since")
+    until_raw = _take_value(argv, "--until")
     all_events = "--all" in argv
     roots = [a for a in argv if not a.startswith("--")]
     if not roots:
         print(
             "usage: python -m gelly_streaming_tpu.obs.timeline "
-            "<run-dir|events.jsonl> [--all]",
+            "<run-dir|events.jsonl> [--all] [--trace <id>] "
+            "[--since <ts|+s>] [--until <ts|+s>]",
             file=sys.stderr,
         )
         return 2
     rc = 0
     for root in roots:
         events = load_run(root)
-        lines = render(events, all_events=all_events)
+        # offsets stay anchored to the RUN's start even when a window
+        # or trace filter narrows the view — the +N column must mean
+        # the same instant with and without filters
+        t0 = run_t0(events)
+        shown_events = filter_events(
+            events,
+            since=_resolve_ts(since_raw, t0, "--since"),
+            until=_resolve_ts(until_raw, t0, "--until"),
+            trace=trace,
+        )
+        # a trace view IS the story: render every one of its events
+        lines = render(shown_events, all_events=all_events or
+                       trace is not None, t0=t0)
         if not lines:
             print(f"{root}: no events", file=sys.stderr)
             rc = 1
             continue
-        shown = "all" if all_events else "story"
+        shown = (f"trace {trace}" if trace is not None
+                 else "all" if all_events else "story")
         print(f"# {root}: {len(events)} events, {len(lines)} shown "
               f"({shown})")
         for line in lines:

@@ -446,6 +446,157 @@ def failover_main(cfg: dict) -> None:
 # RPC cross-process failover scenario (kill the serving BINARY under
 # live multi-connection wire traffic)
 # --------------------------------------------------------------------- #
+#: the per-stage keys of the attribution table: client_send (submit ->
+#: bytes on the wire), the server-side stages in wire order, then
+#: client_recv (response frame -> futures settled); client_wait covers
+#: retry/resubmit outage spans separately
+ATTRIBUTION_STAGES = (
+    "client_send", "decode", "admit", "queue_wait", "dispatch",
+    "settle", "reply", "client_recv",
+)
+
+
+def trace_attribution(
+    root,
+    kill_wall: Optional[float] = None,
+    back_wall: Optional[float] = None,
+) -> dict:
+    """Fold a traced RPC run's merged span stream into the per-stage
+    attribution table (ISSUE 9).
+
+    Per trace with a completed client root span (``rpc.client.batch``):
+    the end-to-end client measurement, the answering replica's
+    server-side residence (newest ``rpc.server.batch``) with its stage
+    breakdown (decode/admit from its attrs; queue_wait/dispatch/settle
+    from the answering sweep's ``serving.query``), the client-side wait
+    spans (retry/resubmit), and the attribution COVERAGE — attributed
+    time over the client's own e2e, the honesty ratio the bench
+    asserts. Traces are bucketed steady vs promotion-window by overlap
+    with ``[kill_wall, back_wall]``; a trace counts as KILL-CROSSING
+    when its client waited out an outage (resubmit/retry span) and its
+    server spans came from at least two distinct shards — the dead
+    primary and the promoted standby."""
+    from collections import defaultdict
+
+    from ..obs.cluster import iter_shard_events
+    from ..obs.registry import nearest_rank
+
+    by_trace: dict = defaultdict(list)
+    for e in iter_shard_events(root):
+        if e.get("kind") == "span" and e.get("trace"):
+            by_trace[e["trace"]].append(e)
+
+    def bucket():
+        return {
+            "e2e": [], "coverage": [], "client_wait": [],
+            "unattributed": [], "stages": defaultdict(list),
+        }
+
+    per = {"steady": bucket(), "promotion_window": bucket()}
+    crossing = 0
+    completed = 0
+    example = None
+    for tid in sorted(by_trace):
+        spans = by_trace[tid]
+        roots = [s for s in spans if s["name"] == "rpc.client.batch"]
+        if not roots:
+            continue  # unanswered (expired) or foreign trace
+        completed += 1
+        c = roots[-1]
+        e2e = float(c["dur_s"])
+        end = float(c["ts"])
+        start = end - e2e
+        promo = (
+            kill_wall is not None and back_wall is not None
+            and end >= kill_wall and start <= back_wall
+        )
+        server_batches = sorted(
+            (s for s in spans if s["name"] == "rpc.server.batch"),
+            key=lambda s: float(s["ts"]),
+        )
+        sweeps = sorted(
+            (s for s in spans if s["name"] == "serving.query"),
+            key=lambda s: float(s["ts"]),
+        )
+        waits = [
+            s for s in spans
+            if s["name"] in ("rpc.client.retry", "rpc.client.resubmit")
+        ]
+        server_s = float(server_batches[-1]["dur_s"]) \
+            if server_batches else 0.0
+        wait_s = sum(float(s["dur_s"]) for s in waits)
+        c_at = c.get("attrs") or {}
+        send_s = float(c_at.get("send_s", 0.0))
+        recv_s = float(c_at.get("recv_s", 0.0))
+        # send_s spans submit -> LAST send, so for a retried batch it
+        # overlaps the wait spans (which cover send -> resend cycles);
+        # take whichever accounts for more, never both
+        attributed = server_s + recv_s + max(send_s, wait_s)
+        server_shards = {
+            s.get("shard") for s in spans
+            if s["name"] in ("rpc.decode", "rpc.admit",
+                             "rpc.server.batch", "serving.query")
+        } - {None}
+        if waits and len(server_shards) >= 2:
+            crossing += 1
+            if example is None:
+                example = tid
+        b = per["promotion_window" if promo else "steady"]
+        b["e2e"].append(e2e)
+        b["coverage"].append(attributed / e2e if e2e > 0 else 1.0)
+        b["client_wait"].append(wait_s)
+        b["unattributed"].append(max(0.0, e2e - attributed))
+        b["stages"]["client_send"].append(send_s)
+        b["stages"]["client_recv"].append(recv_s)
+        if server_batches:
+            at = server_batches[-1].get("attrs") or {}
+            b["stages"]["decode"].append(float(at.get("decode_s", 0.0)))
+            b["stages"]["admit"].append(float(at.get("admit_s", 0.0)))
+            b["stages"]["reply"].append(float(at.get("reply_s", 0.0)))
+        if sweeps:
+            at = sweeps[-1].get("attrs") or {}
+            b["stages"]["queue_wait"].append(
+                float(at.get("queue_wait_s", 0.0)))
+            b["stages"]["dispatch"].append(
+                float(at.get("dispatch_s", 0.0)))
+            b["stages"]["settle"].append(
+                float(at.get("settle_s", 0.0)))
+
+    def summarize(b: dict) -> dict:
+        e2e_ms = sorted(v * 1e3 for v in b["e2e"])
+        cov = sorted(b["coverage"])
+
+        def mean_ms(xs):
+            return round(sum(xs) / len(xs) * 1e3, 3) if xs else None
+
+        return {
+            "traces": len(b["e2e"]),
+            # None for an empty bucket, like every other field here —
+            # a 0.0 p50 would read as "measured zero latency"
+            "e2e_ms": {
+                "p50": round(nearest_rank(e2e_ms, 50), 3),
+                "p99": round(nearest_rank(e2e_ms, 99), 3),
+            } if e2e_ms else None,
+            "stages_ms": {
+                k: mean_ms(b["stages"][k]) for k in ATTRIBUTION_STAGES
+            },
+            "client_wait_ms": mean_ms(b["client_wait"]),
+            "unattributed_ms": mean_ms(b["unattributed"]),
+            "coverage_p50": (
+                round(nearest_rank(cov, 50), 4) if cov else None
+            ),
+        }
+
+    return {
+        "traces_total": len(by_trace),
+        "traces_completed": completed,
+        "kill_crossing_traces": crossing,
+        "example_kill_crossing_trace": example,
+        "steady": summarize(per["steady"]),
+        "promotion_window": summarize(per["promotion_window"]),
+    }
+
+
 def run_rpc_scenario(
     root: str,
     *,
@@ -477,11 +628,23 @@ def run_rpc_scenario(
     flight dump. Client-MEASURED batch latency is reported separately
     for steady state and for the promotion window (batches whose life
     overlapped the outage), which is the artifact's headline.
+
+    ISSUE 9 adds the TRACED run: the driver enables tracing and ships
+    its client-side spans as shard ``p2``, so the merged OBS log holds
+    end-to-end traces — client batch root + retry/resubmit spans joined
+    to each replica's decode/admit/dispatch/reply spans by trace id.
+    The committed artifact gains a per-stage ATTRIBUTION table (steady
+    vs promotion window), and the scenario additionally asserts that at
+    least one trace CROSSES the kill (client resubmit spans joined to
+    both the dead primary's and the promoted standby's server spans)
+    and that per-stage attribution accounts for the client-measured
+    end-to-end latency of answered steady-state batches to within 10%.
     """
     import threading
 
-    from ..obs.cluster import shard_events_path
-    from ..obs.registry import nearest_rank
+    from ..obs import trace as obs_trace
+    from ..obs.cluster import ShardSink, shard_events_path
+    from ..obs.registry import get_registry, nearest_rank
     from ..serving.client import RpcClient
     from ..serving.query import ConnectedQuery
     from ..serving.rpc import spawn_replica, wait_portfile
@@ -489,6 +652,7 @@ def run_rpc_scenario(
 
     say = log or (lambda s: print(s, file=sys.stderr, flush=True))
     os.makedirs(root, exist_ok=True)
+    client_sink = None
     shared = os.path.join(root, "shared")
     base = dict(
         dir=shared, lease_s=lease_s, windows=1 << 20, pace_s=0.01,
@@ -514,6 +678,21 @@ def run_rpc_scenario(
         ),
     }
     try:
+        # the driver IS the client process of the trace story: its
+        # spans (batch roots, retries, resubmits) and client-side
+        # counters ship as shard p2 next to the replicas' p0/p1
+        # streams. Attached INSIDE the try so a failed setup releases
+        # them in the finally (the PR 7 obs-leak lesson);
+        # registry_spans off for the same reason as replica_main — the
+        # span events themselves are the committed evidence
+        client_sink = ShardSink(shard_events_path(root, 2), shard=2)
+        obs_trace.add_sink(client_sink)
+        get_registry().add_sink(client_sink)
+        obs_trace.enable(registry_spans=False)
+        # perf_counter -> wall-clock offset: span events carry wall
+        # ts, the driver's kill/recovery stamps are perf_counter — one
+        # offset joins the two clocks for promotion-window bucketing
+        wall_off = time.time() - time.perf_counter()
         p_port = wait_portfile(os.path.join(root, "primary.port"))
         s_port = wait_portfile(os.path.join(root, "standby.port"))
         addrs = [f"127.0.0.1:{p_port}", f"127.0.0.1:{s_port}"]
@@ -631,6 +810,23 @@ def run_rpc_scenario(
         flight_dumps = [
             os.path.basename(p) for p in obs_flight.find_dumps(root)
         ]
+
+        # -- per-stage trace attribution (ISSUE 9) ---------------------- #
+        attribution = trace_attribution(
+            root,
+            kill_wall=(t_kill + wall_off if t_kill is not None
+                       else None),
+            back_wall=(t_back + wall_off if t_back is not None
+                       else None),
+        )
+        wire_ex = get_registry().histogram(
+            "rpc.client_wire_seconds"
+        ).exemplars()
+        cov = attribution["steady"]["coverage_p50"]
+        traced_ok = (
+            attribution["kill_crossing_traces"] >= 1
+            and cov is not None and 0.9 <= cov <= 1.05
+        )
         ok = (
             not client_errs
             and failures == 0
@@ -640,6 +836,7 @@ def run_rpc_scenario(
             and promoted
             and len(promotion_obs) >= 1
             and len(flight_dumps) >= 1
+            and traced_ok
         )
         doc.update(
             ok=ok,
@@ -674,12 +871,22 @@ def run_rpc_scenario(
             ),
             promoted=promoted,
             flight_dumps=flight_dumps,
+            attribution=attribution,
+            wire_p99_exemplar_trace=(
+                wire_ex[0][1] if wire_ex else None
+            ),
             note=(
                 "client-measured batch latency over live wire traffic "
                 "across a primary serving-binary kill: zero failures "
                 "means every query was answered or cleanly "
                 "DeadlineExceeded within its own budget; the promotion "
-                "window covers batches whose life overlapped the outage"
+                "window covers batches whose life overlapped the "
+                "outage. attribution breaks answered batches into "
+                "per-stage time from the merged trace spans (steady "
+                "coverage_p50 is attributed/e2e — asserted within 10%); "
+                "wire_p99_exemplar_trace links the wire-latency "
+                "histogram's tail to one renderable trace "
+                "(obs.timeline --trace <id> over the OBS log)"
             ),
         )
         if not ok:
@@ -687,15 +894,24 @@ def run_rpc_scenario(
                 f"failures={failures}, client_errs={len(client_errs)}, "
                 f"primary_rc={primary_rc}, recovered={t_back is not None}, "
                 f"promoted={promoted}, "
+                f"crossing={attribution['kill_crossing_traces']}, "
+                f"coverage_p50={cov}, "
                 f"promotion_obs={len(promotion_obs)}, "
                 f"flight_dumps={len(flight_dumps)}"
             )
         say(f"chaos-rpc: ok={ok} batches={len(records)} "
             f"failures={failures} outage={doc.get('outage_s')}s "
             f"steady_p99={doc['steady']['p99_ms']}ms "
-            f"promo_p99={doc['promotion_window']['p99_ms']}ms")
+            f"promo_p99={doc['promotion_window']['p99_ms']}ms "
+            f"traces={attribution['traces_completed']} "
+            f"crossing={attribution['kill_crossing_traces']} "
+            f"coverage_p50={cov}")
         return doc
     finally:
+        if client_sink is not None:
+            obs_trace.disable()
+            obs_trace.remove_sink(client_sink)
+            get_registry().remove_sink(client_sink)
         for p in (primary, standby):
             if p.poll() is None:
                 p.terminate()
@@ -703,6 +919,8 @@ def run_rpc_scenario(
                     p.wait(20)
                 except Exception:
                     _kill_replica(p)
+        if client_sink is not None:
+            client_sink.close()
         _ship_events(obs_f, root, "rpc_failover")
 
 

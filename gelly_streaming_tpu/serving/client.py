@@ -20,8 +20,19 @@ replica addresses and gives callers the SAME future surface as a local
   so a serving-process kill is visible only as a latency blip. Batches
   whose own ``deadline_s`` lapses mid-outage fail
   :class:`~gelly_streaming_tpu.resilience.errors.DeadlineExceeded`
-  cleanly (``rpc.client_deadline_expired``) — every submitted query is
-  ALWAYS answered or cleanly expired, never lost.
+  cleanly (``rpc.client_deadline_expired`` +
+  ``rpc.client_sweeper_expired``) — every submitted query is ALWAYS
+  answered or cleanly expired, never lost.
+- With tracing on (``obs.enable()``) each batch mints ONE
+  :class:`~gelly_streaming_tpu.obs.trace.TraceContext` that rides every
+  send (first, retry, reconnect resubmit) in the frame body, so server
+  spans on every replica that touched the batch join one trace;
+  ``rpc.client.batch`` / ``rpc.client.retry`` / ``rpc.client.resubmit``
+  spans carry the client half of the story, and the per-batch
+  ``rpc.client_wire_seconds`` histogram (always on) gains exemplar
+  trace ids linking its tail to concrete traces.
+  :meth:`RpcClient.stats_snapshot` is the client-side stats parity
+  surface.
 """
 
 from __future__ import annotations
@@ -35,6 +46,7 @@ import time
 from concurrent.futures import Future, InvalidStateError
 from typing import List, Optional, Sequence, Tuple, Union
 
+from ..obs import trace as _trace
 from ..obs.registry import get_registry
 from ..resilience.errors import DeadlineExceeded
 from ..resilience.retry import RetryPolicy, exp_backoff, jittered
@@ -63,10 +75,14 @@ class RpcError(RuntimeError):
 
 
 class _Batch:
-    """One pending wire batch (client side)."""
+    """One pending wire batch (client side). ``ctx`` is the batch's
+    :class:`~gelly_streaming_tpu.obs.trace.TraceContext` (None when
+    tracing was off at submit): every send — first, retry, reconnect
+    resubmit — rides the SAME context, so server-side spans on every
+    replica that ever touched the batch join one trace."""
 
     __slots__ = ("id", "enc", "futures", "deadline_abs",
-                 "attempts", "routes")
+                 "attempts", "routes", "ctx", "t0", "t_send", "t_resp")
 
     def __init__(self, qid: str, enc: list, futures: list,
                  deadline_abs: Optional[float]):
@@ -76,6 +92,10 @@ class _Batch:
         self.deadline_abs = deadline_abs
         self.attempts = 0   # overloaded re-asks
         self.routes = 0     # not_primary re-asks
+        self.ctx = None
+        self.t0 = 0.0       # perf_counter at submit (e2e measurement)
+        self.t_send = 0.0   # perf_counter at the LAST send attempt
+        self.t_resp = 0.0   # perf_counter when the RESP frame arrived
 
     def remaining_s(self) -> Optional[float]:
         if self.deadline_abs is None:
@@ -183,6 +203,14 @@ class RpcClient:
             else time.monotonic() + float(deadline_s)
         )
         batch = _Batch(qid, enc, futures, deadline_abs)
+        batch.t0 = time.perf_counter()
+        if _trace.on():
+            # mint ONE context per batch; its parent sid is reserved
+            # now so server-side spans can parent to the client's root
+            # span before that root is emitted (at settle)
+            batch.ctx = _trace.TraceContext(
+                parent_sid=_trace.next_sid()
+            )
         with self._lock:
             self._pending[qid] = batch
         wire = self._wire
@@ -222,6 +250,51 @@ class RpcClient:
         with self._lock:
             return len(self._pending)
 
+    def stats_snapshot(self) -> dict:
+        """Client-side serving stats as a plain dict — the parity
+        surface for the server's ``ServingStats.snapshot()`` (ISSUE 9
+        satellite): retries, reroutes, reconnects, resubmits, sweeper
+        expiries, and the per-batch wire latency histogram, all read
+        from the shared process registry (the same instruments the
+        cluster event stream ships), so a client process's view of an
+        outage is inspectable without scraping the server::
+
+            {"pending": 0, "retries": 2, "reconnects": 1, ...,
+             "wire_ms": {"count": 120, "p50": 1.9, "p99": 410.0}}
+        """
+        reg = get_registry()
+
+        def _count(name: str) -> int:
+            total = 0.0
+            for _labels, inst in reg.find(name):
+                total += inst.value
+            return int(total)
+
+        hist = reg.histogram("rpc.client_wire_seconds")
+        doc = {
+            "pending": self.pending(),
+            "connects": _count("rpc.client_connects"),
+            "disconnects": _count("rpc.client_disconnects"),
+            "reconnects": _count("rpc.client_reconnects"),
+            "resubmitted": _count("rpc.client_resubmitted"),
+            "retries": _count("rpc.client_retries"),
+            "reroutes": _count("rpc.client_reroutes"),
+            "sweeper_expired": _count("rpc.client_sweeper_expired"),
+            "deadline_expired": _count("rpc.client_deadline_expired"),
+            "wire_ms": {
+                "count": hist.count,
+                "p50": hist.percentile(50) * 1e3,
+                "p99": hist.percentile(99) * 1e3,
+                "max": hist.max * 1e3,
+            },
+        }
+        exemplars = hist.exemplars()
+        if exemplars:
+            doc["wire_ms"]["exemplars"] = [
+                {"ms": v * 1e3, "trace": t} for v, t in exemplars
+            ]
+        return doc
+
     # ------------------------------------------------------------------ #
     # Wire plumbing
     # ------------------------------------------------------------------ #
@@ -233,6 +306,9 @@ class RpcClient:
             # resubmit after an outage must not grant the server a
             # fresh full deadline the client no longer has
             doc["deadline_s"] = max(0.001, remaining)
+        if _trace.on() and batch.ctx is not None:
+            doc["tc"] = batch.ctx.to_wire()
+        batch.t_send = time.perf_counter()
         wire.send(pack_frame(T_REQ, json.dumps(doc).encode("utf-8")))
 
     def _io_loop(self) -> None:
@@ -289,6 +365,22 @@ class RpcClient:
             "rpc.client_resubmitted"
         ).inc(len(batches))
         for b in batches:
+            # t_send == 0 means the batch was registered but never yet
+            # on any wire (submit raced the first connect): that is a
+            # first send, not an outage — no resubmit span for it
+            if _trace.on() and b.ctx is not None and b.t_send > 0.0:
+                # the batch's client-visible outage: last send on the
+                # dead connection -> resubmit on the new one. This span
+                # is the attribution of a failover's latency blip — it
+                # is what joins the dead replica's partial spans to the
+                # promoted replica's full ones in the merged timeline
+                _trace.record_span(
+                    "rpc.client.resubmit",
+                    time.perf_counter() - b.t_send,
+                    trace_id=b.ctx.trace_id,
+                    parent=b.ctx.parent_sid,
+                    attrs={"id": b.id},
+                )
             try:
                 self._send_batch(wire, b)
             except OSError:
@@ -320,17 +412,19 @@ class RpcClient:
             if ftype != T_RESP:
                 reg.counter("rpc.malformed", kind="type").inc()
                 return
+            t_frame = time.perf_counter()  # frame-arrival stamp
             try:
                 doc = json.loads(payload.decode("utf-8"))
             except (ValueError, UnicodeDecodeError):
                 reg.counter("rpc.malformed", kind="json").inc()
                 continue
-            self._handle_resp(doc)
+            self._handle_resp(doc, t_frame)
 
     # ------------------------------------------------------------------ #
     # Response handling
     # ------------------------------------------------------------------ #
-    def _handle_resp(self, doc: dict) -> None:
+    def _handle_resp(self, doc: dict,
+                     t_frame: Optional[float] = None) -> None:
         reg = get_registry()
         qid = doc.get("id")
         if qid is None:
@@ -342,6 +436,8 @@ class RpcClient:
             batch = self._pending.get(qid)
         if batch is None:
             return  # late duplicate of an already-settled batch
+        if t_frame is not None:
+            batch.t_resp = t_frame
         status = doc.get("status")
         if status == OK:
             self._settle_ok(batch, doc.get("answers"))
@@ -409,6 +505,18 @@ class RpcClient:
         wire = self._wire
         if wire is None:
             return  # the reconnect path resubmits every pending batch
+        if _trace.on() and batch.ctx is not None:
+            # an overloaded/not_primary re-ask: round trip + backoff
+            # since the last send, on the SAME trace — retries are part
+            # of the query's causal story, not fresh queries
+            _trace.record_span(
+                "rpc.client.retry",
+                time.perf_counter() - batch.t_send,
+                trace_id=batch.ctx.trace_id,
+                parent=batch.ctx.parent_sid,
+                attrs={"attempts": batch.attempts,
+                       "routes": batch.routes},
+            )
         try:
             self._send_batch(wire, batch)
         except OSError:
@@ -419,14 +527,48 @@ class RpcClient:
     def _settle_ok(self, batch: _Batch, answers) -> None:
         with self._lock:
             self._pending.pop(batch.id, None)
+        e2e_s = time.perf_counter() - batch.t0
         if not isinstance(answers, list) or \
                 len(answers) != len(batch.futures):
+            # a malformed OK payload is a FAILED batch: it must not
+            # land in the wire-latency histogram (or become its p99
+            # exemplar) or emit a completed batch-root span
             err = RpcError(
                 f"answer count mismatch ({answers!r:.120})"
             )
             for f in batch.futures:
                 self._set_exc(f, err)
             return
+        # per-batch wire latency (submit -> answered), always recorded:
+        # client-side latency parity with the server's ServingStats.
+        # The exemplar (tracing only) links this histogram's tail to a
+        # concrete trace id.
+        traced = _trace.on() and batch.ctx is not None
+        get_registry().histogram("rpc.client_wire_seconds").observe(
+            e2e_s, exemplar=batch.ctx.trace_id if traced else None
+        )
+        if traced:
+            # the batch's ROOT span, emitted under the sid reserved at
+            # submit — every server/retry span already parents to it.
+            # send_s/recv_s are the CLIENT-LOCAL stages of the
+            # attribution table: submit -> last send on the wire, and
+            # response-frame arrival -> this settle (encode, io-thread
+            # wakeup, response parse — the milliseconds a server-only
+            # view can never account for)
+            now = time.perf_counter()
+            _trace.record_span(
+                "rpc.client.batch", e2e_s,
+                trace_id=batch.ctx.trace_id,
+                sid=batch.ctx.parent_sid,
+                attrs={"n": len(batch.futures),
+                       "attempts": batch.attempts,
+                       "routes": batch.routes,
+                       "send_s": round(
+                           max(0.0, batch.t_send - batch.t0), 6),
+                       "recv_s": round(
+                           max(0.0, now - batch.t_resp)
+                           if batch.t_resp > 0.0 else 0.0, 6)},
+            )
         for f, a in zip(batch.futures, answers):
             try:
                 if a[0] == "ok":
@@ -435,6 +577,13 @@ class RpcClient:
                         watermark=int(a[3]), staleness=int(a[4]),
                     ))
                 elif a[0] == "deadline":
+                    # a SERVER-reported expiry (the answer rode a RESP
+                    # frame): counted into the deadline total so
+                    # deadline_expired - sweeper_expired isolates the
+                    # outages the server never answered at all
+                    get_registry().counter(
+                        "rpc.client_deadline_expired"
+                    ).inc()
                     self._set_exc(f, DeadlineExceeded(str(a[1])))
                 else:
                     self._set_exc(f, RpcError(str(a[1])))
@@ -483,8 +632,17 @@ class RpcClient:
                             now > b.deadline_abs:
                         expired.append(self._pending.pop(qid))
             for b in expired:
+                # deadline_expired totals EVERY client-visible expiry
+                # (these sweeper batches + the server-reported
+                # per-answer expiries counted in _settle_ok);
+                # sweeper_expired (ISSUE 9 satellite) isolates the
+                # ones the server never answered at all — the outage
+                # signal invisible to the obs plane until now
                 get_registry().counter(
                     "rpc.client_deadline_expired"
+                ).inc()
+                get_registry().counter(
+                    "rpc.client_sweeper_expired"
                 ).inc()
                 exc = DeadlineExceeded(
                     "query batch unanswered within its deadline "

@@ -210,7 +210,11 @@ class FailoverServer:
                     primary._inflight_entries = []
                 now = time.perf_counter()
                 keep = []
-                for q, f, t0, dl in entries:
+                # entries keep their TRACE CONTEXT through adoption:
+                # the standby's answer spans join the same trace the
+                # client minted, so the merged timeline shows one story
+                # spanning submit, death, and the promoted re-answer
+                for q, f, t0, dl, ctx in entries:
                     if f.done():
                         continue
                     if dl is not None and now > dl:
@@ -219,7 +223,7 @@ class FailoverServer:
                         )
                         reg.counter("serving.failover_expired").inc()
                     else:
-                        keep.append((q, f, t0, dl))
+                        keep.append((q, f, t0, dl, ctx))
                 self.standby._adopt(keep)
                 if keep:
                     reg.counter(

@@ -282,9 +282,14 @@ def encode_answer(ans: Answer) -> list:
 # --------------------------------------------------------------------- #
 class _Batch:
     """One in-flight wire batch: futures + answer slots + the delivery
-    connection (re-homed when the client resubmits on a new socket)."""
+    connection (re-homed when the client resubmits on a new socket).
+    ``ctx``/``t_recv``/``decode_s``/``admit_s`` carry the batch's trace
+    context and stage timings from the handler thread to the worker
+    callback that emits the server-side spans (set only when tracing
+    was on at receive time)."""
 
-    __slots__ = ("id", "conn", "futures", "slots", "remaining")
+    __slots__ = ("id", "conn", "futures", "slots", "remaining",
+                 "ctx", "t_recv", "decode_s", "admit_s")
 
     def __init__(self, qid: str, conn: Wire, futures: list):
         self.id = qid
@@ -292,6 +297,10 @@ class _Batch:
         self.futures = futures
         self.slots: list = [None] * len(futures)
         self.remaining = len(futures)
+        self.ctx = None
+        self.t_recv = 0.0
+        self.decode_s = 0.0
+        self.admit_s = 0.0
 
 
 class RpcServer:
@@ -423,6 +432,7 @@ class RpcServer:
                     self._respond(conn, None, ERROR,
                                   error=f"unexpected frame type {ftype}")
                     return
+                t_recv = time.perf_counter()
                 doc = None
                 try:
                     doc = json.loads(payload.decode("utf-8"))
@@ -442,7 +452,24 @@ class RpcServer:
                     self._respond(conn, bad_id, BAD_REQUEST,
                                   error=repr(e)[:200])
                     continue
-                self._serve_batch(conn, qid, queries, deadline_s)
+                # trace extraction is GATED: the tc field is parsed and
+                # a context allocated only when tracing is on (the
+                # disabled wire path stays allocation-identical to
+                # PR 8's); a missing/garbage tc is an untraced batch
+                ctx = None
+                decode_s = 0.0
+                if _trace.on():
+                    ctx = _trace.TraceContext.from_wire(doc.get("tc"))
+                    decode_s = time.perf_counter() - t_recv
+                    if ctx is not None:
+                        _trace.record_span(
+                            "rpc.decode", decode_s,
+                            trace_id=ctx.trace_id,
+                            parent=ctx.parent_sid,
+                            attrs={"id": qid},
+                        )
+                self._serve_batch(conn, qid, queries, deadline_s,
+                                  ctx, t_recv, decode_s)
         finally:
             with self._lock:
                 self._conns.discard(conn)
@@ -450,7 +477,8 @@ class RpcServer:
             reg.counter("rpc.disconnects").inc()
 
     def _serve_batch(self, conn: Wire, qid: str, queries: list,
-                     deadline_s) -> None:
+                     deadline_s, ctx=None, t_recv: float = 0.0,
+                     decode_s: float = 0.0) -> None:
         reg = get_registry()
         with self._lock:
             cached = self._done.get(qid)
@@ -477,11 +505,13 @@ class RpcServer:
             reg.counter("rpc.not_primary").inc()
             self._respond(conn, qid, refusal)
             return
+        t_admit = time.perf_counter()
         futures: list = []
         try:
             for q in queries:
                 futures.append(
-                    self.server.submit(q, deadline_s=deadline_s)
+                    self.server.submit(q, deadline_s=deadline_s,
+                                       ctx=ctx)
                 )
         except Shed as e:
             self._cancel(futures)
@@ -511,6 +541,16 @@ class RpcServer:
             self._respond(conn, qid, ERROR, error=repr(e)[:200])
             return
         batch = _Batch(qid, conn, futures)
+        if _trace.on() and ctx is not None:
+            batch.ctx = ctx
+            batch.t_recv = t_recv
+            batch.decode_s = decode_s
+            batch.admit_s = time.perf_counter() - t_admit
+            _trace.record_span(
+                "rpc.admit", batch.admit_s,
+                trace_id=ctx.trace_id, parent=ctx.parent_sid,
+                attrs={"n": len(queries)},
+            )
         with self._lock:
             self._inflight[qid] = batch
         reg.counter("rpc.batches").inc()
@@ -532,6 +572,7 @@ class RpcServer:
             if batch.remaining:
                 return
             self._inflight.pop(batch.id, None)
+        t_reply = time.perf_counter()
         data = pack_frame(T_RESP, json.dumps(
             {"id": batch.id, "status": OK, "answers": batch.slots}
         ).encode("utf-8"))
@@ -541,6 +582,27 @@ class RpcServer:
                 self._done.popitem(last=False)
             conn = batch.conn
         self._send(conn, data)
+        if _trace.on() and batch.ctx is not None:
+            # wire reply (serialize + send) and the whole server-side
+            # residence of the batch: recv -> last answer on the wire.
+            # The residence span is what the attribution table compares
+            # against the client's own end-to-end measurement.
+            now = time.perf_counter()
+            ctx = batch.ctx
+            _trace.record_span(
+                "rpc.reply", now - t_reply,
+                trace_id=ctx.trace_id, parent=ctx.parent_sid,
+            )
+            _trace.record_span(
+                "rpc.server.batch", now - batch.t_recv,
+                trace_id=ctx.trace_id, parent=ctx.parent_sid,
+                attrs={
+                    "n": len(batch.slots),
+                    "decode_s": round(batch.decode_s, 6),
+                    "admit_s": round(batch.admit_s, 6),
+                    "reply_s": round(now - t_reply, 6),
+                },
+            )
 
     @staticmethod
     def _encode_result(fut) -> list:
@@ -1011,7 +1073,10 @@ def replica_main(cfg: dict) -> None:
         sink = ShardSink(cfg["events"], shard=cfg.get("shard"))
         get_registry().add_sink(sink)
         obs_trace.add_sink(sink)
-        obs_trace.enable()
+        # span events ARE the shipped evidence; the registry mirror
+        # (trace.span_seconds) would double every span in the event
+        # log for a surface nothing scrapes in a bench replica
+        obs_trace.enable(registry_spans=False)
     if cfg.get("flight"):
         obs_flight.install(obs_flight.FlightRecorder(
             cfg["flight"], capacity=128, shard=cfg.get("shard"),

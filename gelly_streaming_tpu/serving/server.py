@@ -151,7 +151,7 @@ class StreamServer:
         self._worker_beat = time.monotonic()
         self._watchdog_thread: Optional[threading.Thread] = None
         self._watchdog_stop = threading.Event()
-        # (query, future, t_submit, deadline_abs_or_None)
+        # (query, future, t_submit, deadline_abs_or_None, trace_ctx)
         self._pending: deque = deque()
         self._inflight = 0  # drained by the worker, not yet answered
         # the drained batch's entries, kept until _settle: if the worker
@@ -256,6 +256,7 @@ class StreamServer:
         *,
         deadline_s: Optional[float] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        ctx=None,
     ) -> "Future[Answer]":
         """Admit one query; resolves to an :class:`~.query.Answer`.
         Raises :class:`Overloaded` at the admission limit — immediately,
@@ -270,12 +271,21 @@ class StreamServer:
         its future fails with
         :class:`~gelly_streaming_tpu.resilience.errors.DeadlineExceeded`
         (``serving.deadline_expired`` counts it) instead of returning
-        an arbitrarily stale answer to a caller that stopped caring."""
+        an arbitrarily stale answer to a caller that stopped caring.
+
+        ``ctx`` is an optional
+        :class:`~gelly_streaming_tpu.obs.trace.TraceContext` the query
+        rides through the pending queue: the worker stamps its stage
+        spans with the trace id, and the context survives failover
+        adoption, so a re-answered query stays on its original trace.
+        When omitted (and tracing is on) the submitting thread's active
+        context is captured — same-process callers inside a span get
+        joined-up traces for free."""
         policy = retry_policy if retry_policy is not None else self.retry_policy
         attempt = 0
         while True:
             try:
-                return self._admit(query, deadline_s)
+                return self._admit(query, deadline_s, ctx)
             except Shed:
                 raise
             except Overloaded:
@@ -287,7 +297,7 @@ class StreamServer:
                 time.sleep(delay)
 
     def _admit(
-        self, query: Query, deadline_s: Optional[float]
+        self, query: Query, deadline_s: Optional[float], ctx=None
     ) -> "Future[Answer]":
         declared = getattr(self._servable, "query_classes", ())
         if declared and not isinstance(query, tuple(declared)):
@@ -344,7 +354,9 @@ class StreamServer:
                 )
             t0 = time.perf_counter()
             deadline = None if deadline_s is None else t0 + float(deadline_s)
-            self._pending.append((query, f, t0, deadline))
+            if ctx is None and _trace.on():
+                ctx = _trace.current_context()
+            self._pending.append((query, f, t0, deadline, ctx))
             self.stats.set_pending(admitted + 1)  # admission gauge
         self._wake.set()
         return f
@@ -380,7 +392,7 @@ class StreamServer:
                     batch.append(entry)
             self._inflight = len(batch)
             self._inflight_entries = batch
-        for q, f, t0, dl in expired:
+        for q, f, t0, dl, _ctx in expired:
             self._expire(q, f, t0, dl, "unanswered after")
         if expired and not batch:
             # the whole drain expired: nothing will reach the answer
@@ -440,27 +452,37 @@ class StreamServer:
             )
             if self._ingest_error is not None:
                 err.__cause__ = self._ingest_error
-            for _, f, _, _ in batch:
+            for _, f, *_rest in batch:
                 f.set_exception(err)
             return
-        queries = [q for q, _, _, _ in batch]
+        queries = [q for q, *_rest in batch]
+        tracing = _trace.on()
+        t_dispatch = time.perf_counter()
         try:
             with _trace.span(
                 "serving.answer",
                 {"batch": len(batch), "window": snap.window}
-                if _trace.on() else None,
+                if tracing else None,
             ):
                 answers = self.engine.answer_batch(
                     snap, queries, head_window=self.store.head_window()
                 )
         except Exception as e:
-            for _, f, _, _ in batch:
+            for _, f, *_rest in batch:
                 if not f.done():
                     f.set_exception(e)
             return
         now = time.perf_counter()
         self.stats.record_batch()
-        for (q, f, t0, dl), ans in zip(batch, answers):
+        # per-trace attribution (ISSUE 9): entries from one wire batch
+        # share a TraceContext; group on it so each traced batch gets
+        # ONE serving.query span carrying the stage breakdown (per-query
+        # spans would multiply the event log by the batch size for no
+        # extra information — queries of a sweep share the dispatch)
+        groups: dict = {} if tracing else None
+        dispatch_s = now - t_dispatch
+        snapshot_age_s = time.monotonic() - snap.published_at
+        for (q, f, t0, dl, ctx), ans in zip(batch, answers):
             # deadline re-check at settle time: a query drained in time
             # but answered late (a slow engine sweep) must still honor
             # its deadline rather than deliver a stale answer the
@@ -468,7 +490,19 @@ class StreamServer:
             if dl is not None and now > dl:
                 self._expire(q, f, t0, dl, "answered after")
                 continue
-            self.stats.record(type(q).__name__, now - t0, ans.staleness)
+            self.stats.record(
+                type(q).__name__, now - t0, ans.staleness,
+                exemplar=ctx.trace_id if tracing and ctx is not None
+                else None,
+            )
+            if tracing and ctx is not None:
+                g = groups.get(id(ctx))
+                if g is None:
+                    groups[id(ctx)] = [ctx, t0, 1, ans.staleness]
+                else:
+                    g[1] = min(g[1], t0)
+                    g[2] += 1
+                    g[3] = max(g[3], ans.staleness)
             # a client may have cancel()ed its future mid-sweep;
             # settling it then raises InvalidStateError, which must not
             # poison the rest of the batch's answers
@@ -479,6 +513,24 @@ class StreamServer:
                     get_registry().counter(
                         "serving.swallowed", site="answer_settle_race"
                     ).inc()
+        if tracing and groups:
+            settle_s = time.perf_counter() - now
+            for ctx, t0_min, n, stale in groups.values():
+                _trace.record_span(
+                    "serving.query",
+                    now - t0_min,
+                    trace_id=ctx.trace_id,
+                    parent=ctx.parent_sid,
+                    attrs={
+                        "n": n,
+                        "queue_wait_s": round(t_dispatch - t0_min, 6),
+                        "dispatch_s": round(dispatch_s, 6),
+                        "settle_s": round(settle_s, 6),
+                        "snapshot_age_s": round(snapshot_age_s, 6),
+                        "staleness": int(stale),
+                        "window": snap.window,
+                    },
+                )
 
     def _worker(self) -> None:
         try:
@@ -529,12 +581,14 @@ class StreamServer:
         return MetricsEndpoint.for_server(self, **kw).start()
 
     def _adopt(self, entries: list) -> None:
-        """Enqueue already-admitted ``(query, future, t0, deadline)``
-        entries from another server — the failover promotion path. The
-        entries keep their original submit times and deadlines, so
-        re-answered queries still report honest latency and expired
-        ones still expire; adoption bypasses admission on purpose (the
-        queries were admitted once; failover must not shed them)."""
+        """Enqueue already-admitted ``(query, future, t0, deadline,
+        ctx)`` entries from another server — the failover promotion
+        path. The entries keep their original submit times, deadlines,
+        AND trace contexts, so re-answered queries still report honest
+        latency and stay on their original trace (the promoted
+        replica's answer span joins the same causal story); adoption
+        bypasses admission on purpose (the queries were admitted once;
+        failover must not shed them)."""
         if not entries:
             return
         with self._lock:
@@ -571,7 +625,7 @@ class StreamServer:
                     # the worker thread must survive ANY answer-path
                     # error — a dead worker hangs every future forever;
                     # fail this batch and keep serving
-                    for _, f, _, _ in batch:
+                    for _, f, *_rest in batch:
                         if not f.done():
                             f.set_exception(e)
                 finally:
@@ -651,7 +705,7 @@ class StreamServer:
                 try:
                     self._answer(leftovers)
                 except BaseException as e:
-                    for _, f, _, _ in leftovers:
+                    for _, f, *_rest in leftovers:
                         if not f.done():
                             f.set_exception(e)
                 finally:

@@ -43,13 +43,17 @@ class ServingStats:
         self._batches = self.registry.counter("serving.batches")
 
     # -- write side (query worker / server) ----------------------------- #
-    def record(self, qclass: str, seconds: float, staleness: int) -> None:
+    def record(self, qclass: str, seconds: float, staleness: int,
+               exemplar: Optional[str] = None) -> None:
         """One answered query: wall seconds from submit to answer, and
-        the answer's windows-behind-head staleness."""
+        the answer's windows-behind-head staleness. ``exemplar`` (a
+        trace id, passed only when tracing is on) links the latency
+        histogram's tail to a concrete trace — see
+        :meth:`~gelly_streaming_tpu.obs.registry.Histogram.observe`."""
         self.registry.histogram(
             "serving.query_seconds", max_samples=self.MAX_SAMPLES,
             cls=qclass,
-        ).observe(seconds)
+        ).observe(seconds, exemplar=exemplar)
         self.registry.histogram(
             "serving.staleness_windows", max_samples=self.MAX_SAMPLES,
             cls=qclass,

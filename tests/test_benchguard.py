@@ -1,0 +1,94 @@
+"""tools/benchguard: the non-blocking perf-trajectory checker (ISSUE 9
+satellite). Pure-stdlib comparisons, so the tests run in milliseconds:
+within-bound / regressed / missing-metric / zero-committed verdicts,
+and the CLI's exit codes against real temp artifacts.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python -m pytest` from the checkout has it
+    sys.path.insert(0, REPO)
+
+from tools.benchguard import WATCHED, compare, dig, main  # noqa: E402
+
+
+def doc(p50=10.0, p99=100.0):
+    return {"steady": {"p50_ms": p50, "p99_ms": p99}}
+
+
+def test_dig_walks_dotted_paths():
+    assert dig(doc(), "steady.p99_ms") == 100.0
+    assert dig(doc(), "steady.nope") is None
+    assert dig(doc(), "nope.p99_ms") is None
+    assert dig({"steady": 3}, "steady.p99_ms") is None
+
+
+def test_within_bounds_passes():
+    verdicts = compare(doc(), doc(p50=25.0, p99=250.0), ratio=3.0)
+    assert [v["ok"] for v in verdicts] == [True, True]
+
+
+def test_regression_past_the_ratio_fails_that_metric():
+    verdicts = compare(doc(), doc(p50=10.0, p99=301.0), ratio=3.0)
+    by = {v["metric"]: v for v in verdicts}
+    assert by["steady.p50_ms"]["ok"] is True
+    assert by["steady.p99_ms"]["ok"] is False
+    assert "3.01x" in by["steady.p99_ms"]["note"]
+
+
+def test_missing_metric_is_a_skip_not_a_failure():
+    verdicts = compare(doc(), {"steady": {"p50_ms": 5.0}})
+    by = {v["metric"]: v for v in verdicts}
+    assert by["steady.p99_ms"]["ok"] is None
+    assert "skipped" in by["steady.p99_ms"]["note"]
+
+
+def test_zero_committed_value_cannot_bound():
+    verdicts = compare(doc(p50=0.0), doc())
+    by = {v["metric"]: v for v in verdicts}
+    assert by["steady.p50_ms"]["ok"] is None
+
+
+def test_watched_metrics_exist_in_the_committed_artifact():
+    # the guard must stay aligned with the artifact it guards: every
+    # watched path resolves to a number in the committed file
+    path = os.path.join(REPO, "BENCH_SERVING_RPC_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED:
+        assert isinstance(dig(committed, metric), (int, float)), metric
+
+
+def _write(tmp_path, name, document):
+    p = tmp_path / name
+    p.write_text(json.dumps(document))
+    return str(p)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    committed = _write(tmp_path, "committed.json", doc())
+    good = _write(tmp_path, "good.json", doc(p50=12.0, p99=120.0))
+    bad = _write(tmp_path, "bad.json", doc(p50=12.0, p99=999.0))
+    assert main(["--committed", committed, "--fresh", good]) == 0
+    assert main(["--committed", committed, "--fresh", bad]) == 1
+    # a looser explicit ratio lets the same numbers through
+    assert main(["--committed", committed, "--fresh", bad,
+                 "--ratio", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "within bounds" in out
+
+
+def test_cli_usage_and_unreadable_inputs(tmp_path):
+    committed = _write(tmp_path, "committed.json", doc())
+    assert main([]) == 2
+    assert main(["--committed", committed]) == 2
+    assert main(["--committed", committed, "--fresh",
+                 str(tmp_path / "absent.json")]) == 2
+    torn = tmp_path / "torn.json"
+    torn.write_text("{not json")
+    assert main(["--committed", committed, "--fresh", str(torn)]) == 2
+    assert main(["--committed", committed, "--fresh", committed,
+                 "--ratio", "abc"]) == 2

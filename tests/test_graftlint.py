@@ -569,6 +569,94 @@ def test_gl005_gated_ring_append_and_reads_are_clean(tmp_path):
     assert "GL005" not in rule_ids(res)
 
 
+# The ISSUE 9 extension: trace-context allocation/injection in the RPC
+# wire loops (serving/rpc.py / serving/client.py) must sit behind the
+# obs gate — an ungated TraceContext per batch is a per-batch object +
+# dict build every DISABLED run pays for. The wire modules get ONLY
+# this check: their operational counters are always-on by design.
+GL005_TRACE_TP = {
+    # the pre-fix shape: context extracted from every frame body
+    # unconditionally in the handler loop
+    "serving/rpc.py": """
+    def _handle(self, conn):
+        while True:
+            doc = self._read_doc(conn)
+            ctx = TraceContext.from_wire(doc.get("tc"))
+            self._serve_batch(conn, doc, ctx)
+    """,
+}
+
+GL005_TRACE_NEG = {
+    # the blessed idiom: extraction gated on the obs gate (including a
+    # derived-flag alias), teardown-path usage in an except handler is
+    # cold by definition
+    "serving/rpc.py": """
+    def _handle(self, conn):
+        while True:
+            doc = self._read_doc(conn)
+            ctx = None
+            if _trace.on():
+                ctx = TraceContext.from_wire(doc.get("tc"))
+            traced = _trace.on() and ctx is not None
+            if traced:
+                _trace.record_span("rpc.decode", 0.0,
+                                   trace_id=ctx.trace_id)
+            try:
+                self._serve_batch(conn, doc, ctx)
+            except Exception:
+                _trace.record_span("rpc.error", 0.0)
+                raise
+    """,
+    # an ungated operational counter in the wire modules stays CLEAN:
+    # connection-lifecycle counters are always-on, like every
+    # resilience event — only trace-context work is scoped here
+    "serving/client.py": """
+    def _io_loop(self):
+        get_registry().counter("rpc.client_connects").inc()
+    """,
+    # the same ungated extraction OUTSIDE the wire modules is out of
+    # scope (server-side entries receive an already-built context)
+    "serving/server.py": """
+    def _admit(self, query, deadline_s):
+        ctx = TraceContext.from_wire(None)
+        return ctx
+    """,
+}
+
+
+def test_gl005_ungated_trace_context_in_wire_loop_fires(tmp_path):
+    res = lint_files(tmp_path, GL005_TRACE_TP)
+    msgs = [f.message for f in res.findings if f.rule == "GL005"]
+    assert len(msgs) == 1 and "from_wire" in msgs[0]
+    assert "trace-context" in msgs[0]
+
+
+def test_gl005_inverted_gate_alias_is_not_a_gate(tmp_path):
+    # review finding: an alias whose TRUTH means the gate is OFF
+    # (`untraced = not _trace.on()`) must not lint the guarded body
+    # clean — only conjunctions that imply the gate is on qualify
+    res = lint_files(tmp_path, {
+        "serving/rpc.py": """
+        def _handle(self, conn):
+            doc = self._read_doc(conn)
+            untraced = not _trace.on()
+            if untraced:
+                ctx = TraceContext.from_wire(doc.get("tc"))
+            maybe = _trace.on() or doc.get("force")
+            if maybe:
+                ctx = TraceContext.from_wire(doc.get("tc"))
+            return ctx
+        """,
+    })
+    msgs = [f.message for f in res.findings if f.rule == "GL005"]
+    assert len(msgs) == 2 and all("from_wire" in m for m in msgs)
+
+
+def test_gl005_gated_trace_context_and_near_misses_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL005_TRACE_NEG)
+    assert "GL005" not in rule_ids(res)
+
+
 # --------------------------------------------------------------------- #
 # GL006 atomic-commit discipline
 # --------------------------------------------------------------------- #

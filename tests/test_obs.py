@@ -209,6 +209,129 @@ def test_disabled_span_is_zero_allocation_noop():
     assert peak < 8192, f"disabled span loop allocated {peak} bytes"
 
 
+def test_trace_context_wire_round_trip_and_tolerance():
+    ctx = obs.TraceContext(parent_sid=obs.next_sid())
+    wire = ctx.to_wire()
+    back = obs.TraceContext.from_wire(wire)
+    assert back.trace_id == ctx.trace_id
+    assert back.parent_sid == ctx.parent_sid
+    # a context without a parent serializes without the sid key
+    assert "s" not in obs.TraceContext().to_wire()
+    # from_wire is tolerant BY CONTRACT: garbage is an untraced batch,
+    # never an error (tracing must not change the wire's accept set)
+    for garbage in (None, 17, "x", [], {}, {"s": 3}, {"t": 9},
+                    {"t": ""}):
+        assert obs.TraceContext.from_wire(garbage) is None
+    # two minted contexts never share a trace id
+    assert obs.TraceContext().trace_id != obs.TraceContext().trace_id
+
+
+def test_activate_stamps_spans_and_hands_off_across_threads():
+    obs.enable()
+    sink = JsonlSink()
+    obs.attach_sink(sink)
+    ctx = obs.TraceContext(parent_sid=obs.next_sid())
+    with obs.activate(ctx):
+        assert obs.current_context() is ctx
+        with obs.span("stage"):
+            pass
+    assert obs.current_context() is None
+
+    # the EXPLICIT handoff: another thread activates the carried
+    # context object — thread-locals never leak it across by themselves
+    seen = {}
+
+    def worker():
+        seen["before"] = obs.current_context()
+        with obs.activate(ctx):
+            with obs.span("worker.stage"):
+                pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(10)
+    assert seen["before"] is None
+    spans = {e["name"]: e for e in sink.events if e["kind"] == "span"}
+    # both root spans carry the trace id and parent to the context sid
+    for name in ("stage", "worker.stage"):
+        assert spans[name]["trace"] == ctx.trace_id
+        assert spans[name]["parent"] == ctx.parent_sid
+
+
+def test_nested_span_under_context_parents_to_its_local_root():
+    obs.enable()
+    sink = JsonlSink()
+    obs.attach_sink(sink)
+    ctx = obs.TraceContext(parent_sid=obs.next_sid())
+    with obs.activate(ctx):
+        with obs.span("outer"):
+            with obs.span("inner"):
+                pass
+    spans = {e["name"]: e for e in sink.events if e["kind"] == "span"}
+    assert spans["outer"]["parent"] == ctx.parent_sid
+    # nesting stays LOCAL: the inner span's parent is the outer span,
+    # while the trace id still rides both
+    assert spans["inner"]["parent"] == spans["outer"]["sid"]
+    assert spans["inner"]["trace"] == ctx.trace_id
+
+
+def test_record_span_emits_event_and_registry_mirror():
+    obs.enable()
+    sink = JsonlSink()
+    obs.attach_sink(sink)
+    ctx = obs.TraceContext(parent_sid=obs.next_sid())
+    sid = obs.record_span(
+        "async.stage", 0.25, trace_id=ctx.trace_id,
+        parent=ctx.parent_sid, attrs={"n": 3},
+    )
+    assert isinstance(sid, int)
+    (e,) = [e for e in sink.events if e["kind"] == "span"]
+    assert e["name"] == "async.stage" and e["dur_s"] == 0.25
+    assert e["trace"] == ctx.trace_id
+    assert e["parent"] == ctx.parent_sid and e["attrs"] == {"n": 3}
+    # the duration lands in the same histogram as with-block spans
+    h = obs.get_registry().histogram("trace.span_seconds",
+                                     span="async.stage")
+    assert h.count == 1 and h.sum == 0.25
+    # a pre-reserved sid (the client's batch-root idiom) is honored
+    sid2 = obs.next_sid()
+    assert obs.record_span("root", 0.1, sid=sid2) == sid2
+
+
+def test_record_span_disabled_is_a_noop():
+    assert not obs.enabled()
+    sink = JsonlSink()
+    obs.attach_sink(sink)
+    assert obs.record_span("x", 0.1) is None
+    assert len(sink.events) == 0
+
+
+def test_histogram_exemplars_keep_largest_and_replay_identically():
+    reg = MetricRegistry()
+    sink = JsonlSink()
+    reg.add_sink(sink)
+    h = reg.histogram("lat")
+    values = [(0.010, "t0"), (0.500, "t1"), (0.020, "t2"),
+              (0.500, "t3"), (0.900, "t4"), (0.001, "t5")]
+    for v, tid in values:
+        h.observe(v, exemplar=tid)
+    h.observe(2.0)  # no exemplar: sampled, never an exemplar entry
+    ex = h.exemplars()
+    # the largest exemplar-carrying observations, largest first; ties
+    # keep arrival order (deterministic in the observation sequence)
+    assert ex == [(0.9, "t4"), (0.5, "t1"), (0.5, "t3"), (0.02, "t2")]
+    snap = reg.snapshot()
+    assert snap["histograms"]["lat"]["exemplars"][0] == \
+        {"v": 0.9, "trace": "t4"}
+    # the exemplar rides the event log, so replay is still an identity
+    replayed = replay(sink.events)
+    assert replayed.histogram("lat").exemplars() == ex
+    assert replayed.snapshot() == snap
+    # a histogram without exemplars gains no snapshot key
+    reg.histogram("plain").observe(1.0)
+    assert "exemplars" not in reg.snapshot()["histograms"]["plain"]
+
+
 def test_enable_disable_roundtrip_and_instrumented_pipeline():
     """End-to-end: a real aggregation run with obs enabled produces the
     hot-path spans, and the same run disabled produces none."""

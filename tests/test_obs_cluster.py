@@ -415,6 +415,50 @@ def test_endpoint_over_aggregator_serves_merged_cluster_view(tmp_path):
         assert json.loads(hz)["shards_consumed_events"] > 0
 
 
+def test_trace_events_merge_across_shards_and_serve_over_http(tmp_path):
+    """ISSUE 9: span events stamped with one trace id merge across
+    shard files (iter_trace_events) and the endpoint serves the trace
+    tail as ndjson at /trace/<id>."""
+    import json as _json
+
+    from gelly_streaming_tpu.obs.cluster import (
+        iter_trace_events,
+        shard_events_path,
+    )
+
+    d = str(tmp_path)
+    shard_events = {
+        0: [{"kind": "span", "name": "rpc.client.batch", "ts": 10.2,
+             "dur_s": 0.2, "sid": 1, "depth": 0, "trace": "tX"}],
+        1: [{"kind": "span", "name": "serving.query", "ts": 10.1,
+             "dur_s": 0.01, "sid": 7, "depth": 0, "trace": "tX",
+             "parent": 1},
+            {"kind": "span", "name": "serving.query", "ts": 10.15,
+             "dur_s": 0.01, "sid": 8, "depth": 0, "trace": "tOther"}],
+    }
+    for shard, events in shard_events.items():
+        with open(shard_events_path(d, shard), "w") as f:
+            for e in events:
+                f.write(_json.dumps(e) + "\n")
+    merged = list(iter_trace_events(d, "tX"))
+    # ts-ordered and shard-stamped; the other trace stays out
+    assert [(e["shard"], e["name"]) for e in merged] == [
+        ("p1", "serving.query"), ("p0", "rpc.client.batch"),
+    ]
+    agg = ClusterAggregator(d)
+    with endpoint.MetricsEndpoint(aggregator=agg) as ep:
+        _, body = _get(f"{ep.url}/trace/tX")
+        lines = [_json.loads(x) for x in body.strip().splitlines()]
+        assert len(lines) == 2
+        assert all(e["trace"] == "tX" for e in lines)
+        # ?n= bounds the tail
+        _, body = _get(f"{ep.url}/trace/tX?n=1")
+        assert len(body.strip().splitlines()) == 1
+        # an unknown trace id is an empty tail, not an error
+        status, body = _get(f"{ep.url}/trace/absent")
+        assert status == 200 and body.strip() == ""
+
+
 def test_endpoint_attaches_to_stream_server():
     from gelly_streaming_tpu.serving.server import StreamServer
 

@@ -575,6 +575,152 @@ def test_standby_refuses_until_promoted(tmp_path):
 
 
 # --------------------------------------------------------------------- #
+# End-to-end query tracing over the wire (ISSUE 9 tentpole)
+# --------------------------------------------------------------------- #
+def test_trace_context_rides_the_wire_end_to_end():
+    """One client batch -> one trace: the context minted client-side
+    rides the frame body, and every server stage span (decode, admit,
+    the answering sweep, reply, the server residence) carries the same
+    trace id, parented to the client's batch-root sid."""
+    obs.enable()
+    sink = obs.JsonlSink()
+    obs.attach_sink(sink)
+    srv = started_server()
+    rpc = RpcServer(srv).start()
+    client = RpcClient(rpc.address)
+    try:
+        ans = client.ask_batch(
+            [ConnectedQuery(0, 1), ComponentSizeQuery(0)],
+            deadline_s=20, timeout=20,
+        )
+        assert ans[0].value is True
+        deadline = time.monotonic() + 5
+        want = {"rpc.decode", "rpc.admit", "serving.query", "rpc.reply",
+                "rpc.server.batch", "rpc.client.batch"}
+        spans = {}
+        while time.monotonic() < deadline and \
+                not want <= set(spans):
+            spans = {}
+            for e in sink.events:
+                if e.get("kind") == "span" and e.get("trace"):
+                    spans.setdefault(e["name"], e)
+            time.sleep(0.01)
+        assert want <= set(spans), sorted(spans)
+        root = spans["rpc.client.batch"]
+        # ONE trace joins all stages; server spans parent to the root
+        for name in want:
+            assert spans[name]["trace"] == root["trace"], name
+        for name in want - {"rpc.client.batch"}:
+            assert spans[name]["parent"] == root["sid"], name
+        # the attribution attrs ride the answering sweep's span
+        at = spans["serving.query"]["attrs"]
+        for key in ("queue_wait_s", "dispatch_s", "settle_s",
+                    "snapshot_age_s", "staleness", "window"):
+            assert key in at, key
+        # the wire-latency histogram's exemplar links to this trace
+        ex = get_registry().histogram(
+            "rpc.client_wire_seconds").exemplars()
+        assert any(t == root["trace"] for _v, t in ex)
+    finally:
+        client.close()
+        rpc.close()
+        srv.close()
+
+
+def test_untraced_wire_stays_untraced_and_tolerates_garbage_tc():
+    """Tracing off -> no context minted, no span events; a frame that
+    carries a garbage tc field is served normally (from_wire is
+    tolerant by contract)."""
+    sink = obs.JsonlSink()
+    obs.attach_sink(sink)  # attached but DISABLED
+    srv = started_server()
+    rpc = RpcServer(srv).start()
+    try:
+        client = RpcClient(rpc.address)
+        assert client.ask(ConnectedQuery(0, 1),
+                          timeout=20).value is True
+        client.close()
+        assert not [e for e in sink.events if e.get("kind") == "span"]
+        # garbage tc on a raw frame: answered ok even with tracing ON
+        obs.enable()
+        s = raw_conn(rpc)
+        s.sendall(pack_frame(T_REQ, json.dumps({
+            "id": "tc-garbage", "q": [["C", 0, 1]],
+            "tc": {"bogus": True},
+        }).encode()))
+        _, payload = read_frame(s)
+        assert json.loads(payload)["status"] == "ok"
+        s.close()
+    finally:
+        rpc.close()
+        srv.close()
+
+
+def test_client_retries_stay_on_the_same_trace():
+    """Overloaded re-asks are part of the query's causal story: every
+    retry span and the final root span carry the ONE trace id minted at
+    submit (the frame resent under the same batch id and tc)."""
+    obs.enable()
+    sink = obs.JsonlSink()
+    obs.attach_sink(sink)
+    srv = StreamServer(iter(()), None, max_pending=1)
+    rpc = RpcServer(srv).start()
+    client = RpcClient(
+        rpc.address,
+        retry_policy=RetryPolicy(attempts=2, base_s=0.01, jitter=0.0),
+    )
+    try:
+        futs = client.submit_batch(
+            [ConnectedQuery(0, 1), ConnectedQuery(1, 2)]
+        )
+        with pytest.raises(Overloaded):
+            futs[0].result(20)
+        retries = [e for e in sink.events
+                   if e.get("name") == "rpc.client.retry"]
+        assert len(retries) == 2
+        traces = {e["trace"] for e in retries}
+        assert len(traces) == 1
+    finally:
+        client.close()
+        rpc.close()
+
+
+def test_failover_adoption_preserves_trace_context():
+    """In-flight entries adopted across a promotion keep their original
+    TraceContext: the standby's answering sweep emits its span on the
+    SAME trace the query was submitted under."""
+    from gelly_streaming_tpu.serving import FailoverServer
+
+    obs.enable()
+    sink = obs.JsonlSink()
+    obs.attach_sink(sink)
+    with faults.injected(faults.FaultPlan(
+        kill_site="serving.worker", kill_at_window=2
+    )):
+        fs = FailoverServer(
+            chain_payloads(windows=3, pace_s=0.0), None,
+            monitor_s=None, max_pending=64,
+        ).start()
+        try:
+            deadline = time.monotonic() + 30
+            while fs.primary.worker_alive() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not fs.primary.worker_alive()
+            ctx = obs.TraceContext(parent_sid=obs.next_sid())
+            f = fs.primary.submit(ConnectedQuery(0, 1), ctx=ctx)
+            fs.promote(reason="worker_death")
+            assert f.result(30).value is True
+        finally:
+            fs.close()
+    sweeps = [e for e in sink.events
+              if e.get("name") == "serving.query"]
+    ours = [e for e in sweeps if e.get("trace") == ctx.trace_id]
+    assert ours, [e.get("trace") for e in sweeps]
+    assert ours[-1]["parent"] == ctx.parent_sid
+
+
+# --------------------------------------------------------------------- #
 # /healthz role + heartbeat age (the failover satellite)
 # --------------------------------------------------------------------- #
 def test_failover_healthz_reports_role_and_heartbeat_age():
@@ -603,6 +749,66 @@ def test_failover_healthz_reports_role_and_heartbeat_age():
     finally:
         ep.close()
         fs.close()
+
+
+@pytest.mark.chaos_fast
+def test_healthz_role_flips_across_a_live_promotion(tmp_path):
+    """ISSUE 9 satellite: /healthz probed over REAL HTTP while the
+    lease monitor runs — role reads standby before the kill, flips to
+    primary (promoted=true) after the lease lapses, and
+    heartbeat_age_s stays fresh throughout because the promoted
+    standby takes the beat over."""
+    import urllib.request
+
+    shared = str(tmp_path / "shared")
+    # a generous lease: a loaded CI host can stall the beat thread for
+    # hundreds of ms, and a pre-kill lapse would flip the role early
+    lease_s = 1.0
+    primary = ReplicaServer(
+        chain_payloads(windows=2000, pace_s=0.005), None,
+        dirpath=shared, role="primary", lease_s=lease_s,
+    ).start()
+    standby = ReplicaServer(
+        dirpath=shared, role="standby", lease_s=lease_s,
+    ).start()
+    ep = standby.metrics_endpoint(port=0)
+
+    def healthz():
+        with urllib.request.urlopen(
+            f"{ep.url}/healthz", timeout=10
+        ) as r:
+            return json.loads(r.read().decode())
+
+    try:
+        doc = healthz()
+        assert doc["role"] == "standby" and doc["promoted"] is False
+        assert doc["worker_alive"] is True and doc["ok"] is True
+        # fresh while the PRIMARY beats
+        assert doc["heartbeat_age_s"] is not None
+        assert doc["heartbeat_age_s"] < 10.0
+        primary.close()  # the lease stops beating; the monitor promotes
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            doc = healthz()
+            if doc.get("role") == "primary":
+                break
+            time.sleep(0.02)
+        assert doc["role"] == "primary" and doc["promoted"] is True
+        assert doc["ok"] is True and doc["worker_alive"] is True
+        # fresh again because the PROMOTED STANDBY owns the beat now:
+        # poll past its first own beats and require sub-lease age
+        deadline = time.monotonic() + 10
+        age = None
+        while time.monotonic() < deadline:
+            age = healthz()["heartbeat_age_s"]
+            if age is not None and age < lease_s:
+                break
+            time.sleep(0.02)
+        assert age is not None and age < lease_s, age
+    finally:
+        ep.close()
+        standby.close()
+        primary.close()
 
 
 def test_heartbeat_lease_records_are_crc_framed_and_atomic(tmp_path):
@@ -657,6 +863,77 @@ def test_timeline_renders_malformed_frames():
     ])
     assert len(lines) == 1 and "MALFORMED" in lines[0]
     assert "kind=truncated" in lines[0]
+
+
+def _trace_story_events():
+    return [
+        {"kind": "span", "name": "rpc.decode", "ts": 10.0,
+         "dur_s": 1e-4, "sid": 5, "depth": 0, "trace": "tA",
+         "parent": 1, "shard": "p0"},
+        {"kind": "span", "name": "rpc.client.resubmit", "ts": 10.4,
+         "dur_s": 0.4, "sid": 2, "depth": 0, "trace": "tA",
+         "parent": 1, "shard": "p2"},
+        {"kind": "span", "name": "serving.query", "ts": 10.5,
+         "dur_s": 0.001, "sid": 9, "depth": 0, "trace": "tA",
+         "parent": 1, "shard": "p1"},
+        {"kind": "span", "name": "rpc.client.batch", "ts": 10.6,
+         "dur_s": 0.6, "sid": 1, "depth": 0, "trace": "tA",
+         "shard": "p2"},
+        # another trace + an untraced metric event: both filtered out
+        {"kind": "span", "name": "serving.query", "ts": 10.2,
+         "dur_s": 0.001, "sid": 11, "depth": 0, "trace": "tB",
+         "shard": "p1"},
+        {"kind": "counter", "name": "rpc.connects", "v": 1,
+         "ts": 10.1, "shard": "p1"},
+    ]
+
+
+def test_timeline_trace_filter_renders_one_causal_story(tmp_path, capsys):
+    events = _trace_story_events()
+    kept = timeline.filter_events(events, trace="tA")
+    assert [e["sid"] for e in kept] == [5, 2, 9, 1]
+    # through the CLI: ts-ordered, every event of the trace rendered
+    # (spans included without needing --all), nothing else
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rc = timeline.main([str(path), "--trace", "tA"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    body = [line for line in out.splitlines()
+            if not line.startswith("#")]
+    assert len(body) == 4
+    assert "[          p0]" in body[0]  # decode on the dead primary
+    assert "rpc.client.resubmit" in body[1]
+    assert "[          p1]" in body[2]  # the promoted standby answers
+    assert "rpc.client.batch" in body[3]
+    assert "rpc.connects" not in out and "tB" not in out
+
+
+def test_timeline_since_until_window_filters(tmp_path, capsys):
+    events = _trace_story_events()
+    # absolute bounds are inclusive
+    kept = timeline.filter_events(events, since=10.2, until=10.5)
+    assert {e["sid"] for e in kept} == {2, 9, 11}
+    # relative (+s) forms resolve against the run's own t0 and keep
+    # the rendered offsets anchored to the SAME zero
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rc = timeline.main(
+        [str(path), "--all", "--since", "+0.15", "--until", "+0.45"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    body = [line for line in out.splitlines()
+            if not line.startswith("#")]
+    # events at +0.2, +0.4 survive; offsets still run-anchored
+    assert len(body) == 2
+    assert body[0].startswith("+   0.200s")
+    assert body[1].startswith("+   0.400s")
+    # an empty window is reported as no events (exit 1)
+    assert timeline.main(
+        [str(path), "--since", "999999999999"]
+    ) == 1
+    capsys.readouterr()
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,156 @@
+"""benchguard: an automated eye on the committed perf trajectory.
+
+The repo commits bench artifacts (``BENCH_SERVING_RPC_CPU.json`` et
+al.) but until now nothing COMPARED a fresh run against them — a
+serving-latency regression only surfaced when a human re-read the
+numbers. This tool is the smallest honest checker (stdlib only, like
+graftlint): it takes the committed artifact and a fresh run of the same
+scenario and fails when a watched latency metric regressed past a
+GENEROUS ratio.
+
+The ratio is deliberately loose (default 3.0x): CI hosts are shared and
+noisy, and the committed numbers come from a different machine — this
+gate exists to catch "p99 went from 100ms to a second", not to litigate
+10%. It is wired as a NON-BLOCKING CI step for the same reason: a red
+benchguard is a prompt to look, not a merge stopper.
+
+Watched metrics (present in every ``bench.py --serving --rpc``
+artifact): ``steady.p50_ms`` and ``steady.p99_ms`` — the steady-state
+client-measured batch latency. The promotion window is NOT guarded: its
+latency is dominated by the configured lease timeout, which is a
+correctness parameter, not a perf trajectory.
+
+Usage::
+
+    python -m tools.benchguard --committed BENCH_SERVING_RPC_CPU.json \
+        --fresh /tmp/fresh.json [--ratio 3.0]
+
+Exit codes: 0 within bounds, 1 regression, 2 usage/unreadable input.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional, Tuple
+
+#: dotted paths of the guarded metrics inside the artifact document
+WATCHED = ("steady.p50_ms", "steady.p99_ms")
+
+#: a fresh value may be up to this many times the committed one
+DEFAULT_RATIO = 3.0
+
+
+def dig(doc: dict, dotted: str):
+    """``dig({"a": {"b": 1}}, "a.b") -> 1``; None when any hop is
+    missing or not a mapping."""
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def compare(
+    committed: dict,
+    fresh: dict,
+    ratio: float = DEFAULT_RATIO,
+    watched: Tuple[str, ...] = WATCHED,
+) -> List[dict]:
+    """Per-metric verdicts: ``{"metric", "committed", "fresh", "bound",
+    "ok", "note"}``. A metric missing from either side is reported
+    (``ok=None``, a skip) rather than failed — an artifact-shape change
+    must read as 'benchguard needs updating', not as a perf regression.
+    A committed value of 0 cannot bound anything and also skips."""
+    out = []
+    for metric in watched:
+        want = dig(committed, metric)
+        got = dig(fresh, metric)
+        entry = {"metric": metric, "committed": want, "fresh": got,
+                 "bound": None, "ok": None, "note": ""}
+        if not isinstance(want, (int, float)) or \
+                not isinstance(got, (int, float)):
+            entry["note"] = "missing on one side; skipped"
+        elif want <= 0:
+            entry["note"] = "committed value is 0; nothing to bound"
+        else:
+            bound = want * ratio
+            entry["bound"] = round(bound, 3)
+            entry["ok"] = bool(got <= bound)
+            if not entry["ok"]:
+                entry["note"] = (
+                    f"{got:.3f} > {bound:.3f} "
+                    f"({got / want:.2f}x the committed {want:.3f})"
+                )
+        out.append(entry)
+    return out
+
+
+def _load(path: str) -> Optional[dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"benchguard: cannot read {path}: {e}", file=sys.stderr)
+        return None
+
+
+def _take(argv: List[str], flag: str) -> Optional[str]:
+    for i, a in enumerate(argv):
+        if a == flag:
+            if i + 1 >= len(argv):
+                print(f"benchguard: {flag} needs a value",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            v = argv[i + 1]
+            del argv[i:i + 2]
+            return v
+        if a.startswith(flag + "="):
+            del argv[i]
+            return a[len(flag) + 1:]
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    committed_path = _take(argv, "--committed")
+    fresh_path = _take(argv, "--fresh")
+    ratio_raw = _take(argv, "--ratio")
+    if committed_path is None or fresh_path is None or argv:
+        print(
+            "usage: python -m tools.benchguard --committed <artifact> "
+            "--fresh <artifact> [--ratio 3.0]",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        ratio = float(ratio_raw) if ratio_raw is not None \
+            else DEFAULT_RATIO
+    except ValueError:
+        print(f"benchguard: --ratio wants a number, got {ratio_raw!r}",
+              file=sys.stderr)
+        return 2
+    committed = _load(committed_path)
+    fresh = _load(fresh_path)
+    if committed is None or fresh is None:
+        return 2
+    verdicts = compare(committed, fresh, ratio)
+    worst = 0
+    for v in verdicts:
+        state = ("SKIP" if v["ok"] is None
+                 else "ok" if v["ok"] else "REGRESSED")
+        line = (f"benchguard: {v['metric']}: committed={v['committed']} "
+                f"fresh={v['fresh']} bound={v['bound']} [{state}]")
+        if v["note"]:
+            line += f" — {v['note']}"
+        print(line)
+        if v["ok"] is False:
+            worst = 1
+    print(f"benchguard: {'REGRESSION' if worst else 'within bounds'} "
+          f"(ratio {ratio}x, {len(verdicts)} metrics)")
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,17 @@ disabled run), this rule flags:
    (resilience counters fire with obs disabled), so the ring append
    itself must gate on ``obs.enable()`` or disabled runs buffer
    telemetry they were promised not to pay for.
+
+The ISSUE 9 extension covers the TRACE-CONTEXT hot path: in the RPC
+wire modules (``serving/rpc.py``/``serving/client.py`` — every query
+batch flows through their loops), allocating or injecting a
+:class:`TraceContext` (``TraceContext(...)``/``from_wire``/``to_wire``/
+``record_span``/``next_sid``/``new_trace_id``/``current_context``)
+must be gated on ``obs.enable()``: an ungated context allocation is a
+per-batch object + dict build every DISABLED run pays for. These
+modules get ONLY the trace-path check — their operational counters
+(``rpc.connects``, ``rpc.malformed``, ...) are always-on by design,
+like every resilience event.
 """
 
 from __future__ import annotations
@@ -51,21 +62,49 @@ HOT_MODULES = (
     "obs/flight.py",
 )
 
+#: modules where only the trace-context check applies (the wire loops:
+#: operational counters there are always-on by design)
+TRACE_MODULES = (
+    "serving/rpc.py",
+    "serving/client.py",
+)
+
 _MUTATORS = {"inc", "set", "observe", "add", "record"}
 _FACTORIES = {"counter", "gauge", "histogram"}
 _GATES = {"on", "enabled"}
+#: trace-context allocation/injection calls that must sit behind the
+#: gate in TRACE_MODULES (the per-batch hot path)
+_TRACE_CALLS = {
+    "TraceContext", "from_wire", "to_wire", "record_span",
+    "next_sid", "new_trace_id", "current_context",
+}
+
+
+def _tracks_gate(expr: ast.AST) -> bool:
+    """True when the expression's TRUTH implies the gate is on: a bare
+    gate call, or an ``and``-chain with a gate conjunct. ``not``/``or``
+    forms invert or weaken that implication (``not _trace.on()`` is an
+    alias for DISABLED), so they must not register as gate aliases."""
+    if isinstance(expr, ast.Call) and \
+            last_attr(call_name(expr)) in _GATES:
+        return True
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.And):
+        return any(_tracks_gate(v) for v in expr.values)
+    return False
 
 
 def _gate_aliases(fn) -> Set[str]:
-    """Local names bound from a gate call: ``obs = _trace.on()``."""
+    """Local names bound from a gate call: ``obs = _trace.on()`` — or
+    from a conjunction containing one (``traced = _trace.on() and
+    ctx is not None``)."""
     out: Set[str] = set()
     for node in ast.walk(fn):
-        if isinstance(node, ast.Assign) and \
-                isinstance(node.value, ast.Call) and \
-                last_attr(call_name(node.value)) in _GATES:
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    out.add(tgt.id)
+        if not isinstance(node, ast.Assign) or \
+                not _tracks_gate(node.value):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
     return out
 
 
@@ -82,9 +121,10 @@ def _test_is_gate(test: ast.AST, aliases: Set[str]) -> bool:
 class ObsZeroOverhead(Rule):
     id = "GL005"
     title = "ungated obs work in a hot-path module"
-    scope_suffixes = HOT_MODULES
+    scope_suffixes = HOT_MODULES + TRACE_MODULES
 
     def check(self, mod: LintModule) -> Iterator[Finding]:
+        trace_scope = mod.relpath.endswith(TRACE_MODULES)
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
@@ -96,24 +136,48 @@ class ObsZeroOverhead(Rule):
                 if self._gated(mod, node, aliases) or \
                         mod.in_except_handler(node):
                     continue
-                yield from self._check_mutation(mod, node)
-                yield from self._check_span(mod, node, aliases)
-                yield from self._check_ring_write(mod, node)
+                if trace_scope:
+                    yield from self._check_trace_ctx(mod, node)
+                else:
+                    yield from self._check_mutation(mod, node)
+                    yield from self._check_span(mod, node, aliases)
+                    yield from self._check_ring_write(mod, node)
 
     @staticmethod
     def _gated(mod: LintModule, node: ast.AST, aliases: Set[str]
                ) -> bool:
-        """Inside the body of an ``if <gate>:`` (not its orelse)."""
+        """Inside the body of an ``if <gate>:`` (not its orelse) — or
+        the body of a gated conditional expression
+        (``... if _trace.on() else None``)."""
         child = node
         for anc in mod.ancestors(node):
             if isinstance(anc, ast.If) and \
                     _test_is_gate(anc.test, aliases):
                 if child not in anc.orelse:
                     return True
+            if isinstance(anc, ast.IfExp) and \
+                    _test_is_gate(anc.test, aliases):
+                if child is not anc.orelse:
+                    return True
             if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 break
             child = anc
         return False
+
+    def _check_trace_ctx(self, mod: LintModule, node: ast.Call
+                         ) -> Iterator[Finding]:
+        """TRACE_MODULES check: a trace-context allocation/injection in
+        the wire loops that is not behind the obs gate — every query
+        batch pays for it, so the disabled path must skip it."""
+        fname = last_attr(call_name(node))
+        if fname not in _TRACE_CALLS:
+            return
+        yield mod.finding(
+            "GL005", node,
+            f"trace-context call '{fname}' in the RPC hot path is not "
+            f"gated on obs being enabled — wrap in 'if _trace.on():' "
+            f"so a disabled run allocates no context per batch",
+        )
 
     def _check_mutation(self, mod: LintModule, node: ast.Call
                         ) -> Iterator[Finding]:
