@@ -1271,7 +1271,10 @@ def bench_serving(
 
         t0 = time.perf_counter()
         server.start()
-        ct = threading.Thread(target=client)
+        # daemon: if the measured pass raises before the join below,
+        # the load thread must die with the process, not outlive the
+        # leaked reference submitting forever (GL010)
+        ct = threading.Thread(target=client, daemon=True)
         ct.start()
         server.join(3600)
         agg.sync()

@@ -146,14 +146,28 @@ class FlightRecorder:
 
 
 def read_dump(path: str) -> dict:
-    """Load and validate one dump file (checksummed container)."""
+    """Load and validate one dump file (checksummed container). The
+    container self-describes and the reader holds it to that (GL011
+    symmetry with :meth:`FlightRecorder.dump`): ``kind`` must be
+    ``"flight"`` and ``n_events`` must match the shipped ring — a
+    CRC-valid file that is not a flight dump is rejected rather than
+    mis-parsed into an empty black box."""
     from ..resilience import integrity as _integrity
 
     with open(path, "rb") as f:
         data = f.read()
-    return json.loads(
+    doc = json.loads(
         _integrity.unwrap_checksummed(data, origin=f"flight dump {path}")
     )
+    if doc.get("kind") != "flight":
+        raise ValueError(
+            f"{path}: not a flight dump (kind={doc.get('kind')!r})")
+    events = doc.get("events")
+    if not isinstance(events, list) or doc.get("n_events") != len(events):
+        raise ValueError(
+            f"{path}: inconsistent flight dump "
+            f"(n_events does not match the shipped ring)")
+    return doc
 
 
 def find_dumps(directory: str) -> List[str]:

@@ -113,7 +113,14 @@ def load_run(root: str) -> List[dict]:
                 "attrs": {
                     "reason": doc.get("reason"),
                     "n_events": doc.get("n_events"),
+                    # the dying worker's pid joins the BLACKBOX line to
+                    # the supervisor's restart/kill lines for the same
+                    # process — and the dump's own attrs (error reprs,
+                    # kill sites) ride along instead of staying buried
+                    # in the container
+                    "pid": doc.get("pid"),
                     "path": os.path.relpath(p, root),
+                    **(doc.get("attrs") or {}),
                 },
             })
     events.sort(key=lambda e: float(e.get("ts") or 0.0))

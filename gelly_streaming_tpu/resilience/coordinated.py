@@ -133,7 +133,8 @@ def _shard_valid(directory: str, epoch: int, pid: int,
     if cache is not None and key in cache:
         return cache[key]
     try:
-        data = open(path, "rb").read()
+        with open(path, "rb") as f:
+            data = f.read()
     except OSError as e:
         return False, f"shard file unreadable: {e!r}"
     if len(data) != rec.get("size"):

@@ -237,10 +237,19 @@ class RpcClient:
         deadline_s: Optional[float] = None,
         timeout: Optional[float] = None,
     ) -> List[Answer]:
-        return [
-            f.result(timeout)
-            for f in self.submit_batch(queries, deadline_s=deadline_s)
-        ]
+        futures = self.submit_batch(queries, deadline_s=deadline_s)
+        # `timeout` bounds the WHOLE batch wait (GL008): each result()
+        # spends what remains of one budget — N sequential waits of
+        # the full timeout would wait N× what the caller asked for
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        out = []
+        for f in futures:
+            out.append(f.result(
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            ))
+        return out
 
     def ask(self, query: Query, timeout: Optional[float] = None,
             deadline_s: Optional[float] = None) -> Answer:
@@ -339,10 +348,21 @@ class RpcClient:
                     )
                 except OSError:
                     continue
-                sock.settimeout(None)
-                sock.setsockopt(
-                    _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
-                )
+                try:
+                    sock.settimeout(None)
+                    sock.setsockopt(
+                        _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    # the server reset the fresh connection before the
+                    # options landed: release THIS socket and try the
+                    # next address — an uncaught raise here would leak
+                    # the fd and kill the io thread (GL010)
+                    get_registry().counter(
+                        "rpc.swallowed", site="connect_config"
+                    ).inc()
+                    sock.close()
+                    continue
                 self._addr_i = i
                 return Wire(sock)
             delay = jittered(

@@ -203,7 +203,12 @@ class FailoverServer:
                 deadline = time.monotonic() + self.INFLIGHT_GRACE_S
                 while (primary.worker_alive() and primary._inflight
                        and time.monotonic() < deadline):
-                    time.sleep(0.001)
+                    # the grace wait deliberately holds _plock: submit()
+                    # and active MUST queue behind an in-flight
+                    # promotion (their documented contract), and the
+                    # wait is bounded by INFLIGHT_GRACE_S; probes use
+                    # active_nowait to stay lock-free
+                    time.sleep(0.001)  # graftlint: disable=GL009 (bounded grace wait; holding the promotion lock here IS the contract submit()/active wait on — active_nowait is the lock-free probe path)
                 with primary._lock:
                     entries.extend(primary._inflight_entries)
                     primary._inflight = 0
@@ -311,18 +316,25 @@ class FailoverServer:
     def close(self, timeout: float = 30.0) -> None:
         """Close both replicas (idempotent). The primary closes first so
         ingest stops at a window boundary; each replica answers its own
-        admitted stragglers on the way down."""
+        admitted stragglers on the way down. ``timeout`` bounds the
+        WHOLE close (GL008): the monitor join and both replica closes
+        spend one shared budget, not a fresh copy each."""
         with self._plock:
             if self._closed:
                 return
             self._closed = True
+        deadline = time.monotonic() + float(timeout)
+
+        def remaining() -> float:
+            return max(0.0, deadline - time.monotonic())
+
         self._monitor_stop.set()
         if self._monitor_thread is not None:
-            self._monitor_thread.join(timeout)
+            self._monitor_thread.join(remaining())
         errors = []
         for srv in (self.primary, self.standby):
             try:
-                srv.close(timeout)
+                srv.close(remaining())
             except BaseException as e:
                 errors.append(e)
         if errors:

@@ -187,6 +187,10 @@ class Wire:
         self.rcvd = 0
 
     def send(self, data: bytes) -> None:
+        # wlock exists to SERIALIZE frame writes on one socket — a
+        # frame interleaved mid-frame is wire corruption, so blocking
+        # the next sender until this frame is fully out is the lock's
+        # entire purpose, not contention (GL009 suppressions below)
         with self.wlock:
             idx = self.sent
             self.sent = idx + 1
@@ -195,11 +199,11 @@ class Wire:
                 # the connection dies — the peer must count a clean
                 # rpc.malformed{kind=truncated}, never a thread death
                 try:
-                    self.sock.sendall(data[: max(1, len(data) // 2)])
+                    self.sock.sendall(data[: max(1, len(data) // 2)])  # graftlint: disable=GL009 (wlock is the per-socket frame-write serializer; blocking the next sender until this frame is out is its purpose)
                 finally:
                     self.close()
                 raise ConnectionAbortedError("injected frame truncation")
-            self.sock.sendall(data)
+            self.sock.sendall(data)  # graftlint: disable=GL009 (wlock is the per-socket frame-write serializer; blocking the next sender until this frame is out is its purpose)
 
     def read(self, *, max_frame: int = DEFAULT_MAX_FRAME
              ) -> Tuple[int, bytes]:
@@ -357,13 +361,19 @@ class RpcServer:
         if self._listener is not None:
             raise RuntimeError("rpc server already started")
         s = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
-        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
-        s.bind((self.host, self._port))
-        s.listen(128)
-        # a bounded accept timeout is the shutdown path: closing a
-        # listener does NOT wake a thread blocked in accept on Linux,
-        # so the loop polls the closing flag at this cadence instead
-        s.settimeout(0.25)
+        try:
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            s.bind((self.host, self._port))
+            s.listen(128)
+            # a bounded accept timeout is the shutdown path: closing a
+            # listener does NOT wake a thread blocked in accept on
+            # Linux, so the loop polls the closing flag at this cadence
+            s.settimeout(0.25)
+        except OSError:
+            # bind/listen failed (port taken, perms): the caller gets
+            # the error, not a leaked listener fd (GL010)
+            s.close()
+            raise
         self._listener = s
         self._port = s.getsockname()[1]
         self._accept_thread = threading.Thread(
@@ -391,8 +401,20 @@ class RpcServer:
                     "rpc.swallowed", site="accept"
                 ).inc()
                 continue
-            sock.settimeout(None)
-            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            try:
+                sock.settimeout(None)
+                sock.setsockopt(_socket.IPPROTO_TCP,
+                                _socket.TCP_NODELAY, 1)
+            except OSError:
+                # a peer that connected and reset immediately: config
+                # on its socket can raise — that must drop THIS socket
+                # (closed, counted), never kill the accept thread and
+                # leave the whole server deaf (GL010)
+                get_registry().counter(
+                    "rpc.swallowed", site="accept_config"
+                ).inc()
+                sock.close()
+                continue
             conn = Wire(sock)
             with self._lock:
                 if self._closing.is_set():
@@ -868,11 +890,13 @@ class ReplicaServer:
         self.server.start()
         self.rpc.start()
         if self.role == "primary":
-            with self._plock:
-                self.lease = HeartbeatLease(
-                    self.dirpath, lease_s=self.lease_s,
-                    beat_s=self.beat_s, port=self.rpc.port,
-                ).start()
+            # the lease's first commit is shared-directory file I/O:
+            # it happens OUTSIDE _plock (GL009) so a slow shared mount
+            # never stalls close()/promote() callers queued on the lock
+            self._install_lease(HeartbeatLease(
+                self.dirpath, lease_s=self.lease_s,
+                beat_s=self.beat_s, port=self.rpc.port,
+            ).start())
             # the mirror stride may skip trailing windows; when ingest
             # ENDS the newest snapshot is the final state and must be
             # on the shared dir for any later failover to serve it
@@ -928,29 +952,44 @@ class ReplicaServer:
                 return
 
     # ------------------------------------------------------------------ #
+    def _install_lease(self, lease: "HeartbeatLease") -> None:
+        """Publish an already-started lease under the promotion lock.
+        The lease's file I/O stays OUTSIDE ``_plock`` (GL009); only the
+        reference swap is locked. A close() that raced the commit wins:
+        the fresh lease is released instead of leaking its beat
+        thread."""
+        with self._plock:
+            if not self._closed:
+                self.lease = lease
+                return
+        lease.close()
+
     def promote(self, reason: str = "manual",
                 _t0: Optional[float] = None) -> None:
         """Take over serving: open the query gate, own the heartbeat.
         One-shot; later calls are no-ops. ``serving.promotion_seconds``
-        measures lapse-detection (or call) to active-gate — the
-        takeover latency a client's retry actually waits out on top of
-        its reconnect."""
+        measures lapse-detection (or call) to heartbeat-takeover — the
+        latency a client's retry actually waits out on top of its
+        reconnect."""
         t0 = time.perf_counter() if _t0 is None else _t0
-        with self._plock:
-            if self.promoted or self._closed:
-                return
-            reg = get_registry()
-            with _trace.span(
-                "serving.promotion",
-                {"reason": reason} if _trace.on() else None,
-            ):
+        reg = get_registry()
+        with _trace.span(
+            "serving.promotion",
+            {"reason": reason} if _trace.on() else None,
+        ):
+            with self._plock:
+                if self.promoted or self._closed:
+                    return
                 reg.counter("serving.failover", reason=reason).inc()
                 self.role = "primary"  # the gate reads this: queries flow
-                self.lease = HeartbeatLease(
-                    self.dirpath, lease_s=self.lease_s,
-                    beat_s=self.beat_s, port=self.rpc.port,
-                ).start()
                 self.promoted = True
+            # the heartbeat takeover is shared-directory file I/O:
+            # committed outside _plock (GL009) so health probes and
+            # close() never queue behind a disk write
+            self._install_lease(HeartbeatLease(
+                self.dirpath, lease_s=self.lease_s,
+                beat_s=self.beat_s, port=self.rpc.port,
+            ).start())
             reg.histogram("serving.promotion_seconds").observe(
                 time.perf_counter() - t0
             )
@@ -981,6 +1020,18 @@ class ReplicaServer:
             "heartbeat_age_s": self.heartbeat_age_s(),
             "rpc_port": self.rpc.port,
         }
+        rec = HeartbeatLease.read(self.dirpath)
+        if rec is not None:
+            # who holds the lease RIGHT NOW — the record's role/pid/
+            # port exist for exactly this probe surface (GL011: every
+            # key the writer commits has a reader), and it is how an
+            # external check tells "this standby is healthy because a
+            # live primary beats" from "nobody is beating"
+            doc["lease"] = {
+                "role": rec.get("role"),
+                "pid": rec.get("pid"),
+                "port": rec.get("port"),
+            }
         doc["ok"] = doc["worker_alive"]
         return doc
 
@@ -997,14 +1048,19 @@ class ReplicaServer:
             if self._closed:
                 return
             self._closed = True
+        # one budget for the whole close (GL008): the monitor join and
+        # the server drain spend what REMAINS of `timeout`, not a
+        # fresh copy each
+        deadline = time.monotonic() + float(timeout)
         self._mon_stop.set()
         if self._mon_thread is not None:
-            self._mon_thread.join(timeout)
+            self._mon_thread.join(
+                max(0.0, deadline - time.monotonic()))
         if self.lease is not None:
             self.lease.close()
         self.rpc.close()
         self._stop_follow.set()
-        self.server.close(timeout)
+        self.server.close(max(0.0, deadline - time.monotonic()))
         if self.mirror is not None:
             try:
                 self.mirror.flush(self.store)

@@ -283,13 +283,22 @@ class StreamServer:
         joined-up traces for free."""
         policy = retry_policy if retry_policy is not None else self.retry_policy
         attempt = 0
+        # the deadline is a TOTAL budget (GL008): pin it to a wall
+        # clock once, spend retry sleeps against it, and admit with
+        # what REMAINS — a query re-admitted after backoff must not be
+        # granted a fresh full deadline measured from its late t0
+        deadline = None if deadline_s is None \
+            else time.monotonic() + float(deadline_s)
         while True:
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
             try:
-                return self._admit(query, deadline_s, ctx)
+                return self._admit(query, remaining, ctx)
             except Shed:
                 raise
             except Overloaded:
-                delay = None if policy is None else policy.delay_s(attempt)
+                delay = None if policy is None \
+                    else policy.delay_before(attempt, remaining)
                 if delay is None:
                     raise
                 attempt += 1
@@ -686,17 +695,24 @@ class StreamServer:
     def close(self, timeout: float = 30.0) -> None:
         """Stop ingest at the next window boundary, answer every
         already-admitted query from the final snapshot, join both
-        threads. Idempotent."""
+        threads. Idempotent. ``timeout`` bounds the WHOLE close: each
+        join gets what remains of the one budget (GL008), so a wedged
+        ingest thread cannot triple the caller's wait."""
         if self._closed:
             return
+        deadline = time.monotonic() + float(timeout)
+
+        def remaining() -> float:
+            return max(0.0, deadline - time.monotonic())
+
         with _trace.span("serving.drain"):
             self._closing = True
             self._stop_ingest.set()
             self._wake.set()
             if self._ingest_thread is not None:
-                self._ingest_thread.join(timeout)
+                self._ingest_thread.join(remaining())
             if self._worker_thread is not None:
-                self._worker_thread.join(timeout)
+                self._worker_thread.join(remaining())
             # a submit racing the closing flag can slip one entry past
             # the worker's exit check; answer stragglers here so no
             # future hangs
@@ -714,6 +730,6 @@ class StreamServer:
             self._closed = True
             self._watchdog_stop.set()
             if self._watchdog_thread is not None:
-                self._watchdog_thread.join(timeout)
+                self._watchdog_thread.join(remaining())
         if self._ingest_error is not None:
             raise self._ingest_error

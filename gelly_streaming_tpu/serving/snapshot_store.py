@@ -352,6 +352,16 @@ def load_newest_snapshot(
         if doc.get("payload") is None:
             integrity.record_rejection(path, "no payload in snapshot doc")
             continue
+        # geometry validation (GL011 symmetry with SnapshotMirror.write:
+        # every committed key is consumed here): a doc missing its
+        # window/watermark/version ints is not a snapshot this follower
+        # can sequence — reject it visibly and fall back
+        if not (isinstance(doc.get("window"), int)
+                and isinstance(doc.get("watermark"), int)
+                and isinstance(doc.get("version"), int)):
+            integrity.record_rejection(
+                path, "snapshot doc geometry keys missing or invalid")
+            continue
         return doc
     return None
 

@@ -12,11 +12,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # `python -m pytest` from the checkout has it
     sys.path.insert(0, REPO)
 
-from tools.benchguard import WATCHED, compare, dig, main  # noqa: E402
+from tools.benchguard import (  # noqa: E402
+    WATCHED,
+    WATCHED_CHAOS,
+    compare,
+    dig,
+    main,
+)
 
 
 def doc(p50=10.0, p99=100.0):
     return {"steady": {"p50_ms": p50, "p99_ms": p99}}
+
+
+def chaos_doc(p50=0.15):
+    return {"recovery_s": {"p50": p50, "p90": p50 * 1.5}}
 
 
 def test_dig_walks_dotted_paths():
@@ -62,6 +72,26 @@ def test_watched_metrics_exist_in_the_committed_artifact():
         assert isinstance(dig(committed, metric), (int, float)), metric
 
 
+def test_chaos_watch_list_matches_the_chaos_artifact():
+    # the ISSUE 10 satellite: the CI chaos step watches recovery p50
+    # from the committed chaos artifact — the watch list must resolve
+    path = os.path.join(REPO, "BENCH_CHAOS_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_CHAOS:
+        assert isinstance(dig(committed, metric), (int, float)), metric
+
+
+def test_explicit_watch_list_overrides_default():
+    verdicts = compare(chaos_doc(), chaos_doc(p50=0.2), ratio=3.0,
+                       watched=WATCHED_CHAOS)
+    assert [v["metric"] for v in verdicts] == ["recovery_s.p50"]
+    assert verdicts[0]["ok"] is True
+    verdicts = compare(chaos_doc(), chaos_doc(p50=0.6), ratio=3.0,
+                       watched=WATCHED_CHAOS)
+    assert verdicts[0]["ok"] is False
+
+
 def _write(tmp_path, name, document):
     p = tmp_path / name
     p.write_text(json.dumps(document))
@@ -92,3 +122,17 @@ def test_cli_usage_and_unreadable_inputs(tmp_path):
     assert main(["--committed", committed, "--fresh", str(torn)]) == 2
     assert main(["--committed", committed, "--fresh", committed,
                  "--ratio", "abc"]) == 2
+    assert main(["--committed", committed, "--fresh", committed,
+                 "--watch", " , "]) == 2
+
+
+def test_cli_watch_flag_targets_the_chaos_artifact(tmp_path, capsys):
+    committed = _write(tmp_path, "chaos_committed.json", chaos_doc())
+    regressed = _write(tmp_path, "chaos_fresh.json",
+                       chaos_doc(p50=0.9))
+    assert main(["--committed", committed, "--fresh", regressed,
+                 "--watch", "recovery_s.p50"]) == 1
+    assert main(["--committed", committed, "--fresh", regressed,
+                 "--watch", "recovery_s.p50", "--ratio", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "recovery_s.p50" in out

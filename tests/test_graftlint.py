@@ -989,6 +989,6 @@ def test_cli_rejects_unknown_rule(capsys):
 
 def test_rule_registry_is_coherent():
     ids = [r.id for r in ALL_RULES]
-    assert ids == sorted(ids) and len(ids) == len(set(ids)) == 7
+    assert ids == sorted(ids) and len(ids) == len(set(ids)) == 11
     for rid in ids + ["GL000"]:
         assert RULE_DOCS[rid]

@@ -1,0 +1,919 @@
+"""Tests for graftlint's interprocedural engine (ISSUE 10).
+
+Covers the call graph (``tools/graftlint/graph.py``: resolution shapes
++ the honest unresolved bucket), the dataflow summaries
+(``tools/graftlint/flow.py``), the four engine rules GL008-GL011 (per
+family: a pinned PRE-FIX fixture reproducing the bug this repo actually
+shipped, plus at least one near-miss a sloppier rule would flag), the
+GL001/GL003 call-graph retrofits, baseline-key stability of engine
+findings under line insertion, and the ``--changed``/``--sarif`` CLI
+satellites.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python -m pytest` from the checkout has it
+    sys.path.insert(0, REPO)
+
+from tools.graftlint.cli import main as lint_main
+from tools.graftlint.core import LintModule, run_lint
+from tools.graftlint import flow
+from tools.graftlint.graph import RepoGraph
+from tools.graftlint.rules import ALL_RULES
+from tools.graftlint.rules.gl001_donation import DonationAfterUse
+from tools.graftlint.rules.gl002_locks import LockDiscipline
+from tools.graftlint.rules.gl003_swallow import SilentSwallow
+from tools.graftlint.rules.gl004_hostsync import HostSyncInHotPath
+from tools.graftlint.rules.gl005_obsgate import ObsZeroOverhead
+from tools.graftlint.rules.gl006_atomic import AtomicCommitDiscipline
+from tools.graftlint.rules.gl007_faults import FaultHookPurity
+from tools.graftlint.rules.gl008_deadline import DeadlineBudget
+from tools.graftlint.rules.gl009_blocklock import BlockingUnderLock
+from tools.graftlint.rules.gl010_lifecycle import ResourceLifecycle
+from tools.graftlint.rules.gl011_codec import WireCodecSymmetry
+
+
+def _fresh_rules():
+    return [
+        DonationAfterUse(),
+        LockDiscipline(),
+        SilentSwallow(),
+        HostSyncInHotPath(),
+        ObsZeroOverhead(),
+        AtomicCommitDiscipline(),
+        FaultHookPurity(),
+        DeadlineBudget(),
+        BlockingUnderLock(),
+        ResourceLifecycle(),
+        WireCodecSymmetry(),
+    ]
+
+
+def write_files(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+
+
+def lint_files(tmp_path, files):
+    write_files(tmp_path, files)
+    res = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path))
+    assert not res.errors, res.errors
+    return res
+
+
+def rule_ids(res):
+    return [f.rule for f in res.findings]
+
+
+def build_graph(tmp_path, files):
+    write_files(tmp_path, files)
+    mods = {}
+    for rel in files:
+        full = str(tmp_path / rel)
+        with open(full, encoding="utf-8") as f:
+            mods[rel] = LintModule(full, rel, f.read())
+    return RepoGraph(mods)
+
+
+# --------------------------------------------------------------------- #
+# Call-graph resolution
+# --------------------------------------------------------------------- #
+GRAPH_FIXTURE = {
+    "pkg/util.py": """
+    def helper(x):
+        return x
+
+    class Base:
+        def shared(self):
+            return 1
+
+    class Tool(Base):
+        def run(self):
+            return self.shared()
+    """,
+    "pkg/user.py": """
+    from .util import helper, Tool
+    from . import util as _u
+
+    def local_fn():
+        return helper(1)
+
+    class Owner:
+        def own_method(self):
+            return 2
+
+        def caller(self):
+            self.own_method()          # self.method
+            local_fn()                 # module-level
+            helper(3)                  # imported symbol
+            _u.helper(4)               # module alias
+            Tool.run(None)             # Cls.method
+            Tool().run()               # Cls(...).method
+            self.duck.quack()          # unresolved: duck-typed attr
+    """,
+}
+
+
+def _resolutions(graph, rel, qualname):
+    info = next(i for i in graph.iter_functions()
+                if i.relpath == rel and i.qualname == qualname)
+    return list(graph.calls_in(info))
+
+
+def test_callgraph_resolution_shapes(tmp_path):
+    g = build_graph(tmp_path, GRAPH_FIXTURE)
+    got = {t.qualname for _c, t in
+           _resolutions(g, "pkg/user.py", "Owner.caller")
+           if t is not None}
+    assert got == {"Owner.own_method", "local_fn", "helper",
+                   "Tool.run"}
+    # the duck-typed call landed in the honest unresolved bucket
+    assert any(name == "self.duck.quack" for _rel, name, _ln
+               in g.unresolved)
+
+
+def test_callgraph_base_class_method(tmp_path):
+    g = build_graph(tmp_path, GRAPH_FIXTURE)
+    resolved = {t.qualname for _c, t in
+                _resolutions(g, "pkg/util.py", "Tool.run")
+                if t is not None}
+    assert resolved == {"Base.shared"}
+
+
+def test_callgraph_callers_index(tmp_path):
+    g = build_graph(tmp_path, GRAPH_FIXTURE)
+    helper = g.functions["pkg/util.py"]["helper"]
+    callers = {c.qualname for c, _call in g.callers_of(helper)}
+    assert callers == {"local_fn", "Owner.caller"}
+
+
+def test_flow_blocking_and_taint(tmp_path):
+    g = build_graph(tmp_path, {"m.py": """
+    import time
+
+    def f(timeout):
+        time.sleep(0.1)
+        q.join()
+        sep = ","
+        sep.join(["a"])          # string join: not blocking
+        import os
+        os.path.join("a", "b")   # path join: not blocking
+        d.get("k")               # keyed get: not blocking
+        return timeout
+
+    def g(timeout):
+        timeout = min(timeout, 1.0)
+        return timeout
+    """})
+    fi = g.functions["m.py"]["f"]
+    s = flow.summarize(g, fi)
+    kinds = [k for k, _n in s.blocking]
+    assert "time.sleep()" in kinds and ".join()" in kinds
+    assert len(kinds) == 2  # neither str.join, os.path.join, nor .get
+    assert s.param_is_raw_at("timeout")
+    gi = g.functions["m.py"]["g"]
+    assert not flow.summarize(g, gi).param_is_raw_at("timeout")
+
+
+# --------------------------------------------------------------------- #
+# GL008 deadline-budget propagation
+# --------------------------------------------------------------------- #
+# Pinned PRE-FIX shape (verbatim from serving/server.py before this
+# PR): StreamServer.submit's Overloaded retry loop slept an unclamped
+# delay_s backoff and re-admitted with the ORIGINAL deadline_s — every
+# retry granted the query a fresh full budget measured from its late
+# t0 (the PR 8 "resubmit must ship the REMAINING budget" bug class,
+# one layer down).
+GL008_PINNED = {
+    "serving/server.py": """
+    import time
+
+    class StreamServer:
+        def _admit(self, query, deadline_s, ctx=None):
+            return query
+
+        def submit(self, query, *, deadline_s=None,
+                   retry_policy=None, ctx=None):
+            policy = retry_policy
+            attempt = 0
+            while True:
+                try:
+                    return self._admit(query, deadline_s, ctx)
+                except RuntimeError:
+                    delay = None if policy is None \\
+                        else policy.delay_s(attempt)
+                    if delay is None:
+                        raise
+                    attempt += 1
+                    time.sleep(delay)
+    """,
+}
+
+# Pre-fix close shape: each join of the teardown chain got the FULL
+# timeout — a wedged thread tripled the caller's wait.
+GL008_CLOSE = {
+    "serving/server.py": """
+    class StreamServer:
+        def close(self, timeout=30.0):
+            self._ingest_thread.join(timeout)
+            self._worker_thread.join(timeout)
+    """,
+}
+
+GL008_NEG = {
+    # forwarding ONE deadline to N queries with no time passing is
+    # correct semantics (the RpcServer._serve_batch shape), and the
+    # remaining-budget idiom is the blessed fix
+    "serving/rpc.py": """
+    import time
+
+    class RpcServer:
+        def _serve_batch(self, queries, deadline_s):
+            futs = []
+            for q in queries:
+                futs.append(
+                    self.server.submit(q, deadline_s=deadline_s))
+            return futs
+
+        def close(self, timeout=30.0):
+            deadline = time.monotonic() + timeout
+            self._a.join(max(0.0, deadline - time.monotonic()))
+            self._b.join(max(0.0, deadline - time.monotonic()))
+    """,
+}
+
+
+def test_gl008_pinned_submit_retry_shape_fires(tmp_path):
+    res = lint_files(tmp_path, GL008_PINNED)
+    msgs = [f.message for f in res.findings if f.rule == "GL008"]
+    assert len(msgs) == 2
+    assert any("deadline_s" in m and "_admit" in m for m in msgs)
+    assert any("delay_s/exp_backoff" in m for m in msgs)
+
+
+def test_gl008_close_budget_reuse_fires_once(tmp_path):
+    res = lint_files(tmp_path, GL008_CLOSE)
+    msgs = [f.message for f in res.findings if f.rule == "GL008"]
+    # the FIRST join legitimately spends the budget; the second is the
+    # finding
+    assert len(msgs) == 1 and "re-spends" in msgs[0]
+
+
+def test_gl008_near_misses_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL008_NEG)
+    assert "GL008" not in rule_ids(res)
+
+
+def test_gl008_result_in_comprehension_fires(tmp_path):
+    res = lint_files(tmp_path, {"serving/client.py": """
+    class C:
+        def ask_batch(self, futures, timeout=None):
+            return [f.result(timeout) for f in futures]
+    """})
+    msgs = [f.message for f in res.findings if f.rule == "GL008"]
+    assert len(msgs) == 1 and "loop" in msgs[0]
+
+
+# --------------------------------------------------------------------- #
+# GL009 blocking-call-under-lock
+# --------------------------------------------------------------------- #
+# Pinned PRE-FIX shape (verbatim-reduced from serving/rpc.py before
+# this PR): ReplicaServer held its promotion lock through the
+# heartbeat lease's first commit — shared-directory file I/O reached
+# through two call levels — so every close()/probe caller queued
+# behind a disk write.
+GL009_PINNED = {
+    "serving/rpc.py": """
+    import threading
+
+    class HeartbeatLease:
+        def write(self):
+            with open(self.path + ".tmp", "wb") as f:
+                f.write(b"x")
+
+        def start(self):
+            self.write()
+            return self
+
+    class ReplicaServer:
+        def __init__(self):
+            self._plock = threading.Lock()
+
+        def promote(self):
+            with self._plock:
+                self.lease = HeartbeatLease().start()
+    """,
+}
+
+GL009_DIRECT = {
+    "serving/failover.py": """
+    import time
+
+    class FailoverServer:
+        def promote(self):
+            with self._plock:
+                time.sleep(0.001)
+    """,
+}
+
+GL009_NEG = {
+    # the fixed shape: the reference swap is locked, the I/O is not;
+    # a TIMED Condition.wait under its own condition is the idiom
+    "serving/rpc.py": """
+    import threading
+
+    class HeartbeatLease:
+        def write(self):
+            with open(self.path + ".tmp", "wb") as f:
+                f.write(b"x")
+
+        def start(self):
+            self.write()
+            return self
+
+    class ReplicaServer:
+        def _install_lease(self, lease):
+            with self._plock:
+                self.lease = lease
+
+        def promote(self):
+            lease = HeartbeatLease().start()
+            self._install_lease(lease)
+
+        def wait_progress(self, timeout):
+            with self._cond:
+                self._cond.wait(timeout)
+    """,
+}
+
+# Call-mediated lock-order cycle: the lexical half alone (B.h) is not a
+# cycle; A.f's helper call closes it through the call graph.
+GL009_CYCLE = {
+    "serving/a.py": """
+    class A:
+        def f(self):
+            with self._alock:
+                self.g()
+
+        def g(self):
+            with self._block:
+                return 1
+    """,
+    "serving/b.py": """
+    class B:
+        def h(self):
+            with self._block:
+                with self._alock:
+                    return 1
+    """,
+}
+
+
+def test_gl009_pinned_lease_under_plock_fires(tmp_path):
+    res = lint_files(tmp_path, GL009_PINNED)
+    hits = [f for f in res.findings if f.rule == "GL009"]
+    assert len(hits) == 1
+    assert "HeartbeatLease.start" in hits[0].message
+    assert "HeartbeatLease.write" in hits[0].message  # the chain
+    assert hits[0].symbol == "ReplicaServer.promote"
+
+
+def test_gl009_direct_sleep_under_lock_fires(tmp_path):
+    res = lint_files(tmp_path, GL009_DIRECT)
+    hits = [f for f in res.findings if f.rule == "GL009"]
+    assert len(hits) == 1 and "time.sleep()" in hits[0].message
+
+
+def test_gl009_lock_free_io_and_timed_wait_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL009_NEG)
+    assert "GL009" not in rule_ids(res)
+
+
+def test_gl009_nested_def_under_lock_is_clean(tmp_path):
+    # review finding: a callback DEFINED under the lock does not RUN
+    # under it — its body must not be linted as lock-held work
+    res = lint_files(tmp_path, {"serving/x.py": """
+    import time
+
+    class S:
+        def arm(self):
+            with self._lock:
+                def later():
+                    time.sleep(5)
+                self._cb = later
+    """})
+    assert "GL009" not in rule_ids(res)
+
+
+def test_reaches_negative_not_cached_under_truncation(tmp_path):
+    # review finding: a negative computed under the depth cap (or a
+    # cycle cut) must not poison later queries from shallower roots
+    import tools.graftlint.graph as graph_mod
+    chain = {"m.py": "\n".join(
+        [f"def f{i}():\n    return f{i + 1}()"
+         for i in range(graph_mod.REACH_DEPTH + 2)]
+        + [f"def f{graph_mod.REACH_DEPTH + 2}():\n"
+           f"    import time\n"
+           f"    time.sleep(1)"]
+    )}
+    g = build_graph(tmp_path, chain)
+
+    def pred(fi):
+        s = flow.summarize(g, fi)
+        return s.blocking[0][0] if s.blocking else None
+
+    deep_root = g.functions["m.py"]["f0"]
+    shallow = g.functions["m.py"][f"f{graph_mod.REACH_DEPTH}"]
+    # the deep query truncates before the sleep...
+    assert g.reaches(deep_root, pred) is None
+    # ...but must not have cached a wrong None for the shallow root
+    got = g.reaches(shallow, pred)
+    assert got is not None and got[0] == "time.sleep()"
+
+
+def test_gl009_call_mediated_cycle_fires(tmp_path):
+    res = lint_files(tmp_path, GL009_CYCLE)
+    hits = [f for f in res.findings
+            if f.rule == "GL009" and "cycle" in f.message]
+    assert hits and all("call-mediated" in f.message for f in hits)
+    # GL002's lexical-only cycle detection must NOT double-report it
+    assert not any(f.rule == "GL002" for f in res.findings)
+
+
+def test_gl009_one_direction_is_clean(tmp_path):
+    res = lint_files(
+        tmp_path, {k: v for k, v in GL009_CYCLE.items()
+                   if k == "serving/a.py"})
+    assert not any("cycle" in f.message for f in res.findings
+                   if f.rule == "GL009")
+
+
+# --------------------------------------------------------------------- #
+# GL010 resource lifecycle
+# --------------------------------------------------------------------- #
+# Pinned PRE-FIX shape (the PR 5 hardening item, CHANGES.md: "the
+# driver no longer leaks one log fd per spawn"): Popen between open
+# and close — a spawn failure raised past the straight-line close.
+GL010_PINNED = {
+    "resilience/chaos.py": """
+    import subprocess
+
+    def spawn_worker(cmd, log_path):
+        logf = open(log_path, "wb")
+        p = subprocess.Popen(cmd, stdout=logf,
+                             stderr=subprocess.STDOUT)
+        logf.close()
+        return p
+    """,
+}
+
+# The accept-thread shape fixed in this PR: socket config between
+# accept and handoff, outside any guard.
+GL010_SOCKET = {
+    "serving/rpc.py": """
+    class RpcServer:
+        def _accept(self):
+            while True:
+                try:
+                    sock, _addr = self._listener.accept()
+                except OSError:
+                    continue
+                sock.settimeout(None)
+                self._conns.add(Wire(sock))
+    """,
+}
+
+GL010_NEG = {
+    # every clean shape: with, try/finally, field ownership, return,
+    # guarded config
+    "resilience/chaos.py": """
+    import subprocess
+
+    def spawn_fixed(cmd, log_path):
+        logf = open(log_path, "wb")
+        try:
+            p = subprocess.Popen(cmd, stdout=logf,
+                                 stderr=subprocess.STDOUT)
+        finally:
+            logf.close()
+        return p
+
+    def read_all(path):
+        with open(path, "rb") as f:
+            return f.read()
+
+    class Sink:
+        def _open(self, path):
+            self._f = open(path, "a")
+
+    def make_handle(path):
+        f = open(path, "rb")
+        return f
+    """,
+    "serving/rpc.py": """
+    class RpcServer:
+        def _accept(self):
+            while True:
+                try:
+                    sock, _addr = self._listener.accept()
+                except OSError:
+                    continue
+                try:
+                    sock.settimeout(None)
+                except OSError:
+                    sock.close()
+                    continue
+                self._conns.add(Wire(sock))
+    """,
+}
+
+
+def test_gl010_pinned_spawn_fd_leak_fires(tmp_path):
+    res = lint_files(tmp_path, GL010_PINNED)
+    hits = [f for f in res.findings if f.rule == "GL010"]
+    assert len(hits) == 1
+    assert "'logf'" in hits[0].message
+    assert "straight-line" in hits[0].message
+
+
+def test_gl010_socket_config_before_handoff_fires(tmp_path):
+    res = lint_files(tmp_path, GL010_SOCKET)
+    hits = [f for f in res.findings if f.rule == "GL010"]
+    assert len(hits) == 1 and "settimeout" in hits[0].message
+
+
+def test_gl010_chained_open_fires(tmp_path):
+    res = lint_files(tmp_path, {"resilience/coordinated.py": """
+    def read_shard(path):
+        data = open(path, "rb").read()
+        return data
+    """})
+    hits = [f for f in res.findings if f.rule == "GL010"]
+    assert len(hits) == 1 and "refcounter" in hits[0].message
+
+
+def test_gl010_clean_shapes_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL010_NEG)
+    assert "GL010" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# GL011 wire-codec symmetry
+# --------------------------------------------------------------------- #
+# Pinned PRE-HAND-FIX shape of the PR 9 "tc" codec contract: a writer
+# shipping a key nobody reads, and a reader depending strictly on a
+# key nobody writes — the two asymmetries the hand-audit closed.
+GL011_PINNED = {
+    "obs/trace.py": """
+    class TraceContext:
+        def to_wire(self):
+            doc = {"t": self.trace_id}
+            doc["s"] = int(self.parent_sid)
+            return doc
+
+        @classmethod
+        def from_wire(cls, doc):
+            if not isinstance(doc, dict):
+                return None
+            tid = doc.get("t")
+            sid = doc["sid"]
+            return cls(tid, sid)
+    """,
+}
+
+GL011_NEG = {
+    # the real (fixed) tc codec: symmetric, tolerant
+    "obs/trace.py": """
+    class TraceContext:
+        def to_wire(self):
+            doc = {"t": self.trace_id}
+            if self.parent_sid is not None:
+                doc["s"] = int(self.parent_sid)
+            return doc
+
+        @classmethod
+        def from_wire(cls, doc):
+            if not isinstance(doc, dict):
+                return None
+            tid = doc.get("t")
+            sid = doc.get("s")
+            return cls(tid, sid)
+    """,
+    # a reader that returns the doc whole is judged by its DIRECT
+    # callers' reads (one call level through the graph)
+    "obs/codec.py": """
+    import json
+
+    def encode_rec(rec):
+        doc = {"a": rec.a, "b": rec.b}
+        return json.dumps(doc)
+
+    def decode_rec(blob):
+        doc = json.loads(blob)
+        return doc
+
+    def consume(blob):
+        doc = decode_rec(blob)
+        return doc.get("a"), doc.get("b")
+    """,
+    # an UNPAIRED encoder is the unresolved bucket: silence
+    "parallel/multihost.py": """
+    def dict_exchange_encode(vdict, src, dst):
+        doc = {"counts": 1, "planes": 2}
+        return doc
+    """,
+}
+
+
+def test_gl011_pinned_tc_asymmetry_fires_both_ways(tmp_path):
+    res = lint_files(tmp_path, GL011_PINNED)
+    msgs = [f.message for f in res.findings if f.rule == "GL011"]
+    assert len(msgs) == 2
+    assert any("'s'" in m and "never read" in m for m in msgs)
+    assert any("'sid'" in m and "never writes" in m for m in msgs)
+
+
+def test_gl011_symmetric_and_unpaired_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL011_NEG)
+    assert "GL011" not in rule_ids(res)
+
+
+def test_gl011_doc_escaping_past_one_level_is_tolerant(tmp_path):
+    # the decoder's caller hands the doc onward: the real readers are
+    # out of reach, so the rule must say nothing
+    res = lint_files(tmp_path, {"obs/codec.py": """
+    import json
+
+    def encode_rec(rec):
+        doc = {"a": rec.a, "orphan": rec.b}
+        return json.dumps(doc)
+
+    def decode_rec(blob):
+        doc = json.loads(blob)
+        return doc
+
+    def relay(blob, sink):
+        doc = decode_rec(blob)
+        sink.push(doc)
+    """})
+    assert "GL011" not in rule_ids(res)
+
+
+# --------------------------------------------------------------------- #
+# GL001 / GL003 retrofits
+# --------------------------------------------------------------------- #
+def test_gl001_donated_read_via_helper_fires(tmp_path):
+    res = lint_files(tmp_path, {"aggregate/summary.py": """
+    import jax
+
+    def _step(s, x):
+        return s, x
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    class Engine:
+        def dispatch(self, block):
+            out, stacked = step(self._summary, block)
+            self._publish()
+            self._summary = out
+            return stacked
+
+        def _publish(self):
+            self.store.publish(self._summary)
+    """})
+    hits = [f for f in res.findings if f.rule == "GL001"]
+    assert len(hits) == 1 and "_publish" in hits[0].message
+
+
+def test_gl001_rebind_before_helper_is_clean(tmp_path):
+    res = lint_files(tmp_path, {"aggregate/summary.py": """
+    import jax
+
+    def _step(s, x):
+        return s, x
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    class Engine:
+        def dispatch(self, block):
+            out, stacked = step(self._summary, block)
+            self._summary = out
+            self._publish()
+            return stacked
+
+        def _publish(self):
+            self.store.publish(self._summary)
+    """})
+    assert "GL001" not in rule_ids(res)
+
+
+def test_gl003_helper_counted_evidence_is_clean_in_socket_scope(
+        tmp_path):
+    # pre-retrofit this was a FALSE POSITIVE: the count lives one
+    # helper call away and the lexical matcher could not see it
+    res = lint_files(tmp_path, {"serving/rpc.py": """
+    class RpcServer:
+        def _count_and_close(self, conn):
+            get_registry().counter("rpc.malformed", kind="x").inc()
+            conn.close()
+
+        def _handle(self, conn):
+            while True:
+                try:
+                    frame = conn.read()
+                except Exception:
+                    self._count_and_close(conn)
+                    break
+    """})
+    assert "GL003" not in rule_ids(res)
+
+
+def test_gl003_helper_without_evidence_still_fires(tmp_path):
+    res = lint_files(tmp_path, {"serving/rpc.py": """
+    class RpcServer:
+        def _teardown(self, conn):
+            conn.close()
+
+        def _handle(self, conn):
+            while True:
+                try:
+                    frame = conn.read()
+                except Exception:
+                    self._teardown(conn)
+                    break
+    """})
+    hits = [f for f in res.findings if f.rule == "GL003"]
+    assert len(hits) == 1 and "threaded socket" in hits[0].message
+
+
+# --------------------------------------------------------------------- #
+# Baseline-key stability for engine findings
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("fixture", [
+    GL008_PINNED, GL009_PINNED, GL010_PINNED, GL011_PINNED,
+])
+def test_engine_finding_keys_survive_line_insertion(tmp_path, fixture):
+    res = lint_files(tmp_path, fixture)
+    keys = sorted(f.key() for f in res.findings)
+    assert keys, "fixture must produce findings"
+    shifted = {
+        rel: "# one\n# two\n# three\n" + textwrap.dedent(src)
+        for rel, src in fixture.items()
+    }
+    for rel, src in shifted.items():
+        (tmp_path / rel).write_text(src, encoding="utf-8")
+    res2 = run_lint(_fresh_rules(), [str(tmp_path)], str(tmp_path))
+    assert sorted(f.key() for f in res2.findings) == keys
+    assert sorted(f.line for f in res2.findings) != \
+        sorted(f.line for f in res.findings)
+
+
+# --------------------------------------------------------------------- #
+# CLI satellites: --sarif and --changed
+# --------------------------------------------------------------------- #
+def test_sarif_output_shape(tmp_path, capsys):
+    write_files(tmp_path, GL010_PINNED)
+    rc = lint_main(["--sarif", "--root", str(tmp_path),
+                    str(tmp_path / "resilience/chaos.py")])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    results = run["results"]
+    assert len(results) == 1 and results[0]["ruleId"] == "GL010"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "resilience/chaos.py"
+    assert loc["region"]["startLine"] >= 1
+    rule_ids_ = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "GL010" in rule_ids_ and "GL000" in rule_ids_
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-C", str(cwd), "-c", "user.email=t@t",
+         "-c", "user.name=t", *args],
+        capture_output=True, text=True, check=True,
+    )
+
+
+def test_changed_mode_scopes_to_diff_and_neighbors(tmp_path, capsys):
+    # two violating files committed; only ONE is then edited — the
+    # committed-but-untouched violation must not block the pre-commit
+    # loop, while the edited file's finding must
+    write_files(tmp_path, {
+        "edited.py": """
+        def f():
+            try:
+                pass
+            except Exception:
+                pass
+        """,
+        "untouched.py": """
+        def g():
+            try:
+                pass
+            except Exception:
+                pass
+        """,
+    })
+    _git(tmp_path, "init", "-q", "-b", "main")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    (tmp_path / "edited.py").write_text(
+        "# touched\n" + (tmp_path / "edited.py").read_text(),
+        encoding="utf-8")
+
+    rc = lint_main(["--changed", "main", "--root", str(tmp_path),
+                    str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "edited.py" in out and "untouched.py" not in out
+    assert "--changed: 1 changed file" in out
+
+
+def test_changed_mode_pulls_in_callgraph_neighbors(tmp_path, capsys):
+    # editing a helper puts its CALLER in scope: the caller's finding
+    # (which depends on the helper's behavior) is reported too
+    write_files(tmp_path, {
+        "helper.py": """
+        def get_backoff(attempt):
+            return 0.1 * attempt
+        """,
+        "caller.py": """
+        from helper import get_backoff
+
+        def f():
+            get_backoff(1)
+            try:
+                pass
+            except Exception:
+                pass
+        """,
+    })
+    _git(tmp_path, "init", "-q", "-b", "main")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    (tmp_path / "helper.py").write_text(
+        "# touched\ndef get_backoff(attempt):\n    return 0.2\n",
+        encoding="utf-8")
+
+    rc = lint_main(["--changed", "main", "--root", str(tmp_path),
+                    str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "caller.py" in out
+
+
+def test_changed_mode_clean_when_nothing_changed(tmp_path, capsys):
+    write_files(tmp_path, {"bad.py": """
+    def f():
+        try:
+            pass
+        except Exception:
+            pass
+    """})
+    _git(tmp_path, "init", "-q", "-b", "main")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    rc = lint_main(["--changed", "main", "--root", str(tmp_path),
+                    str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "0 findings" in out
+
+
+def test_write_baseline_refuses_changed_filter(tmp_path, capsys):
+    write_files(tmp_path, {"x.py": "a = 1\n"})
+    rc = lint_main(["--changed", "--write-baseline",
+                    "--root", str(tmp_path), str(tmp_path)])
+    assert rc == 2
+    assert "filtered view" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------- #
+# Engine findings integrate with the shared machinery
+# --------------------------------------------------------------------- #
+def test_engine_findings_honor_reasoned_suppressions(tmp_path):
+    res = lint_files(tmp_path, {"serving/failover.py": """
+    import time
+
+    class FailoverServer:
+        def promote(self):
+            with self._plock:
+                time.sleep(0.001)  # graftlint: disable=GL009 (fixture: bounded grace wait is the lock's contract)
+    """})
+    assert "GL009" not in rule_ids(res)
+    assert len(res.suppressed) == 1
+
+
+def test_all_rules_registry_includes_engine_rules():
+    ids = [r.id for r in ALL_RULES]
+    assert ids[-4:] == ["GL008", "GL009", "GL010", "GL011"]

@@ -14,16 +14,22 @@ gate exists to catch "p99 went from 100ms to a second", not to litigate
 10%. It is wired as a NON-BLOCKING CI step for the same reason: a red
 benchguard is a prompt to look, not a merge stopper.
 
-Watched metrics (present in every ``bench.py --serving --rpc``
-artifact): ``steady.p50_ms`` and ``steady.p99_ms`` — the steady-state
-client-measured batch latency. The promotion window is NOT guarded: its
+Watched metrics default to the serving-RPC artifact's
+(``steady.p50_ms``/``steady.p99_ms`` — the steady-state client-measured
+batch latency); ``--watch`` overrides the list for other artifacts —
+the CI chaos step passes ``--watch recovery_s.p50`` against
+``BENCH_CHAOS_CPU.json`` (supervisor-measured recovery latency, the
+resilience layer's own p50). The promotion window is NOT guarded: its
 latency is dominated by the configured lease timeout, which is a
-correctness parameter, not a perf trajectory.
+correctness parameter, not a perf trajectory. ``resume_wall_s`` is not
+guarded either — it is dominated by interpreter/jax boot, a hosting
+property.
 
 Usage::
 
     python -m tools.benchguard --committed BENCH_SERVING_RPC_CPU.json \
-        --fresh /tmp/fresh.json [--ratio 3.0]
+        --fresh /tmp/fresh.json [--ratio 3.0] \
+        [--watch steady.p50_ms,steady.p99_ms]
 
 Exit codes: 0 within bounds, 1 regression, 2 usage/unreadable input.
 """
@@ -36,6 +42,9 @@ from typing import List, Optional, Tuple
 
 #: dotted paths of the guarded metrics inside the artifact document
 WATCHED = ("steady.p50_ms", "steady.p99_ms")
+
+#: the chaos-sweep artifact's guarded metric (BENCH_CHAOS_CPU.json)
+WATCHED_CHAOS = ("recovery_s.p50",)
 
 #: a fresh value may be up to this many times the committed one
 DEFAULT_RATIO = 3.0
@@ -117,10 +126,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     committed_path = _take(argv, "--committed")
     fresh_path = _take(argv, "--fresh")
     ratio_raw = _take(argv, "--ratio")
+    watch_raw = _take(argv, "--watch")
     if committed_path is None or fresh_path is None or argv:
         print(
             "usage: python -m tools.benchguard --committed <artifact> "
-            "--fresh <artifact> [--ratio 3.0]",
+            "--fresh <artifact> [--ratio 3.0] "
+            "[--watch metric.a,metric.b]",
             file=sys.stderr,
         )
         return 2
@@ -131,11 +142,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"benchguard: --ratio wants a number, got {ratio_raw!r}",
               file=sys.stderr)
         return 2
+    watched = WATCHED
+    if watch_raw is not None:
+        watched = tuple(
+            m.strip() for m in watch_raw.split(",") if m.strip())
+        if not watched:
+            print("benchguard: --watch wants a comma-separated metric "
+                  "list", file=sys.stderr)
+            return 2
     committed = _load(committed_path)
     fresh = _load(fresh_path)
     if committed is None or fresh is None:
         return 2
-    verdicts = compare(committed, fresh, ratio)
+    verdicts = compare(committed, fresh, ratio, watched)
     worst = 0
     for v in verdicts:
         state = ("SKIP" if v["ok"] is None

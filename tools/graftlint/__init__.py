@@ -5,10 +5,12 @@ the README "Static analysis" section for the history). Generic lint
 (unused imports, undefined names, style) belongs to ruff — graftlint
 only carries the invariants a generic linter cannot express:
 
-- GL001 donation-after-use (donated jit buffers read after dispatch)
+- GL001 donation-after-use (donated jit buffers read after dispatch,
+  directly or inside a helper method one call away)
 - GL002 lock discipline (unguarded writes to lock-owned attributes;
-  lock-acquisition-order cycles)
-- GL003 silent-swallow (``except Exception: pass`` hides worker death)
+  lexical lock-acquisition-order cycles)
+- GL003 silent-swallow (``except Exception: pass`` hides worker death;
+  helper-counted evidence resolves through the call graph)
 - GL004 host-sync-in-hot-path (device syncs inside scan bodies /
   per-window loops)
 - GL005 obs zero-overhead (ungated registry/span work in hot modules)
@@ -17,7 +19,23 @@ only carries the invariants a generic linter cannot express:
 - GL007 fault-hook purity (``os._exit`` / injected raises outside the
   fault plan)
 
-Run as ``python -m tools.graftlint``; suppress a finding inline with
+GL008-GL011 run on the interprocedural engine (``graph.py`` whole-repo
+call graph with an honest unresolved bucket; ``flow.py`` cached
+per-function summaries, facts crossing one call level):
+
+- GL008 deadline-budget propagation (a ``deadline_s``/``timeout``
+  forwarded or re-spent un-clamped after time has passed)
+- GL009 blocking-call-under-lock (sleep/socket/file/join/untimed-wait
+  inside a ``with <lock>:`` region, directly or transitively; plus
+  call-mediated lock-order cycles)
+- GL010 resource lifecycle (sockets, file handles, sinks, processes
+  leaked past an exception edge)
+- GL011 wire-codec symmetry (every key a paired encoder writes must be
+  read — or tolerantly defaulted — by its decoder, and vice versa)
+
+Run as ``python -m tools.graftlint``; ``--changed`` scopes the report
+to the files you touched (plus call-graph neighbors), ``--sarif``
+emits code-scanning output. Suppress a finding inline with
 ``# graftlint: disable=GLxxx (reason)`` — the reason is mandatory
 (GL000 flags reason-less suppressions). Grandfathered findings live in
 ``tools/graftlint/baseline.json``; refresh with ``--write-baseline``.

@@ -1,7 +1,17 @@
-"""graftlint CLI: human + JSON output, baseline handling, exit codes.
+"""graftlint CLI: human/JSON/SARIF output, baseline handling, exit codes.
 
 Exit codes: 0 clean (baseline honored), 1 findings, 2 usage/parse
 errors. The CI gate is literally ``python -m tools.graftlint``.
+
+``--changed [BASE]`` is the pre-commit loop: the FULL scan still runs
+(the interprocedural rules need the whole-repo call graph either way —
+it is seconds), but only findings in files differing from the
+merge-base, PLUS files one resolved call-edge away from a changed file,
+are reported. A caller of an edited helper is exactly as suspect as
+the edit; everything further out is yesterday's clean run.
+
+``--sarif`` emits SARIF 2.1.0 for code-scanning upload (the
+non-blocking annotation step in tier1.yml).
 """
 
 from __future__ import annotations
@@ -9,11 +19,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from .core import load_baseline, run_lint, write_baseline
+from .graph import neighbor_files
 from .rules import ALL_RULES, RULE_DOCS
 
 REPO_ROOT = os.path.abspath(
@@ -22,11 +34,15 @@ DEFAULT_ROOTS = ("gelly_streaming_tpu", "bench.py", "tools")
 DEFAULT_BASELINE = os.path.join(
     os.path.dirname(__file__), "baseline.json")
 
+#: merge-base candidates tried in order for `--changed` with no BASE
+CHANGED_BASE_CANDIDATES = ("origin/main", "origin/master", "main",
+                           "master")
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="repo-specific static analysis (rules GL001-GL007; "
+        description="repo-specific static analysis (rules GL001-GL011; "
                     "each encodes a bug this codebase has shipped)",
     )
     p.add_argument("paths", nargs="*",
@@ -34,6 +50,15 @@ def build_parser() -> argparse.ArgumentParser:
                         % " ".join(DEFAULT_ROOTS))
     p.add_argument("--json", action="store_true",
                    help="machine-readable findings on stdout")
+    p.add_argument("--sarif", action="store_true",
+                   help="SARIF 2.1.0 findings on stdout (code-scanning "
+                        "upload shape)")
+    p.add_argument("--changed", nargs="?", const="auto", default=None,
+                   metavar="BASE",
+                   help="report only findings in files changed vs the "
+                        "merge-base with BASE (default: first of %s), "
+                        "plus their one-hop call-graph neighbors"
+                        % "/".join(CHANGED_BASE_CANDIDATES))
     p.add_argument("--baseline", default=None,
                    help="baseline file (default: tools/graftlint/"
                         "baseline.json when linting the repo)")
@@ -49,6 +74,117 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--verbose", action="store_true",
                    help="also list suppressed/baselined findings")
     return p
+
+
+# --------------------------------------------------------------------- #
+# --changed support
+# --------------------------------------------------------------------- #
+def _git(root: str, *args: str) -> Optional[str]:
+    try:
+        r = subprocess.run(
+            ["git", "-C", root, *args],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return r.stdout.strip() if r.returncode == 0 else None
+
+
+def changed_files(root: str, base: str) -> Optional[Set[str]]:
+    """Repo-relative .py files differing from the merge-base with
+    ``base`` (plus untracked ones). None when git/merge-base is
+    unavailable — the caller falls back to a full report rather than
+    silently reporting nothing."""
+    sha = None
+    candidates = CHANGED_BASE_CANDIDATES if base == "auto" else (base,)
+    for cand in candidates:
+        sha = _git(root, "merge-base", "HEAD", cand)
+        if sha is not None:
+            break
+    if sha is None:
+        return None
+    diff = _git(root, "diff", "--name-only", sha)
+    untracked = _git(root, "ls-files", "--others",
+                     "--exclude-standard")
+    if diff is None:
+        return None
+    out: Set[str] = set()
+    for blob in (diff, untracked or ""):
+        for line in blob.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(line.replace(os.sep, "/"))
+    return out
+
+
+def changed_scope(mods, changed: Set[str]) -> Set[str]:
+    """The reporting scope for --changed: the changed files plus their
+    one-hop resolved call-graph neighbors (restricted to scanned
+    files)."""
+    present = {rel for rel in changed if rel in mods}
+    return present | neighbor_files(mods, present)
+
+
+# --------------------------------------------------------------------- #
+# SARIF
+# --------------------------------------------------------------------- #
+def to_sarif(findings, root: str) -> dict:
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {"text": RULE_DOCS.get(rid, rid)},
+            # the rule docs live in the README's "Static analysis"
+            # section; no absolute helpUri is emitted because the tool
+            # does not know its hosting URL (a wrong one would 404
+            # from the code-scanning UI)
+            "fullDescription": {
+                "text": "See README.md#static-analysis in the "
+                        "repository root for the shipped-bug history "
+                        "behind this rule.",
+            },
+        }
+        for rid in sorted({f.rule for f in findings} | set(RULE_DOCS))
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message + (
+                f" [{f.symbol}]" if f.symbol else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": max(1, f.line),
+                        "startColumn": max(1, f.col),
+                    },
+                },
+            }],
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftlint",
+                    "informationUri":
+                        "https://example.invalid/graftlint",
+                    "rules": rules,
+                },
+            },
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file://" + root.rstrip("/") + "/"},
+            },
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -88,7 +224,29 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     res = run_lint(rules, roots, root, baseline=baseline)
 
+    scope_note = ""
+    if args.changed is not None:
+        changed = changed_files(root, args.changed)
+        if changed is None:
+            scope_note = " (--changed: no git merge-base; full report)"
+        else:
+            # the run's own parsed modules: the graph memo keys on
+            # module identity, so this reuses the interprocedural
+            # rules' whole-repo graph instead of re-parsing everything
+            scope = changed_scope(res.mods, changed)
+            res.findings = [f for f in res.findings if f.path in scope]
+            scope_note = (
+                f" (--changed: {len(changed)} changed file"
+                f"{'' if len(changed) == 1 else 's'}, "
+                f"{len(scope)} in scope)"
+            )
+
     if args.write_baseline:
+        if args.changed is not None:
+            print("graftlint: --write-baseline with --changed would "
+                  "grandfather a filtered view — run it on the full "
+                  "scan", file=sys.stderr)
+            return 2
         if not default_scan and not args.baseline:
             # a partial scan sees only a subset of findings; writing it
             # over the repo-wide default would silently drop every
@@ -106,7 +264,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     dt = time.perf_counter() - t0
-    if args.json:
+    if args.sarif:
+        print(json.dumps(to_sarif(res.findings, root), indent=1,
+                         sort_keys=True))
+    elif args.json:
         print(json.dumps({
             "findings": [f.__dict__ for f in res.findings],
             "suppressed": len(res.suppressed),
@@ -134,7 +295,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"graftlint: {len(res.findings)} finding"
               f"{'' if len(res.findings) == 1 else 's'} "
               f"[{summary}] — {len(res.suppressed)} suppressed, "
-              f"{len(res.baselined)} baselined, {dt:.2f}s")
+              f"{len(res.baselined)} baselined, "
+              f"{dt:.2f}s{scope_note}")
     if res.errors:
         return 2
     return 1 if res.findings else 0

@@ -196,6 +196,11 @@ class LintResult:
         default_factory=list)
     baselined: List[Finding] = field(default_factory=list)
     errors: List[str] = field(default_factory=list)
+    #: the parsed modules of the scan — consumers (the CLI's --changed
+    #: call-graph expansion) reuse these instead of re-parsing; the
+    #: graph memo keys on module identity, so the interprocedural
+    #: rules' whole-repo graph is shared for free
+    mods: Dict[str, "LintModule"] = field(default_factory=dict)
 
 
 def iter_python_files(roots: Iterable[str], repo_root: str
@@ -268,6 +273,7 @@ def run_lint(
             _route(res, budget, mods.get(f.path), f)
     for rule in rules:
         rule.reset()  # drop retained modules/ASTs between runs
+    res.mods = mods
     res.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return res
 

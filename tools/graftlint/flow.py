@@ -1,0 +1,370 @@
+"""Per-function dataflow facts for the interprocedural rules.
+
+One :class:`Summary` per function, computed on demand and CACHED on the
+:class:`~tools.graftlint.graph.RepoGraph` (the whole self-run builds
+each summary once — the 30s CI budget is a hard constraint). A summary
+is deliberately shallow: linear, statement-ordered facts about ONE
+function body, the same paranoia level as GL001's liveness walk —
+control flow is not modeled, and every classifier here errs toward
+silence (an unknown shape is an unknown, not a finding).
+
+What rules read out of a summary:
+
+- **blocking ops** (GL009): direct calls that can block the calling
+  thread — ``time.sleep``, socket ``send/sendall/recv/accept/connect``,
+  ``open``, thread-shaped ``.join()``, and UNTIMED ``.get()``/
+  ``.wait()`` (zero-argument; a timed wait is a different, bounded
+  contract — and ``Condition.wait(t)`` under its own condition lock is
+  the idiom, not a bug).
+- **time-passing ops** (GL008): the subset of blocking ops plus
+  deadline-spending sinks — after one of these fires, a function's
+  original deadline budget is no longer the remaining budget.
+- **evidence** (GL003 retrofit): the body counts a registry event,
+  records a rejection, or re-raises.
+- **self-attribute loads** (GL001 retrofit): ``self.X`` reads anywhere
+  in the body — what a donated-buffer read hidden behind a helper call
+  looks like from the caller.
+- **lock acquisitions** (GL009's interprocedural order edges) and
+  **codec facts** (GL011: constant dict keys written/read, whether the
+  decoded object escapes).
+
+Taint here is reaching-definitions at its simplest: a parameter is RAW
+at a use iff the function never rebinds that name (any assignment —
+including a clamp — kills the taint; the bias is silence).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import call_name, dotted, last_attr
+from .graph import FunctionInfo, RepoGraph
+
+#: parameter names that carry a deadline/timeout budget (GL008)
+DEADLINE_PARAMS = frozenset({
+    "deadline_s", "deadline", "timeout", "timeout_s", "budget_s",
+})
+
+#: dict keys that carry a deadline across a wire/frame boundary
+DEADLINE_KEYS = DEADLINE_PARAMS
+
+#: attribute calls that SPEND a wall-clock budget passed as their
+#: argument: thread/process joins, future results, bounded waits,
+#: closes with a drain timeout
+SPEND_ATTRS = frozenset({"join", "wait", "result", "close", "acquire"})
+
+#: socket attribute calls that block the calling thread
+_SOCKET_ATTRS = frozenset({
+    "recv", "recv_into", "accept", "sendall", "send", "connect",
+    "makefile",
+})
+
+#: registry-evidence calls (same set GL003 matches inline)
+_EVIDENCE_CALLS = frozenset({
+    "counter", "gauge", "histogram", "record_rejection",
+})
+
+_LOCKISH = ("lock", "_mu", "_cond", "_condition", "wlock", "plock")
+
+
+def lock_attr_of(expr: ast.AST) -> Optional[str]:
+    """The lock attribute acquired by a with-item context expression
+    (``self._lock`` / ``primary._plock`` -> attr name), else None."""
+    name = dotted(expr)
+    if name is None:
+        return None
+    short = name.rsplit(".", 1)[-1]
+    low = short.lower()
+    if any(t in low for t in _LOCKISH):
+        return short
+    return None
+
+
+def _receiver_dotted(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return dotted(call.func.value)
+    return None
+
+
+def _is_thread_join(call: ast.Call) -> bool:
+    """``X.join(...)`` that is thread/process-shaped: zero args (string
+    ``sep.join`` always takes one), or a receiver whose name says
+    thread/proc, with a numeric/name timeout. ``os.path.join`` and
+    string joins never match."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "join"):
+        return False
+    recv = _receiver_dotted(call)
+    if recv is not None and recv.startswith("os.path"):
+        return False
+    if not call.args and not call.keywords:
+        return True
+    if len(call.args) == 1 and recv is not None:
+        low = recv.lower()
+        if "thread" in low or "proc" in low or low.endswith("_t"):
+            return True
+        if isinstance(call.args[0], (ast.Constant, ast.Name)) and not (
+            isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)
+        ):
+            # join(<number or timeout name>): str.join takes an
+            # iterable, never a number/timeout — thread-shaped
+            return "".join(low.split("_")) != "ospath"
+    return False
+
+
+def blocking_kind(call: ast.Call) -> Optional[str]:
+    """Classify a call as a thread-blocking operation (GL009's sink
+    set), or None. Timed ``.get(t)``/``.wait(t)`` are NOT classified —
+    only the untimed forever-blocking forms are."""
+    name = call_name(call)
+    if name in ("time.sleep", "sleep"):
+        return "time.sleep()"
+    if name in ("socket.create_connection", "_socket.create_connection",
+                "create_connection"):
+        return "socket connect"
+    if name == "open":
+        return "open()"
+    if isinstance(call.func, ast.Attribute):
+        a = call.func.attr
+        if a in _SOCKET_ATTRS:
+            return f"socket .{a}()"
+        if _is_thread_join(call):
+            return ".join()"
+        if a in ("get", "wait") and not call.args and not call.keywords:
+            return f"untimed .{a}()"
+    return None
+
+
+def time_passing_kind(call: ast.Call) -> Optional[str]:
+    """GL008's 'the budget is being spent' set: every blocking op plus
+    any timed spend (``.join(t)``/``.wait(t)``/``.result(t)``). The
+    first argument must be timeout-shaped (a number, name, attribute,
+    or expression — never a string/iterable), so ``os.path.join`` and
+    ``sep.join(parts)`` stay out."""
+    kind = blocking_kind(call)
+    if kind is not None:
+        return kind
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in SPEND_ATTRS and call.args:
+        if call.func.attr == "join" and not _is_thread_join(call):
+            return None
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and not isinstance(
+                a0.value, (int, float)):
+            return None
+        if isinstance(a0.value if isinstance(a0, ast.Constant)
+                      else None, bool):
+            return None
+        if not isinstance(a0, (ast.Constant, ast.Name, ast.Attribute,
+                               ast.BinOp, ast.Call, ast.IfExp)):
+            return None
+        return f".{call.func.attr}(timeout)"
+    return None
+
+
+@dataclass
+class Summary:
+    """Linear facts about one function body (nested defs excluded)."""
+
+    info: FunctionInfo
+    calls: List[Tuple[ast.Call, Optional[str]]] = field(
+        default_factory=list)  # (node, dotted name)
+    blocking: List[Tuple[str, ast.Call]] = field(default_factory=list)
+    time_passing: List[Tuple[str, ast.Call]] = field(
+        default_factory=list)
+    stores: Dict[str, List[int]] = field(default_factory=dict)
+    evidence: bool = False
+    self_attr_loads: Set[str] = field(default_factory=set)
+    self_attr_stores: Dict[str, List[int]] = field(default_factory=dict)
+    lock_acquires: List[Tuple[str, ast.AST]] = field(
+        default_factory=list)  # (lock attr, With node)
+    # -- codec facts (GL011) ------------------------------------------- #
+    #: constant keys written into locally-built dicts, key -> node
+    dict_key_writes: Dict[str, ast.AST] = field(default_factory=dict)
+    #: constant keys read strictly (``doc["k"]``), key -> node
+    dict_key_strict_reads: Dict[str, ast.AST] = field(
+        default_factory=dict)
+    #: constant keys read tolerantly (``doc.get("k")`` / ``"k" in doc``)
+    dict_key_tolerant_reads: Set[str] = field(default_factory=set)
+    #: names of local vars holding a deserialized doc (json/pickle
+    #: loads / unwrap result), and how they leave the function:
+    #: returned whole (callers' reads then count, one level) vs passed
+    #: on to another call (beyond one level — tolerant by construction)
+    decoded_vars: Set[str] = field(default_factory=set)
+    decoded_returned: bool = False
+    decoded_passed: bool = False
+    #: the function deserializes (loads-shaped) / serializes
+    decodes: bool = False
+    encodes: bool = False
+    #: module-level ALL_CAPS constants referenced + module-local helper
+    #: calls — GL011's pairing evidence
+    const_refs: Set[str] = field(default_factory=set)
+
+    def param_is_raw_at(self, name: str) -> bool:
+        """True when parameter ``name`` is never rebound in this body —
+        the conservative 'the original value is what every use sees'."""
+        return name not in self.stores
+
+    def deadline_params(self) -> Tuple[str, ...]:
+        return tuple(p for p in
+                     self.info.params + self.info.kwonly
+                     if p in DEADLINE_PARAMS)
+
+
+def _nested_nodes(fn) -> set:
+    return {
+        n for sub in ast.walk(fn)
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and sub is not fn
+        for n in ast.walk(sub)
+    }
+
+
+def summarize(graph: RepoGraph, info: FunctionInfo) -> Summary:
+    """Build (or fetch) the summary for one function."""
+    cached = graph._summary_cache.get(info.key)
+    if cached is not None:
+        return cached
+    s = Summary(info)
+    fn = info.node
+    nested = _nested_nodes(fn)
+    # annotation subtrees: `-> Optional["X"]` is a Subscript with a
+    # string slice — type syntax, never a dict read
+    anns: set = set()
+    for node in ast.walk(fn):
+        for sub in getattr(node, "annotation", None), \
+                getattr(node, "returns", None):
+            if sub is not None:
+                anns |= set(ast.walk(sub))
+        if isinstance(node, ast.arg) and node.annotation is not None:
+            anns |= set(ast.walk(node.annotation))
+    # pre-pass: decoded-var names must exist before the main walk sees
+    # any use (ast.walk is breadth-first; a shallow `return doc` visits
+    # before the deeper `doc = loads(...)`)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and node not in nested:
+            _note_assign(s, node)
+    for node in ast.walk(fn):
+        if node in nested or node in anns:
+            continue
+        if isinstance(node, ast.Call):
+            _note_call(s, node)
+        elif isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                s.stores.setdefault(node.id, []).append(node.lineno)
+            elif node.id.isupper() and len(node.id) > 2:
+                s.const_refs.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            name = dotted(node)
+            if name is not None and name.startswith("self.") and \
+                    name.count(".") == 1:
+                attr = name.split(".", 1)[1]
+                if isinstance(node.ctx, ast.Load):
+                    s.self_attr_loads.add(attr)
+                else:
+                    s.self_attr_stores.setdefault(attr, []).append(
+                        node.lineno)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = lock_attr_of(item.context_expr)
+                if attr is not None:
+                    s.lock_acquires.append((attr, node))
+                    break
+        elif isinstance(node, ast.Raise):
+            s.evidence = True
+        elif isinstance(node, ast.Subscript):
+            _note_subscript(s, node)
+        elif isinstance(node, ast.Compare):
+            # "k" in doc -> tolerant read
+            if len(node.ops) == 1 and isinstance(node.ops[0], ast.In) \
+                    and isinstance(node.left, ast.Constant) \
+                    and isinstance(node.left.value, str):
+                s.dict_key_tolerant_reads.add(node.left.value)
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    s.dict_key_writes.setdefault(k.value, k)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in s.decoded_vars:
+                s.decoded_returned = True
+            elif isinstance(node.value, ast.Call):
+                fname = last_attr(call_name(node.value))
+                if fname in ("loads", "load"):
+                    s.decoded_returned = True
+    # a decoded var handed onward whole (passed as an argument to
+    # something other than a read/validate helper)
+    if s.decoded_vars:
+        for node in ast.walk(fn):
+            if node in nested or not isinstance(node, ast.Call):
+                continue
+            fname = last_attr(call_name(node))
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and \
+                        arg.id in s.decoded_vars and \
+                        fname not in ("get", "isinstance", "len",
+                                      "loads", "int", "float", "str"):
+                    s.decoded_passed = True
+    graph._summary_cache[info.key] = s
+    return s
+
+
+def _note_call(s: Summary, node: ast.Call) -> None:
+    name = call_name(node)
+    s.calls.append((node, name))
+    kind = blocking_kind(node)
+    if kind is not None:
+        s.blocking.append((kind, node))
+    tkind = time_passing_kind(node)
+    if tkind is not None:
+        s.time_passing.append((tkind, node))
+    fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+        else last_attr(name)
+    if fname in _EVIDENCE_CALLS:
+        s.evidence = True
+    if fname in ("dumps", "dump", "wrap_checksummed", "pack"):
+        s.encodes = True
+    if fname in ("loads", "load", "unwrap_checksummed", "unpack"):
+        s.decodes = True
+    if fname == "get" and isinstance(node.func, ast.Attribute) and \
+            node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        s.dict_key_tolerant_reads.add(node.args[0].value)
+
+
+def _note_subscript(s: Summary, node: ast.Subscript) -> None:
+    sl = node.slice
+    if not (isinstance(sl, ast.Constant) and isinstance(sl.value, str)):
+        return
+    if isinstance(node.ctx, ast.Store):
+        s.dict_key_writes.setdefault(sl.value, node)
+    else:
+        s.dict_key_strict_reads.setdefault(sl.value, node)
+
+
+def _note_assign(s: Summary, node: ast.Assign) -> None:
+    v = node.value
+    fname = None
+    if isinstance(v, ast.Call):
+        fname = v.func.attr if isinstance(v.func, ast.Attribute) \
+            else last_attr(call_name(v))
+    if fname in ("loads", "load", "from_wire", "read_dump"):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                s.decoded_vars.add(tgt.id)
+
+
+def blocking_reach(graph: RepoGraph, info: FunctionInfo
+                   ) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """Transitive: the first blocking op reachable from ``info``
+    through RESOLVED calls — ``(op kind, call chain)`` or None."""
+
+    def pred(fi: FunctionInfo) -> Optional[str]:
+        s = summarize(graph, fi)
+        return s.blocking[0][0] if s.blocking else None
+
+    return graph.reaches(info, pred)

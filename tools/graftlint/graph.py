@@ -1,0 +1,432 @@
+"""Whole-repo symbol table + call graph: graftlint's interprocedural eye.
+
+Every rule before ISSUE 10 was a single-file AST walk and structurally
+could not see across a call — the exact blind spot the PR 7/8/9
+hardening kept paying for (deadlines un-clamped across a function
+boundary, blocking work reached through a helper, resources leaked one
+frame above their acquisition). This module gives rules a repo-wide
+view on the same stdlib-only terms as the rest of the tool:
+
+- :class:`RepoGraph` indexes every scanned module's classes, methods,
+  module-level functions, and import bindings, then resolves call
+  expressions to :class:`FunctionInfo` targets. Resolved shapes:
+
+  * ``helper(...)``            — module-level function, local or
+    imported by name (``from ..resilience.retry import exp_backoff``);
+  * ``self.method(...)``       — method on the enclosing class,
+    including scanned base classes;
+  * ``Cls.method(...)`` and ``Cls(...).method(...)`` — class-qualified
+    and construct-then-call, with ``Cls`` local or imported;
+  * ``alias.func(...)``        — module alias (``from .. import faults
+    as _faults``; ``_faults.fire``);
+  * ``Cls(...)``               — a scanned class's ``__init__``.
+
+- Everything else lands in an HONEST **unresolved bucket**
+  (:attr:`RepoGraph.unresolved`): duck-typed attribute calls
+  (``self.server.submit``), callables from containers, dynamic
+  dispatch. Rules treat unresolved as unknown and stay silent — the
+  degradation mode is a false negative, never a false positive.
+
+Scope/limits (documented in the README): dataflow facts built on top of
+this graph (:mod:`tools.graftlint.flow`) propagate ONE call level;
+boolean reachability (:meth:`RepoGraph.reaches`) is transitive with a
+depth cap. Single-module views (:func:`module_view`) give the per-file
+rules (GL001/GL003 retrofit) the same resolver without whole-repo
+state.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import LintModule, call_name, dotted
+
+#: resolver recursion caps: base-class walks and transitive reachability
+BASE_DEPTH = 4
+REACH_DEPTH = 8
+
+
+@dataclass(frozen=True)
+class FunctionInfo:
+    """One function/method definition in the scanned repo."""
+
+    relpath: str
+    qualname: str  # "Class.method" or "function"
+    name: str
+    cls: Optional[str]  # owning class name ('' -> None)
+    node: ast.AST  # the FunctionDef/AsyncFunctionDef
+    mod: LintModule
+    params: Tuple[str, ...]  # positional (posonly + args)
+    kwonly: Tuple[str, ...] = ()
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.relpath, self.qualname)
+
+    @property
+    def is_method(self) -> bool:
+        return self.cls is not None
+
+
+@dataclass
+class ClassInfo:
+    relpath: str
+    name: str
+    node: ast.ClassDef
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    bases: Tuple[str, ...] = ()  # raw dotted base names
+
+
+def _params_of(fn) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+    return tuple(pos), tuple(p.arg for p in a.kwonlyargs)
+
+
+def _module_dotted(relpath: str) -> str:
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class RepoGraph:
+    """Symbol table + call resolver over a set of parsed modules."""
+
+    def __init__(self, mods: Dict[str, LintModule]):
+        self.mods = dict(mods)
+        # relpath -> {name: FunctionInfo} (module-level functions)
+        self.functions: Dict[str, Dict[str, FunctionInfo]] = {}
+        # relpath -> {name: ClassInfo}
+        self.classes: Dict[str, Dict[str, ClassInfo]] = {}
+        # relpath -> {local name: (target relpath, symbol)} from-imports
+        self.sym_imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # relpath -> {local alias: target relpath} module imports
+        self.mod_imports: Dict[str, Dict[str, str]] = {}
+        # dotted module name -> relpath, for import resolution
+        self._by_dotted = {_module_dotted(r): r for r in self.mods}
+        #: call expressions no resolver shape matched:
+        #: (relpath, rendered callee or '<dynamic>', line)
+        self.unresolved: List[Tuple[str, str, int]] = []
+        # (relpath, qualname) of the function enclosing each def node
+        self._owner_of_node: Dict[int, FunctionInfo] = {}
+        self._summary_cache: dict = {}  # used by flow.summarize
+        self._reach_cache: dict = {}
+        for rel, mod in self.mods.items():
+            self._index_module(rel, mod)
+        self._callers: Optional[Dict[Tuple[str, str],
+                                     List[Tuple[FunctionInfo,
+                                                ast.Call]]]] = None
+
+    # -- indexing ------------------------------------------------------- #
+    def _index_module(self, rel: str, mod: LintModule) -> None:
+        funcs: Dict[str, FunctionInfo] = {}
+        classes: Dict[str, ClassInfo] = {}
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pos, kwonly = _params_of(node)
+                info = FunctionInfo(rel, node.name, node.name, None,
+                                    node, mod, pos, kwonly)
+                funcs[node.name] = info
+                self._owner_of_node[id(node)] = info
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    rel, node.name, node,
+                    bases=tuple(
+                        b for b in (dotted(x) for x in node.bases)
+                        if b is not None
+                    ),
+                )
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        pos, kwonly = _params_of(sub)
+                        info = FunctionInfo(
+                            rel, f"{node.name}.{sub.name}", sub.name,
+                            node.name, sub, mod, pos, kwonly,
+                        )
+                        ci.methods[sub.name] = info
+                        self._owner_of_node[id(sub)] = info
+                classes[node.name] = ci
+        self.functions[rel] = funcs
+        self.classes[rel] = classes
+        self.sym_imports[rel] = {}
+        self.mod_imports[rel] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                self._index_import_from(rel, node)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = self._by_dotted.get(alias.name)
+                    if target is not None:
+                        local = alias.asname or alias.name.split(".")[0]
+                        self.mod_imports[rel][local] = target
+
+    def _index_import_from(self, rel: str, node: ast.ImportFrom) -> None:
+        if node.level:  # relative: resolve against this file's package
+            # (for __init__.py the directory IS the module's package,
+            # so level 1 already lands right with the same parts)
+            pkg_parts = rel.split("/")[:-1]
+            up = node.level - 1
+            if up:
+                pkg_parts = pkg_parts[: len(pkg_parts) - up] \
+                    if up <= len(pkg_parts) else []
+            base = ".".join(pkg_parts)
+            modname = f"{base}.{node.module}" if node.module else base
+        else:
+            modname = node.module or ""
+        for alias in node.names:
+            local = alias.asname or alias.name
+            # `from pkg import sub` where pkg/sub.py is scanned: module
+            as_mod = self._by_dotted.get(f"{modname}.{alias.name}")
+            if as_mod is not None:
+                self.mod_imports[rel][local] = as_mod
+                continue
+            src = self._by_dotted.get(modname)
+            if src is not None:
+                self.sym_imports[rel][local] = (src, alias.name)
+
+    # -- lookups -------------------------------------------------------- #
+    def owner_of(self, def_node: ast.AST) -> Optional[FunctionInfo]:
+        """The FunctionInfo of a def node seen during indexing (None
+        for nested defs, which have no stable qualname)."""
+        return self._owner_of_node.get(id(def_node))
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        for rel in sorted(self.functions):
+            for info in self.functions[rel].values():
+                yield info
+            for ci in self.classes[rel].values():
+                yield from ci.methods.values()
+
+    def class_named(self, rel: str, name: str) -> Optional[ClassInfo]:
+        """``name`` as visible from module ``rel``: local class,
+        imported symbol, else a globally UNIQUE class of that name
+        (ambiguous names stay unresolved)."""
+        ci = self.classes.get(rel, {}).get(name)
+        if ci is not None:
+            return ci
+        imp = self.sym_imports.get(rel, {}).get(name)
+        if imp is not None:
+            return self.classes.get(imp[0], {}).get(imp[1])
+        hits = [c[name] for c in self.classes.values() if name in c]
+        return hits[0] if len(hits) == 1 else None
+
+    def _method_on(self, rel: str, ci: Optional[ClassInfo], name: str,
+                   depth: int = 0) -> Optional[FunctionInfo]:
+        if ci is None or depth > BASE_DEPTH:
+            return None
+        info = ci.methods.get(name)
+        if info is not None:
+            return info
+        for base in ci.bases:
+            base_ci = self.class_named(ci.relpath, base.split(".")[-1])
+            if base_ci is not None and base_ci is not ci:
+                got = self._method_on(rel, base_ci, name, depth + 1)
+                if got is not None:
+                    return got
+        return None
+
+    def _function_named(self, rel: str, name: str
+                        ) -> Optional[FunctionInfo]:
+        info = self.functions.get(rel, {}).get(name)
+        if info is not None:
+            return info
+        imp = self.sym_imports.get(rel, {}).get(name)
+        if imp is not None:
+            tgt_rel, sym = imp
+            got = self.functions.get(tgt_rel, {}).get(sym)
+            if got is not None:
+                return got
+            ci = self.classes.get(tgt_rel, {}).get(sym)
+            if ci is not None:
+                return ci.methods.get("__init__")
+        ci = self.classes.get(rel, {}).get(name)
+        if ci is not None:
+            return ci.methods.get("__init__")
+        return None
+
+    # -- the resolver --------------------------------------------------- #
+    def resolve_call(self, mod: LintModule, call: ast.Call,
+                     enclosing: Optional[FunctionInfo] = None,
+                     ) -> Optional[FunctionInfo]:
+        """The FunctionInfo a call lands on, or None (bucketed)."""
+        rel = mod.relpath
+        f = call.func
+        got: Optional[FunctionInfo] = None
+        if isinstance(f, ast.Name):
+            got = self._function_named(rel, f.id)
+        elif isinstance(f, ast.Attribute):
+            got = self._resolve_attr_call(rel, f, enclosing)
+        if got is None:
+            name = dotted(f) or "<dynamic>"
+            self.unresolved.append(
+                (rel, name, getattr(call, "lineno", 0)))
+        return got
+
+    def _resolve_attr_call(self, rel: str, f: ast.Attribute,
+                           enclosing: Optional[FunctionInfo],
+                           ) -> Optional[FunctionInfo]:
+        recv = f.value
+        # self.method() -> enclosing class (+ scanned bases)
+        if isinstance(recv, ast.Name) and recv.id == "self" and \
+                enclosing is not None and enclosing.cls is not None:
+            ci = self.classes.get(enclosing.relpath, {}) \
+                .get(enclosing.cls)
+            return self._method_on(rel, ci, f.attr)
+        # Cls(...).method() -> construct-then-call
+        if isinstance(recv, ast.Call):
+            cname = call_name(recv)
+            if cname is not None:
+                ci = self.class_named(rel, cname.split(".")[-1])
+                if ci is not None:
+                    return self._method_on(rel, ci, f.attr)
+            return None
+        name = dotted(recv)
+        if name is None:
+            return None
+        parts = name.split(".")
+        # alias.func() / alias.Cls.method()
+        target_rel = self.mod_imports.get(rel, {}).get(parts[0])
+        if target_rel is not None:
+            if len(parts) == 1:
+                info = self.functions.get(target_rel, {}).get(f.attr)
+                if info is not None:
+                    return info
+                ci = self.classes.get(target_rel, {}).get(f.attr)
+                return None if ci is None else \
+                    ci.methods.get("__init__")
+            if len(parts) == 2:
+                ci = self.classes.get(target_rel, {}).get(parts[1])
+                return self._method_on(rel, ci, f.attr)
+            return None
+        # Cls.method() on a visible class
+        if len(parts) == 1:
+            ci = self.class_named(rel, parts[0])
+            if ci is not None:
+                return self._method_on(rel, ci, f.attr)
+        return None
+
+    # -- traversal helpers ---------------------------------------------- #
+    def calls_in(self, info: FunctionInfo
+                 ) -> Iterator[Tuple[ast.Call, Optional[FunctionInfo]]]:
+        """Every call in ``info``'s body (nested defs excluded) with its
+        resolution (None = unresolved)."""
+        nested = {
+            n for sub in ast.walk(info.node)
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and sub is not info.node
+            for n in ast.walk(sub)
+        }
+        for node in ast.walk(info.node):
+            if node in nested or not isinstance(node, ast.Call):
+                continue
+            yield node, self.resolve_call(info.mod, node, info)
+
+    def callers_of(self, info: FunctionInfo
+                   ) -> List[Tuple[FunctionInfo, ast.Call]]:
+        """Resolved call sites landing on ``info`` (built lazily)."""
+        if self._callers is None:
+            self._callers = {}
+            for fn in self.iter_functions():
+                for call, tgt in self.calls_in(fn):
+                    if tgt is not None:
+                        self._callers.setdefault(tgt.key, []).append(
+                            (fn, call))
+        return self._callers.get(info.key, [])
+
+    def reaches(self, info: FunctionInfo,
+                predicate: Callable[[FunctionInfo], Optional[str]],
+                ) -> Optional[Tuple[str, Tuple[str, ...]]]:
+        """Transitive reachability: does ``info`` (or any resolved
+        callee, depth-capped, cycle-safe) satisfy ``predicate``?
+        Returns ``(predicate result, call-chain qualnames)`` or None.
+        Unresolved callees are skipped — silence over guessing."""
+        got, _complete = self._reaches(info, predicate, 0, set())
+        return got
+
+    def _reaches(self, info: FunctionInfo, predicate, depth: int,
+                 seen: Set[Tuple[str, str]],
+                 ) -> Tuple[Optional[Tuple[str, Tuple[str, ...]]], bool]:
+        """(result, complete): ``complete`` is False when the search
+        was truncated by the depth cap or a cycle cut — a negative
+        computed under truncation must NOT be cached, or a later query
+        from a shallower root would read a wrong None."""
+        if depth > REACH_DEPTH:
+            return None, False
+        if info.key in seen:
+            return None, False  # on the current path: cycle cut
+        if info.key in self._reach_cache:
+            return self._reach_cache[info.key], True
+        hit = predicate(info)
+        if hit is not None:
+            result = (hit, (info.qualname,))
+            self._reach_cache[info.key] = result
+            return result, True
+        seen.add(info.key)
+        complete = True
+        try:
+            for call, tgt in self.calls_in(info):
+                if tgt is None:
+                    continue
+                got, sub_ok = self._reaches(tgt, predicate, depth + 1,
+                                            seen)
+                if got is not None:
+                    result = (got[0], (info.qualname,) + got[1])
+                    self._reach_cache[info.key] = result
+                    return result, True
+                complete = complete and sub_ok
+        finally:
+            seen.discard(info.key)
+        if complete:
+            self._reach_cache[info.key] = None
+        return None, complete
+
+
+# --------------------------------------------------------------------- #
+# Shared per-run graph + single-module views
+# --------------------------------------------------------------------- #
+_MEMO: dict = {}
+_MEMO_CAP = 8
+
+
+def get_repo_graph(mods: Dict[str, LintModule]) -> RepoGraph:
+    """One :class:`RepoGraph` per distinct module set: the runner hands
+    every interprocedural rule the same :class:`LintModule` objects, so
+    all of them share one build per run."""
+    key = tuple(sorted((rel, id(m)) for rel, m in mods.items()))
+    graph = _MEMO.get(key)
+    if graph is None:
+        if len(_MEMO) >= _MEMO_CAP:
+            _MEMO.clear()
+        graph = RepoGraph(mods)
+        _MEMO[key] = graph
+    return graph
+
+
+def module_view(mod: LintModule) -> RepoGraph:
+    """A single-module graph: the same resolver limited to one file —
+    what the GL001/GL003 retrofits use during per-file ``check`` (their
+    one-helper-call-away gap is a same-module gap in practice; imports
+    resolve to nothing here and stay honestly unresolved)."""
+    return get_repo_graph({mod.relpath: mod})
+
+
+def neighbor_files(mods: Dict[str, LintModule],
+                   changed: Set[str]) -> Set[str]:
+    """``--changed`` expansion: files with a RESOLVED call edge into or
+    out of any changed file (one hop). A caller of an edited helper is
+    exactly as suspect as the edit."""
+    graph = get_repo_graph(mods)
+    out: Set[str] = set()
+    for fn in graph.iter_functions():
+        for _call, tgt in graph.calls_in(fn):
+            if tgt is None:
+                continue
+            if fn.relpath in changed and tgt.relpath not in changed:
+                out.add(tgt.relpath)
+            elif tgt.relpath in changed and fn.relpath not in changed:
+                out.add(fn.relpath)
+    return out

@@ -1,5 +1,8 @@
 """Rule registry: ALL_RULES is the suite ``python -m tools.graftlint``
-runs. Order is the reporting order inside a line tie."""
+runs. Order is the reporting order inside a line tie. GL001-GL007 are
+single-file AST walks (GL001/GL003 resolve same-module helpers through
+the call graph since ISSUE 10); GL008-GL011 run on the whole-repo
+interprocedural engine (tools/graftlint/graph.py + flow.py)."""
 
 from .gl001_donation import DonationAfterUse
 from .gl002_locks import LockDiscipline
@@ -8,6 +11,10 @@ from .gl004_hostsync import HostSyncInHotPath
 from .gl005_obsgate import ObsZeroOverhead
 from .gl006_atomic import AtomicCommitDiscipline
 from .gl007_faults import FaultHookPurity
+from .gl008_deadline import DeadlineBudget
+from .gl009_blocklock import BlockingUnderLock
+from .gl010_lifecycle import ResourceLifecycle
+from .gl011_codec import WireCodecSymmetry
 
 ALL_RULES = (
     DonationAfterUse(),
@@ -17,6 +24,10 @@ ALL_RULES = (
     ObsZeroOverhead(),
     AtomicCommitDiscipline(),
     FaultHookPurity(),
+    DeadlineBudget(),
+    BlockingUnderLock(),
+    ResourceLifecycle(),
+    WireCodecSymmetry(),
 )
 
 RULE_DOCS = {r.id: r.title for r in ALL_RULES}

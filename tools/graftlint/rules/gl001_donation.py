@@ -26,6 +26,15 @@ rule finds, per module:
 Linear-by-line within one function body: control flow is not modeled,
 which is exactly the right paranoia level for buffers whose liveness
 must be obvious to a reviewer anyway.
+
+ISSUE 10 retrofit — the one-helper-call-away gap: a donated
+``self.X`` read inside a helper METHOD called after the donating
+dispatch (``self._publish()`` whose body loads ``self._summary``) used
+to be invisible because the call site shows no load of the name. The
+module-level call graph (:func:`tools.graftlint.graph.module_view`)
+now resolves ``self.method`` calls and checks the callee's
+``self``-attribute loads — one call level, same-module, honest
+unresolved bucket beyond that.
 """
 
 from __future__ import annotations
@@ -34,6 +43,8 @@ import ast
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from ..core import Finding, LintModule, Rule, call_name, dotted
+from ..flow import summarize
+from ..graph import module_view
 
 
 def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
@@ -212,6 +223,40 @@ class DonationAfterUse(Rule):
                         f"TPU/GPU; copy before donating or rebind "
                         f"from the call result",
                     )
+                elif name.startswith("self.") and name.count(".") == 1:
+                    yield from self._helper_reads(
+                        mod, fn, name, cname, end, stores)
+
+    def _helper_reads(self, mod: LintModule, fn, name: str,
+                      cname: str, end: int, stores
+                      ) -> Iterator[Finding]:
+        """The retrofit: a donated ``self.X`` loaded inside a helper
+        method called after the dispatch, with no intervening rebind
+        of ``self.X`` before the helper call."""
+        view = module_view(mod)
+        attr = name.split(".", 1)[1]
+        owner = view.owner_of(fn)
+        if owner is None:
+            return
+        for call, target in view.calls_in(owner):
+            line = getattr(call, "lineno", 0)
+            if line <= end or target is None:
+                continue
+            killed = any(s == name and end < ln < line
+                         for s, ln in stores)
+            if killed:
+                continue
+            tsum = summarize(view, target)
+            if attr in tsum.self_attr_loads:
+                yield mod.finding(
+                    "GL001", call,
+                    f"'{name}' was donated to '{cname}' "
+                    f"(donate_argnums) and '{target.qualname}' "
+                    f"called afterwards reads it — the dispatch "
+                    f"invalidates the buffer on TPU/GPU; copy "
+                    f"before donating or rebind before the call",
+                )
+                return
 
     @staticmethod
     def _arg_names(arg: ast.AST) -> Set[str]:

@@ -33,9 +33,11 @@ remains fine without a count.
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, Optional
 
 from ..core import Finding, LintModule, Rule, last_attr, dotted
+from ..flow import summarize
+from ..graph import module_view
 
 _BROAD = {"Exception", "BaseException"}
 
@@ -52,12 +54,20 @@ THREADED_SOCKET_MODULES = (
 _EVIDENCE_CALLS = {"counter", "gauge", "histogram", "record_rejection"}
 
 
-def _leaves_evidence(handler: ast.ExceptHandler) -> bool:
+def _leaves_evidence(handler: ast.ExceptHandler,
+                     mod: Optional[LintModule] = None) -> bool:
     """True when the handler body re-raises or makes a registry call.
     The factory is matched by its TERMINAL attribute so the dominant
     idiom ``get_registry().counter(...).inc()`` is seen too (the
     intermediate Call breaks a plain dotted-name lookup — the same
-    shape GL005's mutation matcher handles)."""
+    shape GL005's mutation matcher handles).
+
+    ISSUE 10 retrofit: evidence one helper call away counts — a
+    handler calling ``self._count_swallow(...)`` whose body counts or
+    re-raises used to read as uncounted (a false positive the
+    module-level call graph now resolves). Unresolved calls stay
+    non-evidence: silence about the HELPER, strictness about the
+    handler."""
     for stmt in handler.body:
         for node in ast.walk(stmt):
             if isinstance(node, ast.Raise):
@@ -69,6 +79,19 @@ def _leaves_evidence(handler: ast.ExceptHandler) -> bool:
             else:
                 fname = last_attr(dotted(node.func))
             if fname in _EVIDENCE_CALLS:
+                return True
+    if mod is None:
+        return False
+    view = module_view(mod)
+    enclosing = mod.enclosing_function(handler)
+    owner = None if enclosing is None else view.owner_of(enclosing)
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            target = view.resolve_call(mod, node, owner)
+            if target is not None and \
+                    summarize(view, target).evidence:
                 return True
     return False
 
@@ -118,7 +141,7 @@ class SilentSwallow(Rule):
                     f"event (e.g. counter('...swallowed', site=...)) "
                     f"or classify via resilience/errors.py",
                 )
-            elif socket_scope and not _leaves_evidence(node):
+            elif socket_scope and not _leaves_evidence(node, mod):
                 # check #2: threaded socket code — doing "something"
                 # (closing the connection, breaking the loop) is not
                 # evidence; the wire fault must be counted or re-raised

@@ -1,0 +1,253 @@
+"""GL008 — deadline-budget propagation.
+
+The shipped bugs: PR 8's hardening had to fix BOTH halves of this class
+by hand — the RPC client's resubmit originally shipped the ORIGINAL
+``deadline_s`` after an outage ("resubmit must ship the REMAINING
+budget"), and overloaded-retry sleeps had to be deadline-clamped
+(``RetryPolicy.delay_before`` exists because ``delay_s`` alone sleeps a
+would-be answer straight into ``DeadlineExceeded``). The invariant: a
+deadline/timeout parameter names a TOTAL budget; once any of it has
+been spent, forwarding or spending the original raw value grants time
+the caller no longer has.
+
+Three checks over every function with a deadline-ish parameter
+(:data:`~tools.graftlint.flow.DEADLINE_PARAMS`), each requiring the
+parameter to be RAW at the use (never rebound in the body — a clamp,
+``min``/``max``, or remaining-recompute rebind silences the rule):
+
+1. **forward-after-spend**: the raw parameter is forwarded — as a
+   deadline-named keyword, positionally into a RESOLVED callee whose
+   parameter there is deadline-named (the call graph supplies the
+   name), or stored under a deadline wire key (``doc["deadline_s"] =
+   p``) — lexically AFTER a time-passing operation (sleep, wait, join,
+   socket wait, timed ``.result``/``.close``).
+2. **spend-in-loop**: the raw parameter is itself spent
+   (``.join(p)``/``.wait(p)``/``.result(p)``/``time.sleep(p)``) inside
+   a loop, or after an earlier spend — N sequential waits of the full
+   budget wait N× what the caller asked for (the
+   ``close(timeout)``-joins-three-threads shape).
+3. **unclamped retry delay**: a retry delay built from
+   ``delay_s``/``exp_backoff`` is slept/waited in a function that HAS a
+   deadline budget in scope — ``RetryPolicy.delay_before(attempt,
+   remaining)`` is the clamped form this repo already owns.
+
+Forwarding the same raw deadline to N calls with NO time passing
+between them is deliberately CLEAN (a wire batch's queries all share
+one deadline — that is correct semantics, not budget reuse).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from ..core import Finding, LintModule, Rule, call_name, last_attr
+from ..flow import (
+    DEADLINE_KEYS,
+    DEADLINE_PARAMS,
+    SPEND_ATTRS,
+    summarize,
+    time_passing_kind,
+)
+from ..graph import FunctionInfo, get_repo_graph
+
+#: retry-delay producers that do NOT clamp to a remaining budget
+_UNCLAMPED_DELAY = frozenset({"delay_s", "exp_backoff"})
+
+
+def _raw_param_args(call: ast.Call, params) -> List[Tuple[str, str]]:
+    """(param, how) uses of raw deadline params in one call's args:
+    how is 'pos<i>' or 'kw:<name>'."""
+    out = []
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Name) and a.id in params:
+            out.append((a.id, f"pos{i}"))
+    for kw in call.keywords:
+        if kw.arg is not None and isinstance(kw.value, ast.Name) and \
+                kw.value.id in params:
+            out.append((kw.value.id, f"kw:{kw.arg}"))
+    return out
+
+
+class DeadlineBudget(Rule):
+    id = "GL008"
+    title = "deadline/timeout budget forwarded or re-spent un-clamped"
+
+    def __init__(self):
+        self._mods = {}
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        self._mods[mod.relpath] = mod
+        return iter(())
+
+    def reset(self) -> None:
+        self._mods = {}
+
+    def finalize(self) -> Iterator[Finding]:
+        graph = get_repo_graph(self._mods)
+        for info in graph.iter_functions():
+            yield from self._check_function(graph, info)
+
+    # ------------------------------------------------------------------ #
+    def _check_function(self, graph, info: FunctionInfo
+                        ) -> Iterator[Finding]:
+        s = summarize(graph, info)
+        params = [p for p in s.deadline_params()
+                  if s.param_is_raw_at(p)]
+        if not params and not s.deadline_params():
+            return
+        mod = info.mod
+        pset = set(params)
+        # time-passing nodes, in source order
+        passing = [(n.lineno, kind, n) for kind, n in s.time_passing]
+        passing.sort(key=lambda t: t[0])
+        loops = [n for n in ast.walk(info.node)
+                 if isinstance(n, (ast.For, ast.While, ast.ListComp,
+                                   ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp))]
+        loop_members = {id(loop): set(ast.walk(loop)) for loop in loops}
+
+        if pset:
+            yield from self._check_forwards(
+                graph, info, mod, s, pset, passing, loops, loop_members)
+        yield from self._check_retry_delay(mod, info, s)
+
+    def _check_forwards(self, graph, info, mod, s, pset, passing,
+                        loops, loop_members) -> Iterator[Finding]:
+        spends_seen: List[int] = []  # lines of raw-param spends
+        events: List[Tuple[int, str, str, ast.Call, bool]] = []
+        for call, _name in s.calls:
+            for param, how in _raw_param_args(call, pset):
+                fwd = self._forward_kind(graph, info, call, how)
+                spend = self._spend_kind(call, param)
+                if fwd is None and spend is None:
+                    continue
+                events.append((call.lineno, param,
+                               fwd if fwd is not None else spend,
+                               call, spend is not None))
+        # dict stores under a deadline wire key count as forwards
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in pset:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) and \
+                            isinstance(tgt.slice, ast.Constant) and \
+                            tgt.slice.value in DEADLINE_KEYS:
+                        events.append((
+                            node.lineno, node.value.id,
+                            f'wire key "{tgt.slice.value}"', node,
+                            False,
+                        ))
+        events.sort(key=lambda e: e[0])
+        for line, param, what, node, is_spend in events:
+            prior_pass = next(
+                (k for ln, k, n in passing
+                 if ln < line and n is not node), None)
+            prior_spend = any(ln < line for ln in spends_seen)
+            in_spending_loop = False
+            if is_spend:
+                spends_seen.append(line)
+                # a spend inside ANY loop re-spends per iteration
+                in_spending_loop = any(
+                    node in loop_members[id(loop)] for loop in loops
+                )
+            else:
+                # a forward only trips inside a loop that also passes
+                # time (the N-queries-one-deadline shape stays clean)
+                for loop in loops:
+                    if node not in loop_members[id(loop)]:
+                        continue
+                    if any(n in loop_members[id(loop)] and n is not node
+                           for _ln, _k, n in passing):
+                        in_spending_loop = True
+                        break
+            if prior_pass is None and not prior_spend \
+                    and not in_spending_loop:
+                continue
+            why = (
+                f"inside a loop that spends it"
+                if in_spending_loop and prior_pass is None
+                else f"after '{prior_pass or 'an earlier spend'}' "
+                     f"already spent part of it"
+            )
+            verb = "re-spends" if is_spend else "forwards"
+            yield mod.finding(
+                "GL008", node,
+                f"'{info.qualname}' {verb} its raw '{param}' budget "
+                f"({what}) {why} — compute the REMAINING budget "
+                f"(deadline = now + {param} once, then remaining per "
+                f"use) instead of granting the full original",
+            )
+
+    @staticmethod
+    def _forward_kind(graph, info, call: ast.Call, how: str
+                      ) -> Optional[str]:
+        """Is this argument position a deadline slot of the callee?"""
+        if how.startswith("kw:"):
+            kw = how[3:]
+            return f"keyword '{kw}'" if kw in DEADLINE_PARAMS else None
+        pos = int(how[3:])
+        target = graph.resolve_call(info.mod, call, info)
+        if target is None:
+            return None
+        params = list(target.params)
+        if params and params[0] == "self" and isinstance(
+                call.func, ast.Attribute):
+            params = params[1:]
+        if pos < len(params) and params[pos] in DEADLINE_PARAMS:
+            return f"into '{target.qualname}({params[pos]}=...)'"
+        return None
+
+    @staticmethod
+    def _spend_kind(call: ast.Call, param: str) -> Optional[str]:
+        """time.sleep(p) / X.join(p) / X.wait(p) / X.result(p):
+        the raw budget is consumed by this very call."""
+        if not call.args or not (
+                isinstance(call.args[0], ast.Name)
+                and call.args[0].id == param):
+            return None
+        name = call_name(call)
+        if name in ("time.sleep", "sleep"):
+            return "time.sleep"
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in SPEND_ATTRS:
+            if time_passing_kind(call) is None:
+                return None
+            return f".{call.func.attr}()"
+        return None
+
+    # ------------------------------------------------------------------ #
+    def _check_retry_delay(self, mod, info, s) -> Iterator[Finding]:
+        """Check 3: sleeping an unclamped retry delay while a deadline
+        budget is in scope."""
+        if not s.deadline_params():
+            return
+        delay_vars = set()
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call) and \
+                        last_attr(call_name(sub)) in _UNCLAMPED_DELAY:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            delay_vars.add(tgt.id)
+        if not delay_vars:
+            return
+        for call, name in s.calls:
+            is_sleep = name in ("time.sleep", "sleep")
+            is_wait = isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "wait"
+            if not (is_sleep or is_wait) or not call.args:
+                continue
+            a0 = call.args[0]
+            if isinstance(a0, ast.Name) and a0.id in delay_vars:
+                yield mod.finding(
+                    "GL008", call,
+                    f"'{info.qualname}' sleeps a retry delay from "
+                    f"delay_s/exp_backoff while holding a deadline "
+                    f"budget — clamp it to the remaining budget "
+                    f"(RetryPolicy.delay_before) so the retry loop "
+                    f"cannot sleep past the deadline",
+                )

@@ -1,0 +1,207 @@
+"""GL009 — blocking call while holding a lock.
+
+The shipped bugs: PR 8's socket teardown originally hung close() for
+5-10s because a blocking socket read was reachable while the closer
+held state locks (fixed with shutdown-before-close + accept-timeout
+polling), and PR 5's FailoverServer held its promotion lock through
+waits that every ``submit``/``active`` caller then queued behind. The
+invariant: inside a ``with self._lock:`` region, nothing may block the
+thread — every other thread touching that lock inherits the wait.
+
+Two layers:
+
+1. **Direct**: a blocking call (:func:`tools.graftlint.flow.blocking_kind`:
+   ``time.sleep``, socket ``send/sendall/recv/accept/connect``,
+   ``open``, thread ``.join``, UNTIMED ``.get()``/``.wait()``)
+   lexically inside a with-lock region. ``Condition.wait(timeout)`` is
+   exempt by construction (timed, and it RELEASES the condition's own
+   lock — that is the idiom).
+2. **Transitive**: a call inside the region that RESOLVES (call graph)
+   to a function reaching a blocking op through further resolved calls
+   (depth-capped). Unresolved callees are skipped — silence over
+   guessing; the honest limit the README documents.
+
+The same pass extends GL002's acquisition-order graph ACROSS calls: a
+with-lock(A) region whose resolved callee (transitively) acquires
+lock B contributes an A→B edge the lexical scan cannot see; a cycle
+containing at least one such call-mediated edge is reported here (GL002
+keeps reporting purely lexical cycles, so no finding is doubled).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, LintModule, Rule
+from ..flow import _nested_nodes, blocking_kind, summarize
+from ..graph import FunctionInfo, RepoGraph, get_repo_graph
+
+#: transitive-reach depth cap for lock-order edge harvesting
+_EDGE_DEPTH = 4
+
+
+class BlockingUnderLock(Rule):
+    id = "GL009"
+    title = "blocking call while holding a lock / call-mediated lock-order cycle"
+
+    def __init__(self):
+        self._mods: Dict[str, LintModule] = {}
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        self._mods[mod.relpath] = mod
+        return iter(())
+
+    def reset(self) -> None:
+        self._mods = {}
+
+    def finalize(self) -> Iterator[Finding]:
+        graph = get_repo_graph(self._mods)
+        # (edge, mod, node, call-mediated?) across the whole scan
+        edges: List[Tuple[Tuple[str, str], LintModule, ast.AST, bool]] \
+            = []
+        for info in graph.iter_functions():
+            yield from self._check_function(graph, info, edges)
+        yield from self._order_findings(edges)
+
+    # ------------------------------------------------------------------ #
+    def _check_function(self, graph: RepoGraph, info: FunctionInfo,
+                        edges) -> Iterator[Finding]:
+        s = summarize(graph, info)
+        if not s.lock_acquires:
+            return
+        mod = info.mod
+        # a nested def's body under the with-lock does NOT run while
+        # the lock is held — only its definition does (same exclusion
+        # the flow summaries make)
+        nested = _nested_nodes(info.node)
+        for lock, region in s.lock_acquires:
+            members = set(ast.walk(region)) - nested
+            # the region body only: a nested with-lock is its own region
+            for node in ast.walk(region):
+                if node is region or node in nested or \
+                        not isinstance(node, ast.Call):
+                    continue
+                kind = blocking_kind(node)
+                if kind is not None:
+                    yield mod.finding(
+                        "GL009", node,
+                        f"'{kind}' inside 'with {lock}:' in "
+                        f"'{info.qualname}' blocks every thread "
+                        f"waiting on the lock — move the blocking "
+                        f"work outside the locked region",
+                    )
+                    continue
+                target = graph.resolve_call(mod, node, info)
+                if target is None or target.key == info.key:
+                    continue
+                got = self._reaches_blocking(graph, target)
+                if got is not None:
+                    op, chain = got
+                    yield mod.finding(
+                        "GL009", node,
+                        f"call to '{target.qualname}' inside "
+                        f"'with {lock}:' in '{info.qualname}' reaches "
+                        f"blocking '{op}' (via "
+                        f"{' -> '.join(chain)}) — every thread "
+                        f"waiting on the lock inherits that wait",
+                    )
+                # lock-order edges through the call (depth-capped)
+                for inner in self._locks_reached(graph, target,
+                                                 _EDGE_DEPTH):
+                    if inner != lock:
+                        edges.append(((lock, inner), mod, node, True))
+            # lexical edges feed the same graph so call-mediated
+            # cycles that close through a lexical half are seen
+            for inner_node in members:
+                if inner_node is region or not isinstance(
+                        inner_node, (ast.With, ast.AsyncWith)):
+                    continue
+                for sl, wn in s.lock_acquires:
+                    if wn is inner_node and sl != lock:
+                        edges.append(((lock, sl), mod, inner_node,
+                                      False))
+
+    @staticmethod
+    def _reaches_blocking(graph: RepoGraph, target: FunctionInfo
+                          ) -> Optional[Tuple[str, Tuple[str, ...]]]:
+        def pred(fi: FunctionInfo) -> Optional[str]:
+            fs = summarize(graph, fi)
+            return fs.blocking[0][0] if fs.blocking else None
+
+        return graph.reaches(target, pred)
+
+    @staticmethod
+    def _locks_reached(graph: RepoGraph, target: FunctionInfo,
+                       depth: int,
+                       _seen: Optional[Set] = None) -> Set[str]:
+        if depth <= 0:
+            return set()
+        if _seen is None:
+            _seen = set()
+        if target.key in _seen:
+            return set()
+        _seen.add(target.key)
+        s = summarize(graph, target)
+        out = {lock for lock, _n in s.lock_acquires}
+        for call, tgt in graph.calls_in(target):
+            if tgt is not None:
+                out |= BlockingUnderLock._locks_reached(
+                    graph, tgt, depth - 1, _seen)
+        return out
+
+    # ------------------------------------------------------------------ #
+    def _order_findings(self, edges) -> Iterator[Finding]:
+        """Cycles in the combined (lexical + call-mediated) graph that
+        include at least one call-mediated edge — purely lexical cycles
+        stay GL002's finding."""
+        graph: Dict[str, Set[str]] = {}
+        mediated: Set[Tuple[str, str]] = set()
+        for (a, b), _mod, _node, via_call in edges:
+            graph.setdefault(a, set()).add(b)
+            if via_call:
+                mediated.add((a, b))
+        cyc = _find_cycle(graph)
+        if cyc is None:
+            return
+        cyc_edges = set(zip(cyc, cyc[1:]))
+        if not (cyc_edges & mediated):
+            return
+        for (a, b), mod, node, via_call in edges:
+            if (a, b) in cyc_edges and via_call:
+                yield mod.finding(
+                    "GL009", node,
+                    f"call-mediated lock-order cycle: "
+                    + " -> ".join(cyc)
+                    + " (this call acquires the inner lock through "
+                    "the call graph; pick ONE global order)",
+                )
+
+
+def _find_cycle(graph: Dict[str, Set[str]]) -> Optional[List[str]]:
+    """Any one cycle as [a, b, ..., a], else None (same walk as
+    GL002's, over the combined edge set)."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color.get(m, WHITE) == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color.get(m, WHITE) == WHITE:
+                got = dfs(m)
+                if got is not None:
+                    return got
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got is not None:
+                return got
+    return None

@@ -1,0 +1,291 @@
+"""GL010 — resource lifecycle: exception edges must not leak handles.
+
+The shipped bugs: PR 5's chaos driver leaked one log fd per worker
+spawn (the ``Popen`` between ``open`` and ``close`` raised past both),
+and PR 9's trace sink stayed attached — with tracing globally enabled —
+after a failed replica spawn because the enable ran before the ``try``.
+The invariant: a locally-acquired resource (socket, file handle,
+``ShardSink``, ``Popen``, non-daemon thread) must be released on EVERY
+path out of the function, not just the straight-line one.
+
+Per function, every acquisition bound to a local name is classified:
+
+- **clean shapes**: the ``with`` statement; release
+  (``close``/``join``/``kill``/``terminate``/``wait``) inside a
+  ``finally`` or ``except`` of a try opened at/after the acquisition;
+  ownership handoff — stored to a field/container, passed to another
+  call, or returned (the new owner's lifecycle, not this frame's).
+- **findings**: no release on any path; or a release that only sits on
+  the straight-line path with at least one call between acquisition
+  and release — that call's exception edge escapes with the handle
+  open (exactly the per-spawn fd shape).
+- **socket-specific**: configuration calls on the socket itself
+  (``settimeout``/``setsockopt``) between acquisition and handoff,
+  outside any try — an immediately-reset peer raises ``OSError`` there,
+  leaking the socket AND killing the accept/connect thread.
+- **chained** ``open(...).read()``: the handle is never named at all —
+  it closes only when the refcounter gets around to it; use ``with``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+from ..core import Finding, LintModule, Rule, call_name, dotted
+
+#: acquisition call names -> resource kind
+_CTORS = {
+    "open": "file handle",
+    "socket.socket": "socket",
+    "_socket.socket": "socket",
+    "socket.create_connection": "socket",
+    "_socket.create_connection": "socket",
+    "create_connection": "socket",
+    "ShardSink": "ShardSink",
+    "subprocess.Popen": "subprocess",
+    "Popen": "subprocess",
+}
+
+_RELEASE_ATTRS = frozenset({
+    "close", "join", "kill", "terminate", "wait", "shutdown",
+})
+
+_SOCKET_CONFIG_ATTRS = frozenset({"settimeout", "setsockopt",
+                                  "setblocking"})
+
+
+def _acquisition_in(value: ast.AST) -> Optional[Tuple[ast.Call, str]]:
+    """The resource-acquiring call inside an assignment value (walks
+    through IfExp/BoolOp wrappers), with its kind."""
+    for node in ast.walk(value):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        kind = _CTORS.get(name) if name else None
+        if kind == "subprocess" and name == "Popen" or name in _CTORS:
+            return node, _CTORS[name]
+        # thread: only non-daemon locals are lifecycle-tracked
+        if name in ("threading.Thread", "Thread"):
+            if not any(kw.arg == "daemon" and isinstance(
+                    kw.value, ast.Constant) and kw.value.value
+                    for kw in node.keywords):
+                return node, "thread"
+    return None
+
+
+def _accept_acquisition(value: ast.AST) -> Optional[ast.Call]:
+    """``X.accept()`` — returns (socket, addr)."""
+    if isinstance(value, ast.Call) and \
+            isinstance(value.func, ast.Attribute) and \
+            value.func.attr == "accept" and not value.args:
+        return value
+    return None
+
+
+class ResourceLifecycle(Rule):
+    id = "GL010"
+    title = "resource leaked past an exception edge"
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(mod, fn)
+        yield from self._check_chained_opens(mod)
+
+    # ------------------------------------------------------------------ #
+    def _check_chained_opens(self, mod: LintModule) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Call) and \
+                    call_name(node.value) == "open":
+                yield mod.finding(
+                    "GL010", node.value,
+                    "open(...) used without binding the handle — it "
+                    "closes only when the refcounter collects it; "
+                    "use 'with open(...) as f:'",
+                )
+
+    # ------------------------------------------------------------------ #
+    def _check_function(self, mod: LintModule, fn) -> Iterator[Finding]:
+        nested = {
+            n for sub in ast.walk(fn)
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and sub is not fn
+            for n in ast.walk(sub)
+        }
+        acquisitions: List[Tuple[str, str, ast.stmt, ast.Call]] = []
+        for node in ast.walk(fn):
+            if node in nested or not isinstance(node, ast.Assign):
+                continue
+            got = _acquisition_in(node.value)
+            name = None
+            if got is not None:
+                call, kind = got
+            else:
+                call = _accept_acquisition(node.value)
+                kind = "socket"
+                if call is not None and len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Tuple) and \
+                        node.targets[0].elts and \
+                        isinstance(node.targets[0].elts[0], ast.Name):
+                    name = node.targets[0].elts[0].id
+            if call is None:
+                continue
+            if name is None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        name = tgt.id
+                        break
+                    if isinstance(tgt, ast.Attribute):
+                        name = None  # self.x = open(...): field-owned
+                        break
+            if name is not None:
+                acquisitions.append((name, kind, node, call))
+        for name, kind, stmt, call in acquisitions:
+            yield from self._check_acquisition(
+                mod, fn, nested, name, kind, stmt, call)
+
+    def _check_acquisition(self, mod, fn, nested, name, kind, stmt,
+                           call) -> Iterator[Finding]:
+        start = getattr(stmt, "end_lineno", stmt.lineno)
+        uses: List[ast.AST] = []
+        release_nodes: List[ast.Call] = []
+        handoff_line: Optional[int] = None
+        config_calls: List[ast.Call] = []
+        risky_lines: List[int] = []
+        in_stmt = set(ast.walk(stmt))
+        for node in ast.walk(fn):
+            if node in nested or node in in_stmt:
+                continue
+            line = getattr(node, "lineno", 0)
+            if line <= start and not isinstance(node, ast.With):
+                continue
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Name) and ce.id == name:
+                        return  # managed by `with`
+            elif isinstance(node, ast.Return) and \
+                    node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        handoff_line = min(handoff_line or line, line)
+            elif isinstance(node, ast.Assign):
+                tgt_names = [dotted(t) for t in node.targets]
+                if isinstance(node.value, ast.Name) and \
+                        node.value.id == name and any(
+                            t and ("." in t or "[" not in t)
+                            for t in tgt_names if t):
+                    # stored somewhere (field / other name): handoff
+                    for t in tgt_names:
+                        if t and "." in t:
+                            handoff_line = min(handoff_line or line,
+                                               line)
+            elif isinstance(node, ast.Call):
+                fname = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else None
+                recv = dotted(node.func.value) if isinstance(
+                    node.func, ast.Attribute) else None
+                if recv == name and fname in _RELEASE_ATTRS:
+                    release_nodes.append(node)
+                    continue
+                if recv == name and fname in _SOCKET_CONFIG_ATTRS:
+                    config_calls.append(node)
+                    risky_lines.append(line)
+                    continue
+                # POSITIONAL args transfer ownership (`Wire(sock)`,
+                # `add_sink(sink)`); a KEYWORD pass (`Popen(stdout=
+                # logf)`) is usage — the caller still owns the handle,
+                # and the call can raise past it (the PR 5 per-spawn
+                # fd leak was exactly this shape)
+                arg_hit = any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in node.args
+                )
+                if arg_hit:
+                    handoff_line = min(handoff_line or line, line)
+                else:
+                    risky_lines.append(line)
+            uses.append(node)
+        yield from self._verdict(mod, fn, name, kind, call, start,
+                                 release_nodes, handoff_line,
+                                 config_calls, risky_lines)
+
+    def _verdict(self, mod, fn, name, kind, call, start, release_nodes,
+                 handoff_line, config_calls, risky_lines
+                 ) -> Iterator[Finding]:
+        guarded_release = [
+            n for n in release_nodes if self._in_cleanup(mod, n, start)
+        ]
+        if guarded_release:
+            return  # released in a finally/except: every edge covered
+        first_release = min(
+            (n.lineno for n in release_nodes), default=None)
+        bound = first_release if first_release is not None \
+            else handoff_line
+        if bound is not None:
+            # socket config between acquisition and release/handoff,
+            # with no cleanup guard: an OSError there leaks the socket
+            if kind == "socket":
+                exposed = [c for c in config_calls
+                           if c.lineno < bound
+                           and not self._in_cleanup(mod, c, start,
+                                                    any_try=True)]
+                if exposed:
+                    yield mod.finding(
+                        "GL010", exposed[0],
+                        f"'{name}' ({kind}) is configured "
+                        f"(settimeout/setsockopt) outside any "
+                        f"try before its handoff — an "
+                        f"immediately-reset peer raises OSError "
+                        f"here, leaking the socket and killing "
+                        f"this thread; guard and close on error",
+                    )
+                return
+            if handoff_line is not None and \
+                    handoff_line <= (first_release or handoff_line):
+                return  # handed off before anything risky matters
+            risky = [ln for ln in risky_lines
+                     if start < ln < (first_release or 0)]
+            if risky:
+                yield mod.finding(
+                    "GL010", call,
+                    f"'{name}' ({kind}) in '{mod.symbol(call)}' is "
+                    f"released only on the straight-line path — "
+                    f"{len(risky)} call(s) between acquisition and "
+                    f"release can raise and leak it; use try/finally "
+                    f"or a with block",
+                )
+            return
+        if handoff_line is not None:
+            return
+        yield mod.finding(
+            "GL010", call,
+            f"'{name}' ({kind}) in '{mod.symbol(call)}' is never "
+            f"released and never handed off — close/join it (or hand "
+            f"ownership to a field, container, or caller)",
+        )
+
+    @staticmethod
+    def _in_cleanup(mod: LintModule, node: ast.AST, acq_line: int,
+                    any_try: bool = False) -> bool:
+        """Is ``node`` inside a ``finally``/``except`` (or, with
+        ``any_try``, anywhere under a try) of a Try statement that
+        begins at-or-after the acquisition region?"""
+        child = node
+        for anc in mod.ancestors(node):
+            if isinstance(anc, ast.Try):
+                if any_try:
+                    return True
+                if child in anc.finalbody:
+                    return True
+                if any(child in h.body or child is h
+                       for h in anc.handlers):
+                    return True
+            if isinstance(anc, ast.ExceptHandler):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            child = anc
+        return False

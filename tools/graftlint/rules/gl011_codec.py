@@ -1,0 +1,232 @@
+"""GL011 — wire-codec symmetry.
+
+The shipped contract: PR 9's ``tc`` trace-context codec got its
+symmetry BY HAND — every key ``TraceContext.to_wire`` writes is read
+(tolerantly) by ``from_wire``, and ``from_wire`` reads nothing the
+writer never sends. Nothing enforced that; the next codec (rendezvous
+records, heartbeat leases, mirrored snapshots, flight dumps — the repo
+grows one per PR) only keeps the property while reviewers remember it.
+The invariant:
+
+- every constant key a paired encoder WRITES must be READ — strictly
+  or tolerantly — by its decoder (or by a decoder's direct caller when
+  the decoder returns the decoded doc whole: one call level through
+  the graph, the flow layer's propagation rule);
+- every key the decoder reads STRICTLY (``doc["k"]``, a KeyError on
+  absence) must be a key the encoder writes; tolerant reads
+  (``doc.get("k")``, ``"k" in doc``) accept anything by design.
+
+Pairing is deliberately conservative (an unpaired codec is silent, the
+unresolved bucket):
+
+- name symmetry in one class: ``to_wire``/``from_wire``,
+  ``write``/``read``, ``dump``/``load``, ``encode``/``decode``,
+  ``pack``/``unpack``, ``save``/``load``;
+- module-level prefix pairs: ``encode_X``/``decode_X``,
+  ``pack_X``/``unpack_X``, ``save_X``/``load_X``, ``write_X``/
+  ``read_X``, and class-method-to-function ``dump``/``read_dump``;
+- shared-anchor pairs: an encoding and a decoding function in one
+  module that both call the same module-local ``*path*`` helper or
+  reference the same ALL_CAPS constant (the ``_snap_path`` /
+  ``HEARTBEAT_NAME`` shape) — only when that anchor pairs exactly one
+  encoder with one decoder.
+
+A decoder whose doc escapes BEYOND one call level (passed onward
+whole) is treated as tolerant-of-everything: the rule cannot see the
+real readers and says nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, LintModule, Rule
+from ..flow import summarize
+from ..graph import FunctionInfo, RepoGraph, get_repo_graph
+
+#: same-class method name pairs (writer, reader)
+_CLASS_PAIRS = (
+    ("to_wire", "from_wire"),
+    ("write", "read"),
+    ("dump", "load"),
+    ("encode", "decode"),
+    ("pack", "unpack"),
+    ("save", "load"),
+)
+
+#: module-level prefix pairs (writer prefix, reader prefix)
+_PREFIX_PAIRS = (
+    ("encode_", "decode_"),
+    ("pack_", "unpack_"),
+    ("save_", "load_"),
+    ("write_", "read_"),
+    ("to_", "from_"),
+)
+
+
+class WireCodecSymmetry(Rule):
+    id = "GL011"
+    title = "encoder/decoder key asymmetry in a paired wire codec"
+
+    def __init__(self):
+        self._mods: Dict[str, LintModule] = {}
+
+    def check(self, mod: LintModule) -> Iterator[Finding]:
+        self._mods[mod.relpath] = mod
+        return iter(())
+
+    def reset(self) -> None:
+        self._mods = {}
+
+    def finalize(self) -> Iterator[Finding]:
+        graph = get_repo_graph(self._mods)
+        for writer, reader in self._pairs(graph):
+            yield from self._check_pair(graph, writer, reader)
+
+    # ------------------------------------------------------------------ #
+    # Pairing
+    # ------------------------------------------------------------------ #
+    def _pairs(self, graph: RepoGraph
+               ) -> Iterator[Tuple[FunctionInfo, FunctionInfo]]:
+        seen: Set[Tuple[Tuple[str, str], Tuple[str, str]]] = set()
+
+        def emit(w: Optional[FunctionInfo], r: Optional[FunctionInfo]):
+            if w is None or r is None:
+                return ()
+            key = (w.key, r.key)
+            if key in seen:
+                return ()
+            seen.add(key)
+            return ((w, r),)
+
+        for rel in sorted(graph.classes):
+            for ci in graph.classes[rel].values():
+                for wname, rname in _CLASS_PAIRS:
+                    yield from emit(ci.methods.get(wname),
+                                    ci.methods.get(rname))
+                # class-method dump -> module-level read_dump
+                for wname in ("dump", "write"):
+                    w = ci.methods.get(wname)
+                    r = graph.functions[rel].get(f"read_{wname}")
+                    yield from emit(w, r)
+        for rel in sorted(graph.functions):
+            funcs = graph.functions[rel]
+            for name, info in funcs.items():
+                for wp, rp in _PREFIX_PAIRS:
+                    if name.startswith(wp):
+                        yield from emit(
+                            info, funcs.get(rp + name[len(wp):]))
+            yield from self._anchor_pairs(graph, rel)
+
+    def _anchor_pairs(self, graph: RepoGraph, rel: str
+                      ) -> Iterator[Tuple[FunctionInfo, FunctionInfo]]:
+        """Encoder/decoder joined by a shared module-local path helper
+        or ALL_CAPS constant — unambiguous anchors only."""
+        encoders: Dict[str, List[FunctionInfo]] = {}
+        decoders: Dict[str, List[FunctionInfo]] = {}
+        infos = list(graph.functions[rel].values())
+        for ci in graph.classes[rel].values():
+            infos.extend(ci.methods.values())
+        for info in infos:
+            s = summarize(graph, info)
+            anchors: Set[str] = set(s.const_refs)
+            for call, cname in s.calls:
+                if cname is not None and "path" in cname.lower() and \
+                        cname.split(".")[-1] in graph.functions[rel]:
+                    anchors.add(f"fn:{cname.split('.')[-1]}")
+            if not anchors:
+                continue
+            if s.encodes and s.dict_key_writes:
+                for a in anchors:
+                    encoders.setdefault(a, []).append(info)
+            if s.decodes and not s.encodes:
+                for a in anchors:
+                    decoders.setdefault(a, []).append(info)
+        for anchor, ws in sorted(encoders.items()):
+            rs = decoders.get(anchor, [])
+            if len(ws) == 1 and len(rs) == 1 and \
+                    ws[0].key != rs[0].key:
+                yield ws[0], rs[0]
+
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _result_escapes(caller: FunctionInfo,
+                        call: ast.Call) -> bool:
+        """Does the decoder-call's RESULT leave ``caller`` whole —
+        returned, or passed as an argument to another call? Reads
+        through ``.get``/subscripts do not count (they are the reads
+        the symmetry check consumes)."""
+        mod = caller.mod
+        parent = mod.parent(call)
+        if isinstance(parent, ast.Return):
+            return True
+        if isinstance(parent, ast.Call) and call in parent.args:
+            return True
+        names: set = set()
+        if isinstance(parent, ast.Assign) and parent.value is call:
+            for tgt in parent.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+        if not names:
+            return False
+        for node in ast.walk(caller.node):
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id in names:
+                return True
+            if isinstance(node, ast.Call) and node is not call:
+                for a in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(a, ast.Name) and a.id in names:
+                        return True
+        return False
+
+    # ------------------------------------------------------------------ #
+    # The symmetry check
+    # ------------------------------------------------------------------ #
+    def _check_pair(self, graph: RepoGraph, writer: FunctionInfo,
+                    reader: FunctionInfo) -> Iterator[Finding]:
+        ws = summarize(graph, writer)
+        rs = summarize(graph, reader)
+        written = dict(ws.dict_key_writes)
+        if not written:
+            return
+        strict = dict(rs.dict_key_strict_reads)
+        tolerant = set(rs.dict_key_tolerant_reads)
+        tolerant_all = rs.decoded_passed
+        if rs.decoded_returned and not tolerant_all:
+            # one call level out: the decoder hands the doc back whole;
+            # its direct callers are the real read sites
+            callers = graph.callers_of(reader)
+            if not callers:
+                tolerant_all = True  # nobody visible reads it: silence
+            for caller, call in callers:
+                cs = summarize(graph, caller)
+                # the caller's strict reads are NOT symmetry
+                # obligations (they may target other dicts); they do
+                # count as evidence the key is consumed
+                tolerant |= set(cs.dict_key_strict_reads)
+                tolerant |= cs.dict_key_tolerant_reads
+                if self._result_escapes(caller, call):
+                    # the doc travels beyond one call level: the real
+                    # readers are out of reach — tolerant by silence
+                    tolerant_all = True
+        if not tolerant_all:
+            reads = set(strict) | tolerant
+            for key in sorted(set(written) - reads):
+                yield writer.mod.finding(
+                    "GL011", written[key],
+                    f"key '{key}' written by '{writer.qualname}' is "
+                    f"never read by its paired decoder "
+                    f"'{reader.qualname}' (nor one call out) — read "
+                    f"it, default it tolerantly, or stop shipping it",
+                )
+        for key in sorted(set(strict) - set(written)):  # vice versa
+            yield reader.mod.finding(
+                "GL011", strict[key],
+                f"'{reader.qualname}' reads key '{key}' strictly "
+                f"(KeyError on absence) but its paired encoder "
+                f"'{writer.qualname}' never writes it — write it or "
+                f"read it with a tolerant default",
+            )

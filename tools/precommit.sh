@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Fast pre-commit loop: graftlint on the files you touched (plus their
+# one-hop call-graph neighbors), then the ruff baseline. Mirrors the
+# blocking CI gates (tier1.yml "Static analysis") — if this passes, the
+# static-analysis step will too; the full-scan difference is only which
+# findings get REPORTED, never which are computed.
+#
+# Usage:
+#   tools/precommit.sh            # diff vs origin/main|main merge-base
+#   tools/precommit.sh <base>     # diff vs an explicit base ref
+#
+# Wire it up with:  ln -s ../../tools/precommit.sh .git/hooks/pre-commit
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+base="${1:-}"
+if [ -n "$base" ]; then
+    python -m tools.graftlint --changed "$base"
+else
+    python -m tools.graftlint --changed
+fi
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+else
+    echo "precommit: ruff not installed; skipping the ruff baseline" \
+         "(CI still runs it blocking)" >&2
+fi
