@@ -1,0 +1,963 @@
+"""Sharded parallel ingest: the million-writes path (ISSUE 11).
+
+The engine sustains multi-million-eps window dispatch, but until now
+every edge entered through ONE Python reader yielding tuples one at a
+time (``core/sources.py``). The reference distributes exactly this
+stage via Flink's keyed shuffle between its source and windowing layers
+(PAPER.md §1 L1/L2: parallel sources -> keyBy -> per-key windows). This
+module is the TPU-native equivalent, kept on the host:
+
+- :class:`ShardedEdgeSource` — N concurrent TCP connections, one per
+  shard, records partitioned by **edge-endpoint hash**
+  (:func:`shard_of`, the one partition rule the producer, the readers,
+  and the oracle tests all share — the keyed-shuffle analog). Each
+  shard's reader thread decodes, assembles **per-shard count windows**,
+  and hands closed windows over a bounded queue; the merge side yields
+  them in arrival order.
+- **GSEW binary wire format** — length-prefixed frames carrying raw
+  little-endian i32/i64 edge columns (the PR 8 ``GSRP`` frame codec is
+  the template), decoded into numpy columns by ONE native call per
+  frame (``native.decode_edge_frame``; numpy fallback without the
+  toolchain) instead of per-line ``int()``. Frames carry a
+  per-connection sequence number, so a reconnecting peer can replay
+  from any earlier point (**at-least-once**) and the reader dedupes to
+  exactly-once at frame granularity.
+- **Explicit backpressure** — each shard queue is BOUNDED
+  (:func:`~gelly_streaming_tpu.core.pipeline.bounded_put`): a slow
+  consumer blocks the reader's put, which stops ``recv``, which lets
+  TCP flow control push back on the producer. Overload degrades to
+  bounded staleness, never unbounded buffering. Evidence:
+  ``source.shard_depth{shard}`` gauge, ``source.backpressure_s``
+  counter, ``source.backpressure_stalls/resumes{shard}`` episode
+  counters (the timeline's INGEST-STALL / INGEST-RESUME story lines).
+- :class:`ShardedEdgeStream` — merges closed shard windows into the
+  existing block/superbatch execution path: per-window blocks via the
+  shared :class:`~gelly_streaming_tpu.core.window.Windower`, and
+  ``superbatches(k)`` packs K closed windows with ONE group encode and
+  zero per-window device work
+  (:meth:`~gelly_streaming_tpu.core.window.Windower.pack_window_cols`).
+
+RESILIENCE (the ``SocketEdgeSource`` contract, reused): connection
+errors reconnect with bounded exponential backoff (``reconnect``
+attempts, ``source.reconnects`` counted); a malformed byte stream —
+bad magic/version, oversized or geometry-inconsistent length, torn
+frames — is a counted ``source.malformed_frames{kind}`` plus a clean
+reconnect (framing cannot resync mid-garbage), never a dead reader
+thread. A CLEAN peer close at a frame boundary ends that shard. The
+installed :class:`~gelly_streaming_tpu.resilience.FaultPlan`'s
+``disconnect_at_record`` fires per record ordinal, dropping the whole
+in-flight frame so the peer's replay re-delivers it exactly once.
+
+``python -m gelly_streaming_tpu.core.ingest --serve ...`` is the
+serve-from-memory load-generator peer ``bench.py --ingest`` spawns:
+it synthesizes an R-MAT stream, partitions it with :func:`shard_of`,
+pre-encodes each shard's frames (or text lines), and serves each
+connection from memory.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket as _socket
+import struct
+import threading
+import time
+import warnings
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import trace as _trace
+from ..obs.registry import get_registry
+from ..resilience import faults as _faults
+from ..resilience.errors import TransientSourceError
+from ..resilience.retry import exp_backoff
+from .pipeline import bounded_put
+from .stream import SimpleEdgeStream
+
+# --------------------------------------------------------------------- #
+# GSEW wire format
+# --------------------------------------------------------------------- #
+#: frame magic (also the protocol's garbage detector)
+MAGIC = b"GSEW"
+VERSION = 1
+#: header: magic | version | flags | n_edges | payload length | sequence
+HEADER = struct.Struct("<4sBBIIQ")
+#: flags bit 0: int64 endpoint columns (else int32)
+F_WIDE = 1
+#: flags bit 1: float64 value column present
+F_VAL = 2
+#: reject frames declaring more edges than this before reading them
+MAX_FRAME_EDGES = 1 << 22
+#: reject payloads past this byte length before reading them
+DEFAULT_MAX_FRAME = 64 << 20
+
+_DONE = object()  # per-shard end-of-stream sentinel on the window queue
+
+
+class Disconnect(Exception):
+    """Peer closed at a frame boundary — the clean end of a shard."""
+
+
+class MalformedFrame(ValueError):
+    """The byte stream violated the frame contract; ``kind`` is the
+    ``source.malformed_frames{kind=...}`` label (magic/version/
+    oversized/columns/truncated)."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
+
+
+class _Stopped(Exception):
+    """Internal unwind: the source was closed mid-read."""
+
+
+def pack_edge_frame(
+    src: np.ndarray,
+    dst: np.ndarray,
+    val: Optional[np.ndarray] = None,
+    *,
+    seq: int = 0,
+    wide: Optional[bool] = None,
+) -> bytes:
+    """Encode one GSEW frame: header + raw little-endian columns
+    (src, then dst, then the optional float64 value column).
+
+    ``wide=None`` picks int32 columns when every id fits (half the
+    wire bytes — the common dense-id case), int64 otherwise. ``seq``
+    is the per-connection frame sequence number (1-based; 0 = unknown,
+    never deduped) the reader uses to drop at-least-once replays.
+    """
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    n = src.shape[0]
+    if dst.shape[0] != n:
+        raise ValueError("src/dst column lengths disagree")
+    if n > MAX_FRAME_EDGES:
+        raise ValueError(
+            f"{n} edges exceeds the {MAX_FRAME_EDGES}-edge frame bound"
+        )
+    if wide is None:
+        i32 = np.iinfo(np.int32)
+        wide = bool(n) and bool(
+            min(int(src.min()), int(dst.min())) < i32.min
+            or max(int(src.max()), int(dst.max())) > i32.max
+        )
+    # encoder and reader must agree on BOTH bounds (the GL011 ethos):
+    # a frame the encoder emits but every reader rejects as oversized
+    # would dead-loop the replay path, so reject it at pack time
+    nbytes = n * (8 if wide else 4) * 2 + (8 * n if val is not None else 0)
+    if nbytes > DEFAULT_MAX_FRAME:
+        raise ValueError(
+            f"frame payload of {nbytes} bytes exceeds the reader bound "
+            f"{DEFAULT_MAX_FRAME}; lower frame_edges (wide/val columns "
+            "cost up to 24 bytes per edge)"
+        )
+    dt = "<i8" if wide else "<i4"
+    flags = (F_WIDE if wide else 0) | (F_VAL if val is not None else 0)
+    parts = [src.astype(dt, copy=False).tobytes(),
+             dst.astype(dt, copy=False).tobytes()]
+    if val is not None:
+        val = np.ascontiguousarray(val, np.float64)
+        if val.shape[0] != n:
+            raise ValueError("val column length disagrees with src/dst")
+        parts.append(val.astype("<f8", copy=False).tobytes())
+    payload = b"".join(parts)
+    return HEADER.pack(MAGIC, VERSION, flags, n, len(payload), seq) + payload
+
+
+def decode_frame_payload(
+    payload: bytes, n_edges: int, flags: int
+) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Decode a frame payload into ``(src i64, dst i64, val f64|None)``
+    columns — one native call per frame
+    (:func:`gelly_streaming_tpu.native.decode_edge_frame`)."""
+    from .. import native as _native
+
+    try:
+        return _native.decode_edge_frame(
+            payload, n_edges, bool(flags & F_WIDE), bool(flags & F_VAL)
+        )
+    except ValueError as e:
+        raise MalformedFrame("columns", str(e)) from e
+
+
+def frame_geometry(n_edges: int, flags: int) -> int:
+    """Payload byte length the header's (n_edges, flags) pair implies."""
+    isz = 8 if flags & F_WIDE else 4
+    return n_edges * isz * 2 + (8 * n_edges if flags & F_VAL else 0)
+
+
+def read_edge_frame(
+    sock,
+    *,
+    max_edges: int = MAX_FRAME_EDGES,
+    max_frame: int = DEFAULT_MAX_FRAME,
+    stop: Optional[threading.Event] = None,
+) -> Tuple[int, int, int, bytes]:
+    """One complete frame off the socket: ``(seq, flags, n_edges,
+    payload)``. Raises :class:`Disconnect` at a clean frame boundary,
+    :class:`MalformedFrame` for everything the frame contract rejects,
+    and re-raises ``socket.timeout`` only when it struck at a boundary
+    with nothing read (an idle tick the caller may poll through)."""
+    head = _recv_exact(sock, HEADER.size, at_boundary=True, stop=stop)
+    magic, version, flags, n_edges, plen, seq = HEADER.unpack(head)
+    if magic != MAGIC:
+        raise MalformedFrame("magic", f"bad magic {magic!r}")
+    if version != VERSION:
+        raise MalformedFrame("version", f"unsupported version {version}")
+    if n_edges > max_edges or plen > max_frame:
+        raise MalformedFrame(
+            "oversized",
+            f"frame declares {n_edges} edges / {plen} payload bytes "
+            f"(bounds: {max_edges} edges, {max_frame} bytes)",
+        )
+    want = frame_geometry(n_edges, flags)
+    if plen != want:
+        raise MalformedFrame(
+            "columns",
+            f"payload length {plen} disagrees with the column geometry "
+            f"{want} (n={n_edges}, flags={flags})",
+        )
+    payload = _recv_exact(sock, plen, stop=stop) if plen else b""
+    return seq, flags, n_edges, payload
+
+
+def _recv_exact(
+    sock,
+    n: int,
+    *,
+    at_boundary: bool = False,
+    stop: Optional[threading.Event] = None,
+) -> bytes:
+    """Read exactly ``n`` bytes. An orderly EOF (``recv() == b""``,
+    i.e. the peer's FIN) before the FIRST byte of a frame is a clean
+    :class:`Disconnect` — the ONLY clean end; a reset at a boundary
+    re-raises as the OSError it is (a reconnectable failure, never a
+    silent end-of-stream). EOF or a reset mid-frame is a
+    :class:`MalformedFrame` (``truncated``). A receive timeout at a
+    boundary with nothing read propagates (the reader's idle/stop poll
+    tick); mid-frame it keeps waiting — a slow peer is not a torn one —
+    unless ``stop`` was set, which unwinds via :class:`_Stopped`."""
+    buf = b""
+    while len(buf) < n:
+        if stop is not None and stop.is_set():
+            raise _Stopped()
+        try:
+            chunk = sock.recv(n - len(buf))
+        except _socket.timeout:
+            if at_boundary and not buf:
+                raise
+            continue
+        except OSError as e:
+            if at_boundary and not buf:
+                # a reset between frames is NOT a clean close: only the
+                # peer's FIN (empty recv below) may end the shard —
+                # mapping resets to Disconnect would silently truncate
+                # the stream while budget remains to reconnect
+                raise
+            raise MalformedFrame(
+                "truncated",
+                f"connection lost after {len(buf)}/{n} bytes: {e!r}",
+            ) from e
+        if not chunk:
+            if at_boundary and not buf:
+                raise Disconnect("peer closed")
+            raise MalformedFrame(
+                "truncated", f"peer closed after {len(buf)}/{n} bytes"
+            )
+        buf += chunk
+    return buf
+
+
+# --------------------------------------------------------------------- #
+# Partitioning: the keyed-shuffle rule
+# --------------------------------------------------------------------- #
+def shard_of(src, dst, nshards: int) -> np.ndarray:
+    """Deterministic edge -> shard assignment by endpoint hash.
+
+    THE one partition rule (same ethos as ``window.is_column_input``):
+    the load generator, any real producer, and the oracle tests must
+    agree on which shard owns an edge, so the rule lives in exactly one
+    place. Vectorized 64-bit mix of both endpoints; stable across runs
+    and processes."""
+    s = np.asarray(src).astype(np.uint64)
+    d = np.asarray(dst).astype(np.uint64)
+    h = s * np.uint64(0x9E3779B97F4A7C15) ^ (
+        d * np.uint64(0xC2B2AE3D27D4EB4F)
+    )
+    h ^= h >> np.uint64(33)
+    return (h % np.uint64(nshards)).astype(np.int64)
+
+
+def partition_edges(
+    src, dst, val=None, nshards: int = 1
+) -> List[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+    """Split edge columns into per-shard column triples, stream order
+    preserved within each shard (what a keyed shuffle delivers)."""
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    owner = shard_of(src, dst, nshards)
+    out = []
+    for i in range(nshards):
+        m = owner == i
+        out.append((
+            src[m], dst[m], None if val is None else np.asarray(val)[m]
+        ))
+    return out
+
+
+# --------------------------------------------------------------------- #
+# The sharded source
+# --------------------------------------------------------------------- #
+class _Shard:
+    """One connection's reader state: the bounded window queue, the
+    replay-dedup watermark, and lazily-resolved obs instruments."""
+
+    __slots__ = ("index", "addr", "q", "thread", "error", "last_seq",
+                 "nrec", "pend", "have", "_gauge", "_stall", "_resume")
+
+    def __init__(self, index: int, addr, depth: int):
+        self.index = index
+        self.addr = addr
+        self.q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+        self.last_seq = 0   # highest accepted frame seq (replay dedup)
+        self.nrec = 0       # accepted-record ordinal (fault hook index)
+        self.pend: list = []  # buffered column triples of the open window
+        self.have = 0
+        self._gauge = None
+        self._stall = None
+        self._resume = None
+
+
+class ShardedEdgeSource:
+    """N concurrent shard connections feeding per-shard count windows.
+
+    ``addresses`` is one ``(host, port)`` per shard; the peer must serve
+    each connection the records :func:`shard_of` assigns to that shard
+    (the keyed-shuffle contract — :func:`partition_edges` implements it
+    for in-memory producers, the ``--serve`` CLI for subprocesses).
+    ``window`` is the per-shard count-window size; closed windows are
+    handed over a bounded queue of ``queue_windows`` entries — the
+    explicit backpressure boundary (see the module docstring).
+
+    ``fmt="binary"`` reads GSEW frames (exactly-once across reconnects
+    via frame sequence dedup); ``fmt="text"`` reads the line protocol
+    ``SocketEdgeSource`` speaks, batch-parsed natively per recv
+    (at-least-once across reconnects — lines carry no sequence).
+
+    Consume via :meth:`windows` (closed windows in arrival order) or
+    :meth:`stream` (a :class:`ShardedEdgeStream` on the block/superbatch
+    execution path). Single-use, like every stream source here.
+    """
+
+    def __init__(
+        self,
+        addresses: Sequence[Tuple[str, int]],
+        *,
+        window: int,
+        fmt: str = "binary",
+        queue_windows: int = 4,
+        weighted: bool = False,
+        tick_s: float = 0.2,
+        reconnect: int = 5,
+        reconnect_base_s: float = 0.05,
+        reconnect_max_s: float = 2.0,
+        stall_event_s: float = 0.5,
+        max_frame_edges: int = MAX_FRAME_EDGES,
+    ):
+        if fmt not in ("binary", "text"):
+            raise ValueError(f"fmt must be binary/text, got {fmt!r}")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.fmt = fmt
+        self.weighted = weighted
+        self.tick_s = float(tick_s)
+        self.reconnect = int(reconnect)
+        self.reconnect_base_s = float(reconnect_base_s)
+        self.reconnect_max_s = float(reconnect_max_s)
+        self.stall_event_s = float(stall_event_s)
+        self.max_frame_edges = int(max_frame_edges)
+        self._stop = threading.Event()
+        self._tokens: "queue.Queue[int]" = queue.Queue()
+        self._shards = [
+            _Shard(i, tuple(a), queue_windows)
+            for i, a in enumerate(addresses)
+        ]
+        self._started = False
+        self._consumed = False
+
+    @property
+    def nshards(self) -> int:
+        return len(self._shards)
+
+    # ------------------------------------------------------------------ #
+    def start(self) -> "ShardedEdgeSource":
+        if self._started:
+            return self
+        self._started = True
+        for sh in self._shards:
+            t = threading.Thread(
+                target=self._run_reader, args=(sh,), daemon=True,
+                name=f"ingest-shard-{sh.index}",
+            )
+            sh.thread = t
+            t.start()
+        return self
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        for sh in self._shards:
+            t = sh.thread
+            if t is None:
+                continue
+            t.join(timeout=join_timeout_s)
+            if t.is_alive():
+                # same posture as pipeline.prefetch: a reader that never
+                # honored the stop flag is a silent leak — surface it
+                get_registry().counter("source.reader_leaked").inc()
+                warnings.warn(
+                    f"ingest shard {sh.index}: reader thread did not "
+                    f"exit within {join_timeout_s}s of close; thread "
+                    "(and its socket) leaked",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+
+    # ------------------------------------------------------------------ #
+    def windows(
+        self,
+    ) -> Iterator[Tuple[int, np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+        """Yield ``(shard, src, dst, val|None)`` closed windows in
+        arrival order until every shard ends cleanly. Single use. A
+        shard's reader error (exhausted reconnect budget, injected
+        fatal) re-raises HERE, after its queued windows drained."""
+        if self._consumed:
+            raise RuntimeError("ShardedEdgeSource is single-use")
+        self._consumed = True
+        self.start()
+        done = 0
+        n = len(self._shards)
+        try:
+            while done < n:
+                try:
+                    tok = self._tokens.get(timeout=1.0)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    if not any(
+                        sh.thread is not None and sh.thread.is_alive()
+                        for sh in self._shards
+                    ) and all(sh.q.empty() for sh in self._shards):
+                        raise RuntimeError(
+                            "ingest reader threads died without handoff"
+                        )
+                    continue
+                sh = self._shards[tok]
+                try:
+                    item = sh.q.get_nowait()
+                except queue.Empty:
+                    continue  # close() raced the token; nothing to do
+                if item is _DONE:
+                    done += 1
+                    if sh.error is not None:
+                        raise sh.error
+                    continue
+                yield (sh.index,) + item
+        finally:
+            self.close()
+
+    def stream(self, vertex_dict=None, context=None, *,
+               val_dtype=np.float32) -> "ShardedEdgeStream":
+        """The merged stream on the block/superbatch execution path."""
+        return ShardedEdgeStream(
+            self, vertex_dict=vertex_dict, context=context,
+            val_dtype=val_dtype,
+        )
+
+    # ------------------------------------------------------------------ #
+    # Reader threads
+    # ------------------------------------------------------------------ #
+    def _run_reader(self, sh: _Shard) -> None:
+        try:
+            if self.fmt == "binary":
+                self._read_binary(sh)
+            else:
+                self._read_text(sh)
+        except _Stopped:
+            pass
+        except BaseException as e:
+            # not a swallow: the error is COUNTED here and re-raised at
+            # the consumer's merge loop once this shard's queue drains
+            sh.error = e
+            get_registry().counter(
+                "source.reader_errors", shard=str(sh.index)
+            ).inc()
+        finally:
+            if bounded_put(sh.q, _DONE, self._stop):
+                self._tokens.put(sh.index)
+
+    def _read_binary(self, sh: _Shard) -> None:
+        attempts = 0
+        # consecutive malformed frames with NO new data accepted in
+        # between: a deterministic mid-stream corruption would otherwise
+        # reconnect forever (each replay's intact prefix refills the
+        # reconnect budget while seq-dedup yields no progress)
+        malformed_streak = 0
+        while not self._stop.is_set():
+            try:
+                sock = _socket.create_connection(sh.addr, timeout=5.0)
+            except OSError as e:
+                attempts += 1
+                self._backoff(sh, attempts, e)
+                continue
+            clean = False
+            failure: Optional[Exception] = None  # reconnect cause
+            try:
+                sock.settimeout(self.tick_s)
+                while True:
+                    try:
+                        seq, flags, n, payload = read_edge_frame(
+                            sock, max_edges=self.max_frame_edges,
+                            stop=self._stop,
+                        )
+                    except _socket.timeout:
+                        if self._stop.is_set():
+                            raise _Stopped() from None
+                        continue  # idle boundary tick
+                    except Disconnect:
+                        clean = True
+                        break
+                    attempts = 0  # an intact frame refills the budget
+                    if seq and seq <= sh.last_seq:
+                        # at-least-once replay after a reconnect: the
+                        # peer re-served an already-accepted frame
+                        get_registry().counter(
+                            "source.replayed_frames", shard=str(sh.index)
+                        ).inc()
+                        continue
+                    with _trace.span(
+                        "ingest.decode",
+                        {"edges": int(n), "shard": sh.index}
+                        if _trace.on() else None,
+                    ):
+                        src, dst, val = decode_frame_payload(
+                            payload, n, flags
+                        )
+                    # fault hook BEFORE the frame is accepted: an
+                    # injected disconnect drops the WHOLE frame (seq
+                    # watermark unmoved), so the peer's replay
+                    # re-delivers it exactly once
+                    if _faults.active():
+                        for j in range(n):
+                            _faults.fire(
+                                "source.record", index=sh.nrec + j
+                            )
+                    if seq:
+                        sh.last_seq = seq
+                    sh.nrec += n
+                    malformed_streak = 0  # real progress, not a replay
+                    if not self.weighted:
+                        val = None
+                    if not self._buffer_cols(sh, src, dst, val):
+                        raise _Stopped()
+            except MalformedFrame as e:
+                # counted evidence + clean reconnect: framing cannot
+                # resync mid-garbage, so the connection is dropped and
+                # the budgeted backoff below applies
+                self._count_malformed(sh, e.kind)
+                malformed_streak += 1
+                failure = e
+            except OSError as e:
+                # reset / injected disconnect mid-stream: reconnect;
+                # the in-flight frame died with the connection and the
+                # peer re-serves it (at-least-once, deduped by seq)
+                failure = e
+            finally:
+                sock.close()
+            if clean:
+                self._flush_tail(sh)
+                return
+            if failure is not None:
+                if malformed_streak > self.reconnect:
+                    # the stream is corrupt, not flaky: every reconnect
+                    # replays the same garbage at the same point — give
+                    # up with a classified error instead of looping
+                    raise TransientSourceError(
+                        f"ingest shard {sh.index} "
+                        f"({sh.addr[0]}:{sh.addr[1]}): "
+                        f"{malformed_streak} consecutive malformed "
+                        "frames with no new data between reconnects"
+                    ) from failure
+                # backoff AFTER teardown, outside the handler: an
+                # exhausted budget raises TransientSourceError (a
+                # ConnectionError), which the except OSError above
+                # must never re-catch
+                attempts += 1
+                self._backoff(sh, attempts, failure)
+
+    def _read_text(self, sh: _Shard) -> None:
+        attempts = 0
+        while not self._stop.is_set():
+            try:
+                sock = _socket.create_connection(sh.addr, timeout=5.0)
+            except OSError as e:
+                attempts += 1
+                self._backoff(sh, attempts, e)
+                continue
+            buf = b""
+            clean = False
+            failure: Optional[Exception] = None
+            try:
+                sock.settimeout(self.tick_s)
+                while True:
+                    if self._stop.is_set():
+                        raise _Stopped()
+                    try:
+                        data = sock.recv(1 << 16)
+                    except _socket.timeout:
+                        continue
+                    if not data:
+                        clean = True
+                        break
+                    attempts = 0
+                    buf += data
+                    if b"\n" not in buf:
+                        continue
+                    lines, buf = buf.rsplit(b"\n", 1)
+                    if not self._parse_text_chunk(sh, lines):
+                        raise _Stopped()
+            except OSError as e:
+                failure = e
+            finally:
+                sock.close()
+            if clean:
+                if buf.strip():
+                    self._parse_text_chunk(sh, buf)
+                self._flush_tail(sh)
+                return
+            if failure is not None:
+                attempts += 1
+                self._backoff(sh, attempts, failure)
+
+    def _parse_text_chunk(self, sh: _Shard, lines: bytes) -> bool:
+        from .. import native as _native
+
+        with _trace.span(
+            "ingest.decode",
+            {"bytes": len(lines), "shard": sh.index}
+            if _trace.on() else None,
+        ):
+            src, dst, val, malformed = _native.parse_edge_lines(lines)
+        if malformed:
+            get_registry().counter(
+                "source.malformed_lines"
+            ).inc(malformed)
+        n = len(src)
+        if n == 0:
+            return True
+        if _faults.active():
+            for j in range(n):
+                _faults.fire("source.record", index=sh.nrec + j)
+        sh.nrec += n
+        if not self.weighted:
+            val = None
+        return self._buffer_cols(sh, src, dst, val)
+
+    # ------------------------------------------------------------------ #
+    # Window assembly + the backpressure boundary
+    # ------------------------------------------------------------------ #
+    def _buffer_cols(self, sh: _Shard, src, dst, val) -> bool:
+        from .window import take_cols
+
+        sh.pend.append((src, dst, val))
+        sh.have += len(src)
+        while sh.have >= self.window:
+            sh.have -= self.window
+            if not self._put_window(sh, take_cols(sh.pend, self.window)):
+                return False
+        return True
+
+    def _flush_tail(self, sh: _Shard) -> None:
+        from .window import take_cols
+
+        if sh.have:
+            take = sh.have
+            sh.have = 0
+            self._put_window(sh, take_cols(sh.pend, take))
+
+    def _put_window(self, sh: _Shard, cols) -> bool:
+        stalled = [False]
+
+        def on_wait(waited: float) -> None:
+            if not stalled[0] and waited >= self.stall_event_s:
+                stalled[0] = True
+                if sh._stall is None:
+                    sh._stall = get_registry().counter(
+                        "source.backpressure_stalls", shard=str(sh.index)
+                    )
+                sh._stall.inc()
+
+        def on_done(waited: float) -> None:
+            if waited > 0:
+                get_registry().counter("source.backpressure_s").inc(waited)
+            if stalled[0]:
+                if sh._resume is None:
+                    sh._resume = get_registry().counter(
+                        "source.backpressure_resumes", shard=str(sh.index)
+                    )
+                sh._resume.inc()
+
+        if not bounded_put(
+            sh.q, cols, self._stop, on_wait=on_wait, on_done=on_done
+        ):
+            return False
+        if sh._gauge is None:
+            sh._gauge = get_registry().gauge(
+                "source.shard_depth", shard=str(sh.index)
+            )
+        sh._gauge.set(sh.q.qsize())
+        self._tokens.put(sh.index)
+        return True
+
+    def _count_malformed(self, sh: _Shard, kind: str) -> None:
+        # every frame-contract violation is counted evidence (the fuzz
+        # contract: a malformed byte stream is a clean reconnect, never
+        # a dead reader thread — and never a silent one)
+        get_registry().counter(
+            "source.malformed_frames", kind=kind, shard=str(sh.index)
+        ).inc()
+
+    # ------------------------------------------------------------------ #
+    def _backoff(self, sh: _Shard, attempts: int, err: Exception) -> None:
+        """One budgeted reconnect delay (the ``SocketEdgeSource``
+        resilience contract): counted, bounded-exponential, waited out
+        in slices so ``close()`` never blocks a full delay. Raises
+        :class:`TransientSourceError` past the budget."""
+        get_registry().counter("source.reconnects").inc()
+        if attempts > self.reconnect:
+            raise TransientSourceError(
+                f"ingest shard {sh.index} ({sh.addr[0]}:{sh.addr[1]}) "
+                f"gave up after {attempts - 1} reconnect attempts"
+            ) from err
+        delay = exp_backoff(
+            attempts - 1, self.reconnect_base_s, self.reconnect_max_s
+        )
+        while delay > 0:
+            if self._stop.is_set():
+                raise _Stopped()
+            step = min(0.05, delay)
+            time.sleep(step)
+            delay -= step
+
+
+# --------------------------------------------------------------------- #
+# The merged stream: closed shard windows -> block/superbatch path
+# --------------------------------------------------------------------- #
+class ShardedEdgeStream(SimpleEdgeStream):
+    """A real :class:`~gelly_streaming_tpu.core.stream.SimpleEdgeStream`
+    over a :class:`ShardedEdgeSource`'s merged windows: aggregations,
+    transforms, emission streams, and serving ingest all work unchanged.
+
+    Per-window blocks go through the shared
+    :class:`~gelly_streaming_tpu.core.window.Windower` (one encode + one
+    device block per closed shard window), and :meth:`superbatches`
+    packs K closed windows with ONE group encode and zero per-window
+    device work
+    (:meth:`~gelly_streaming_tpu.core.window.Windower.pack_window_cols`)
+    — the sharded analog of the count-window column fast path. Single
+    use, like the source underneath."""
+
+    def __init__(self, source: ShardedEdgeSource, *, vertex_dict=None,
+                 context=None, val_dtype=np.float32):
+        from .window import CountWindow, Windower
+
+        windower = Windower(
+            CountWindow(source.window), vertex_dict, val_dtype=val_dtype
+        )
+        self._sharded_source = source
+        self._shard_windower = windower
+        super().__init__(
+            context=context, _blocks=self._shard_blocks,
+            _vdict=windower.vertex_dict,
+        )
+
+    def _shard_blocks(self):
+        w = self._shard_windower
+        for _shard, src, dst, val in self._sharded_source.windows():
+            yield w._block_from_arrays(src, dst, val)
+
+    def superbatches(self, k: int):
+        if k < 1:
+            raise ValueError(f"superbatch k must be >= 1, got {k}")
+
+        def gen():
+            w = self._shard_windower
+            group: list = []
+            index = 0
+            for _shard, src, dst, val in self._sharded_source.windows():
+                group.append((src, dst, val))
+                if len(group) >= k:
+                    yield w.pack_window_cols(group, index)
+                    index += len(group)
+                    group = []
+            if group:
+                yield w.pack_window_cols(group, index)
+
+        return gen()
+
+
+# --------------------------------------------------------------------- #
+# Serve-from-memory peer (the load generator's server half)
+# --------------------------------------------------------------------- #
+def encode_shard_frames(
+    src, dst, val=None, *, frame_edges: int = 8192,
+    wide: Optional[bool] = None,
+) -> bytes:
+    """Pre-encode one shard's whole stream as consecutive GSEW frames
+    (seq 1..N) — what the serve-from-memory peer sends verbatim."""
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    parts = []
+    seq = 0
+    for a in range(0, len(src), frame_edges):
+        b = a + frame_edges
+        seq += 1
+        parts.append(pack_edge_frame(
+            src[a:b], dst[a:b],
+            None if val is None else np.asarray(val)[a:b],
+            seq=seq, wide=wide,
+        ))
+    return b"".join(parts)
+
+
+def encode_shard_text(src, dst) -> bytes:
+    """One shard's stream as the line protocol (the text baseline)."""
+    return "".join(
+        f"{int(s)}\t{int(d)}\n"
+        for s, d in zip(np.asarray(src).tolist(), np.asarray(dst).tolist())
+    ).encode()
+
+
+def serve_blobs(
+    blobs: Sequence[bytes], *, host: str = "127.0.0.1",
+    accepts: int = 1, chunk: int = 1 << 18,
+) -> Tuple[List[int], List[threading.Thread], threading.Event]:
+    """Serve each pre-encoded blob on its own listening port: accept up
+    to ``accepts`` connections sequentially and send the WHOLE blob to
+    each (a re-accept replays from the start — the at-least-once peer
+    the reconnect tests need). Returns ``(ports, threads, stop)``;
+    setting ``stop`` ends the accept loops at their next poll."""
+    stop = threading.Event()
+    ports: List[int] = []
+    threads: List[threading.Thread] = []
+    for i, blob in enumerate(blobs):
+        srv = _socket.create_server((host, 0))
+        srv.settimeout(0.2)
+        ports.append(srv.getsockname()[1])
+
+        def run(srv=srv, blob=blob, shard=i):
+            served = 0
+            try:
+                while served < accepts and not stop.is_set():
+                    try:
+                        conn, _ = srv.accept()
+                    except _socket.timeout:
+                        continue
+                    except OSError:
+                        # listener torn down under us: the stop path
+                        get_registry().counter(
+                            "source.swallowed", site="serve_accept"
+                        ).inc()
+                        return
+                    try:
+                        for a in range(0, len(blob), chunk):
+                            if stop.is_set():
+                                break
+                            conn.sendall(blob[a:a + chunk])
+                    except OSError:
+                        # peer vanished mid-send (reconnect tests kill
+                        # readers on purpose): count, move to the next
+                        # accept — the replay is the contract
+                        get_registry().counter(
+                            "source.swallowed", site="serve_send"
+                        ).inc()
+                    finally:
+                        conn.close()
+                    served += 1
+            finally:
+                srv.close()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"ingest-serve-{i}")
+        t.start()
+        threads.append(t)
+    return ports, threads, stop
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m gelly_streaming_tpu.core.ingest --serve ...`` — the
+    serve-from-memory load-generator peer ``bench.py --ingest`` spawns.
+    Synthesizes an R-MAT stream, partitions it by :func:`shard_of`,
+    pre-encodes per-shard blobs, prints ``{"ports": [...]}`` on stdout
+    once ready, serves one connection per shard, and exits."""
+    import json
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def take(flag: str, default=None):
+        if flag in argv:
+            i = argv.index(flag)
+            v = argv[i + 1]
+            del argv[i:i + 2]
+            return v
+        return default
+
+    if "--serve" not in argv:
+        print(
+            "usage: python -m gelly_streaming_tpu.core.ingest --serve "
+            "--shards N --edges M [--scale S] [--seed K] "
+            "[--format binary|text] [--frame-edges F] [--accepts A]",
+            file=sys.stderr,
+        )
+        return 2
+    argv.remove("--serve")
+    shards = int(take("--shards", "1"))
+    n_edges = int(take("--edges", str(1 << 20)))
+    scale = int(take("--scale", "20"))
+    seed = int(take("--seed", "7"))
+    fmt = take("--format", "binary")
+    frame_edges = int(take("--frame-edges", "8192"))
+    accepts = int(take("--accepts", "1"))
+    from ..datasets import rmat_edges
+
+    src, dst = rmat_edges(n_edges, scale, seed=seed)
+    parts = partition_edges(src, dst, None, shards)
+    if fmt == "binary":
+        blobs = [
+            encode_shard_frames(s, d, frame_edges=frame_edges)
+            for s, d, _v in parts
+        ]
+    else:
+        blobs = [encode_shard_text(s, d) for s, d, _v in parts]
+    ports, threads, _stop = serve_blobs(blobs, accepts=accepts)
+    print(json.dumps({
+        "ports": ports,
+        "edges": int(n_edges),
+        "per_shard": [int(len(s)) for s, _d, _v in parts],
+        "format": fmt,
+    }), flush=True)
+    for t in threads:
+        t.join()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -51,6 +51,39 @@ def superbatch_prefetch_depth(superbatch: int, base: int = 2) -> int:
     return max(int(base), int(superbatch) + 1)
 
 
+def bounded_put(q: "queue.Queue", item: Any, stop: threading.Event, *,
+                timeout: float = 0.1,
+                on_wait: Optional[Any] = None,
+                on_done: Optional[Any] = None) -> bool:
+    """Put ``item`` on a bounded queue, polling ``stop`` between
+    attempts — the backpressure primitive shared by :func:`prefetch`'s
+    producer and the sharded ingest readers
+    (:class:`~gelly_streaming_tpu.core.ingest.ShardedEdgeSource`): a
+    FULL queue blocks the producer right here, which for a socket
+    reader means ``recv`` stops and TCP flow control pushes back on the
+    peer — overload degrades to bounded staleness, never unbounded
+    buffering.
+
+    ``on_wait(waited_s)`` fires after each full-queue timeout slice
+    with the cumulative approximate wait (stall detection without extra
+    clock reads on the put fast path); ``on_done(waited_s)`` fires once
+    after a successful put. Returns False when ``stop`` was set before
+    the item could be enqueued (the consumer is gone)."""
+    waited = 0.0
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=timeout)
+        except queue.Full:
+            waited += timeout
+            if on_wait is not None:
+                on_wait(waited)
+            continue
+        if on_done is not None:
+            on_done(waited)
+        return True
+    return False
+
+
 def prefetch(iterator: Iterator[T], depth: int = 2,
              name: str = "pipeline", *,
              stall_timeout_s: Optional[float] = None,
@@ -112,17 +145,14 @@ def prefetch(iterator: Iterator[T], depth: int = 2,
         """Bounded put that gives up once the consumer is gone."""
         obs = _trace.on()
         t0 = time.perf_counter() if obs else 0.0
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                if obs:
-                    dt = time.perf_counter() - t0
-                    if dt > 1e-4:  # count real blocking, not put cost
-                        _instruments()[1].inc(dt)
-                return True
-            except queue.Full:
-                continue
-        return False
+
+        def done(_waited):
+            if obs:
+                dt = time.perf_counter() - t0
+                if dt > 1e-4:  # count real blocking, not put cost
+                    _instruments()[1].inc(dt)
+
+        return bounded_put(q, item, stop, on_done=done)
 
     def produce():
         try:

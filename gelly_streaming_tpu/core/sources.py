@@ -8,9 +8,23 @@ adds the LIVE ones — an edge stream with no known end, consumed as it
 arrives:
 
 - :class:`SocketEdgeSource` — line-delimited edge records over TCP, the
-  ``socketTextStream`` parity path.
+  ``socketTextStream`` parity path. Since ISSUE 11 the TEXT protocol is
+  parsed with the file parser's grammar, one chunk-parse call per
+  ``recv`` (``native.parse_edge_lines`` — the AVX-512 line scanner when
+  the toolchain is available, the byte-equivalent regex fallback
+  otherwise) instead of per-line Python ``split()``/``int()``.
 - :class:`GeneratorSource` — unbounded synthetic stream (R-MAT chunks),
   for tests/benches that need "no end" semantics without a network.
+  :meth:`GeneratorSource.iter_chunks` exposes the R-MAT columns
+  directly (no per-edge tuple round trip); the windower consumes them
+  on its chunk fast path, so the load generator is never itself the
+  ingest bottleneck.
+
+These are the SINGLE-connection sources. The scale-out path — N
+connections partitioned by edge-endpoint hash, the **GSEW binary wire
+format** decoded natively, per-shard windowers with explicit
+backpressure — lives in :mod:`gelly_streaming_tpu.core.ingest`
+(``ShardedEdgeSource``; README "Ingest at scale").
 
 Both yield ``None`` ticks while idle so a
 :class:`~gelly_streaming_tpu.core.window.ProcessingTimeWindow` can close
@@ -39,6 +53,7 @@ import socket
 import time
 from typing import Iterator, Optional, Tuple
 
+import numpy as np
 
 from ..obs.registry import get_registry
 from ..resilience import faults as _faults
@@ -59,10 +74,18 @@ def _perturbed(records: Iterator) -> Iterator:
 class SocketEdgeSource:
     """Unbounded edge records over TCP (``env.socketTextStream`` parity).
 
-    Lines are whitespace- or tab-separated ``src dst [val]``; malformed
-    lines are counted into the obs registry (``source.malformed_lines``)
-    and skipped, ``#`` comments and blank lines are skipped silently,
-    like the file parser. Iteration ends when the peer closes the
+    Lines follow the FILE parser's grammar (``native.parse_edge_lines``;
+    space/tab/comma separators, ``#``/``%`` comments, third column as
+    number or ``+``/``-`` event flag) and complete lines are parsed in
+    ONE chunk-parse call per ``recv`` — the AVX-512 scanner when the
+    native toolchain is available, the byte-equivalent regex fallback
+    otherwise — instead of per-line Python ``split()``/``int()``
+    (ISSUE 11 satellite). Malformed lines (non-blank, non-comment,
+    grammar-rejected) are counted into the obs registry
+    (``source.malformed_lines``) and skipped, exactly as before; when a
+    fault plan is installed the source drops back to per-line parsing
+    so record-ordinal faults interleave with parsing exactly as the
+    wire delivered them. Iteration ends when the peer closes the
     connection CLEANLY (a live deployment would simply never close).
     ``tick_s``: receive timeout after which a ``None`` time tick is
     yielded instead of a record.
@@ -129,11 +152,19 @@ class SocketEdgeSource:
                     if b"\n" not in buf:
                         continue
                     lines, buf = buf.rsplit(b"\n", 1)
-                    for line in lines.split(b"\n"):
-                        rec = self._parse(line)
-                        if rec is not None:
-                            if _faults.active():
+                    if _faults.active():
+                        # per-line path: record-ordinal faults must
+                        # interleave with parsing exactly as the wire
+                        # delivered the lines (a chunk parse would
+                        # count lines past an injected disconnect)
+                        for line in lines.split(b"\n"):
+                            rec = self._parse_one(line)
+                            if rec is not None:
                                 _faults.fire("source.record", index=nrec)
+                                nrec += 1
+                                yield rec
+                    else:
+                        for rec in self._parse_chunk(lines):
                             nrec += 1
                             yield rec
             except OSError as e:
@@ -147,7 +178,7 @@ class SocketEdgeSource:
             finally:
                 sock.close()
             if clean_close:
-                rec = self._parse(buf)
+                rec = self._parse_one(buf)
                 if rec is not None:
                     if _faults.active():
                         _faults.fire("source.record", index=nrec)
@@ -178,23 +209,40 @@ class SocketEdgeSource:
             time.sleep(step)
             delay -= step
 
-    def _parse(self, line: bytes) -> Optional[Tuple]:
-        line = line.strip()
-        if not line or line.startswith(b"#"):
-            return None
-        parts = line.split()
-        if len(parts) < 2:
-            self._count_malformed()
-            return None
-        try:
-            s, d = int(parts[0]), int(parts[1])
-            v = float(parts[2]) if self.weighted and len(parts) > 2 else 0.0
-        except ValueError:
-            self._count_malformed()
-            return None
-        return (s, d, v)
+    def _parse_chunk(self, lines: bytes) -> Iterator[Tuple]:
+        """Parse a recv batch of complete lines in ONE chunk-parse call
+        (the file parser's grammar; malformed lines counted) and yield
+        per-record tuples."""
+        from .. import native as _native
 
-    def _count_malformed(self) -> None:
+        src, dst, val, malformed = _native.parse_edge_lines(lines)
+        if malformed:
+            self._count_malformed(malformed)
+        if self.weighted and val is not None:
+            for s, d, v in zip(src.tolist(), dst.tolist(), val.tolist()):
+                yield (s, d, v)
+        else:
+            for s, d in zip(src.tolist(), dst.tolist()):
+                yield (s, d, 0.0)
+
+    def _parse_one(self, line: bytes) -> Optional[Tuple]:
+        """One line through the same grammar as the chunk path (used on
+        the fault-interleaved path and for the clean-close tail)."""
+        from .. import native as _native
+
+        src, dst, val, malformed = _native.parse_edge_lines(line)
+        if malformed:
+            self._count_malformed(malformed)
+        if len(src) == 0:
+            return None
+        v = (
+            float(val[0])
+            if self.weighted and val is not None
+            else 0.0
+        )
+        return (int(src[0]), int(dst[0]), v)
+
+    def _count_malformed(self, n: int = 1) -> None:
         # a malformed line is DATA the operator should know about, not
         # noise (satellite: no silent discards); resolved lazily so a
         # source built before obs/test registry swaps still reports
@@ -202,7 +250,7 @@ class SocketEdgeSource:
             self._malformed = get_registry().counter(
                 "source.malformed_lines"
             )
-        self._malformed.inc()
+        self._malformed.inc(n)
 
 
 class GeneratorSource:
@@ -225,7 +273,24 @@ class GeneratorSource:
     def __iter__(self) -> Iterator[Tuple]:
         return _perturbed(self._records())
 
-    def _records(self) -> Iterator[Tuple]:
+    def iter_chunks(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Column-chunk fast path (ISSUE 11 satellite): yield the R-MAT
+        ``(src, dst)`` int64 columns directly, no ``.tolist()`` +
+        per-edge tuple round trip — the windower's chunk path
+        (``Windower.blocks_from_chunks``) consumes these as-is, so the
+        synthetic load generator is never itself the bottleneck.
+
+        When an installed fault plan perturbs records, the chunks are
+        re-assembled FROM the perturbed record path (perturbation
+        schedules are per-record), so chaos runs see identical streams
+        on either path."""
+        plan = _faults.plan()
+        if plan is not None and plan.perturbs_records():
+            yield from self._rechunked_records()
+            return
+        yield from self._column_chunks()
+
+    def _column_chunks(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         from ..datasets import rmat_edges
 
         produced = 0
@@ -234,8 +299,26 @@ class GeneratorSource:
             n = self.chunk
             if self.limit is not None:
                 n = min(n, self.limit - produced)
-            src, dst = rmat_edges(n, self.scale, seed=self.seed + step)
-            for s, d in zip(src.tolist(), dst.tolist()):
-                yield (s, d, 0.0)
+            yield rmat_edges(n, self.scale, seed=self.seed + step)
             produced += n
             step += 1
+
+    def _rechunked_records(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        buf_s: list = []
+        buf_d: list = []
+        for rec in _perturbed(self._records()):
+            if rec is None:
+                continue
+            buf_s.append(rec[0])
+            buf_d.append(rec[1])
+            if len(buf_s) >= self.chunk:
+                yield (np.asarray(buf_s, np.int64),
+                       np.asarray(buf_d, np.int64))
+                buf_s, buf_d = [], []
+        if buf_s:
+            yield np.asarray(buf_s, np.int64), np.asarray(buf_d, np.int64)
+
+    def _records(self) -> Iterator[Tuple]:
+        for src, dst in self._column_chunks():
+            for s, d in zip(src.tolist(), dst.tolist()):
+                yield (s, d, 0.0)

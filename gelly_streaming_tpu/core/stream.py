@@ -161,6 +161,12 @@ class SimpleEdgeStream(GraphStream):
                 # Windower (iter() would hide them behind a generic
                 # iterator and fall back to per-record parsing)
                 self._block_source = lambda: windower.blocks(edges_it)
+            elif callable(getattr(edges, "iter_chunks", None)):
+                # chunk-capable source (GeneratorSource): hand the
+                # SOURCE to the Windower so its column-chunk fast path
+                # applies — iter() would flatten it back to per-record
+                # tuples
+                self._block_source = lambda: windower.blocks(edges_it)
             else:
                 self._block_source = lambda: windower.blocks(iter(edges_it))
             self._windower = windower

@@ -238,6 +238,17 @@ class Windower:
         if is_column_input(edges):
             yield from self._array_windows(edges)
             return
+        if callable(getattr(edges, "iter_chunks", None)) and isinstance(
+            policy, CountWindow
+        ):
+            # chunk-capable source (GeneratorSource): consume column
+            # chunks directly instead of per-record tuples — the
+            # synthetic load generator must not itself be the
+            # bottleneck. Count windows only: time policies read
+            # per-record semantics (ticks, timestamps) chunks don't
+            # carry, so they keep the record path.
+            yield from self.blocks_from_chunks(edges.iter_chunks())
+            return
         if isinstance(policy, CountWindow):
             buf: list[Tuple] = []
             for e in edges:
@@ -386,6 +397,54 @@ class Windower:
                 )
             yield group
 
+    def pack_window_cols(
+        self, win_cols: Sequence[Tuple], first_index: int = 0
+    ) -> "SuperbatchGroup":
+        """Pack ALREADY-CLOSED windows (raw-id column triples
+        ``(src, dst, val|None)``) into one :class:`SuperbatchGroup`
+        with a single group encode and ZERO per-window device work —
+        the superbatch ingest fusion for window boundaries decided
+        upstream (the sharded ingest's per-shard windowers,
+        ``core/ingest.py``). The count-window column fast path
+        (:meth:`_array_superbatches`) is the same shape with the
+        boundary slicing done here too."""
+        k = len(win_cols)
+        lens = [len(c[0]) for c in win_cols]
+        with _trace.span(
+            "window.superbatch_pack",
+            {"k": k, "edges": int(sum(lens)), "window_index": first_index}
+            if _trace.on() else None,
+        ):
+            if k == 1:
+                src = np.ascontiguousarray(win_cols[0][0], np.int64)
+                dst = np.ascontiguousarray(win_cols[0][1], np.int64)
+            else:
+                src = np.concatenate(
+                    [np.asarray(c[0], np.int64) for c in win_cols]
+                )
+                dst = np.concatenate(
+                    [np.asarray(c[1], np.int64) for c in win_cols]
+                )
+            s_g, d_g = self.vertex_dict.encode_pair(src, dst)
+            s_g = np.asarray(s_g, np.int32)
+            d_g = np.asarray(d_g, np.int32)
+            nv = self.vertex_dict.capacity
+            cols = []
+            infos = []
+            a = 0
+            for j, c in enumerate(win_cols):
+                b = a + lens[j]
+                v = c[2]
+                cols.append((
+                    s_g[a:b], d_g[a:b],
+                    None if v is None else np.asarray(v, self.val_dtype),
+                ))
+                infos.append(WindowInfo(first_index + j, None, None))
+                a = b
+            return SuperbatchGroup(
+                infos, cols, nv, val_dtype=self.val_dtype
+            )
+
     # ------------------------------------------------------------------ #
     # Vectorized ingest: numpy columns instead of per-record tuples
     # ------------------------------------------------------------------ #
@@ -491,47 +550,6 @@ class Windower:
         pending: list[Tuple] = []  # (src, dst, val|None) column triples
         have = 0
         index = 0
-
-        def assemble(take: int):
-            nonlocal have
-            s_parts, d_parts, v_parts = [], [], []
-            got = 0
-            while got < take:
-                s, d, v = pending[0]
-                need = take - got
-                if len(s) <= need:
-                    s_parts.append(s)
-                    d_parts.append(d)
-                    v_parts.append(v)
-                    pending.pop(0)
-                    got += len(s)
-                else:
-                    s_parts.append(s[:need])
-                    d_parts.append(d[:need])
-                    v_parts.append(None if v is None else v[:need])
-                    pending[0] = (
-                        s[need:], d[need:], None if v is None else v[need:]
-                    )
-                    got = take
-            have -= take
-            if len(s_parts) == 1:
-                # common case (chunks larger than windows): hand out slice
-                # views, no concatenation copy — the encoder reads views
-                return s_parts[0], d_parts[0], v_parts[0]
-            src = np.concatenate(s_parts)
-            dst = np.concatenate(d_parts)
-            if any(v is not None for v in v_parts):
-                val = np.concatenate(
-                    [
-                        np.zeros(len(s), self.val_dtype) if v is None
-                        else np.asarray(v, self.val_dtype)
-                        for s, v in zip(s_parts, v_parts)
-                    ]
-                )
-            else:
-                val = None
-            return src, dst, val
-
         build = self._block_from_encoded if encoded else self._block_from_arrays
         for cols in chunks:
             src, dst = np.asarray(cols[0]), np.asarray(cols[1])
@@ -541,10 +559,15 @@ class Windower:
             pending.append((src, dst, val))
             have += len(src)
             while have >= size:
-                yield WindowInfo(index, None, None), build(*assemble(size))
+                have -= size
+                yield WindowInfo(index, None, None), build(
+                    *take_cols(pending, size, self.val_dtype)
+                )
                 index += 1
         if have:
-            yield WindowInfo(index, None, None), build(*assemble(have))
+            yield WindowInfo(index, None, None), build(
+                *take_cols(pending, have, self.val_dtype)
+            )
 
     def _chunk_time_windows(
         self, chunks, policy: EventTimeWindow, encoded: bool = False
@@ -553,6 +576,51 @@ class Windower:
         runs = iter_time_slot_runs(chunks, policy, val_dtype=self.val_dtype)
         for index, (slot, src, dst, val) in enumerate(runs):
             yield self._info(index, slot), build(src, dst, val)
+
+
+def take_cols(pend: list, take: int, val_dtype=np.float64):
+    """Slice ``take`` edges off a pending list of ``(src, dst,
+    val|None)`` column chunks, mutating ``pend`` in place — THE
+    take-N-across-chunk-boundaries rule, shared by the windower's
+    chunked count windows and the sharded ingest's per-shard window
+    assembly (``core/ingest.py``). Single-chunk takes hand out slice
+    VIEWS (no concatenation copy — the encoder reads views);
+    multi-chunk takes concatenate once, zero-filling ``None`` value
+    chunks when any chunk carries values."""
+    s_parts, d_parts, v_parts = [], [], []
+    got = 0
+    while got < take:
+        s, d, v = pend[0]
+        need = take - got
+        if len(s) <= need:
+            s_parts.append(s)
+            d_parts.append(d)
+            v_parts.append(v)
+            pend.pop(0)
+            got += len(s)
+        else:
+            s_parts.append(s[:need])
+            d_parts.append(d[:need])
+            v_parts.append(None if v is None else v[:need])
+            pend[0] = (
+                s[need:], d[need:], None if v is None else v[need:]
+            )
+            got = take
+    if len(s_parts) == 1:
+        return s_parts[0], d_parts[0], v_parts[0]
+    src = np.concatenate(s_parts)
+    dst = np.concatenate(d_parts)
+    if any(v is not None for v in v_parts):
+        val = np.concatenate(
+            [
+                np.zeros(len(s), val_dtype) if v is None
+                else np.asarray(v, val_dtype)
+                for s, v in zip(s_parts, v_parts)
+            ]
+        )
+    else:
+        val = None
+    return src, dst, val
 
 
 def iter_time_slot_runs(chunks, policy: "EventTimeWindow",

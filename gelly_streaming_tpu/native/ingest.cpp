@@ -13,6 +13,8 @@
 //   encoder_*                                 -> first-seen id compaction
 //   write_edge_file                           -> fast corpus writer
 //   cc_baseline_run                           -> compiled CC baseline
+//   decode_edge_frame                         -> GSEW binary wire decode
+//   parse_edge_lines                          -> socket text chunk parse
 //
 // Format per line: "src dst [third]" where third may be a value,
 // timestamp, or +/- event flag (returned as +1/-1). '#'/'%' lines and
@@ -700,6 +702,81 @@ int64_t write_edge_file(const char* path, const int64_t* src,
     }
     fclose(f);
     return 0;
+}
+
+// Binary wire-frame column decode (the GSEW ingest wire format,
+// core/ingest.py). One call replaces the per-line strtoll/int() work of
+// the text path entirely: the payload already IS little-endian columns,
+// so decoding is a geometry check plus a widen/copy into the caller's
+// int64/double buffers. Layout: src column, then dst column (int32 when
+// wide == 0, int64 otherwise), then an optional float64 value column.
+// Returns 0, or -1 when the payload size disagrees with (n, wide,
+// has_val) — the caller counts that as a malformed frame.
+int64_t decode_edge_frame(const char* payload, int64_t nbytes, int64_t n,
+                          int32_t wide, int32_t has_val, int64_t* src,
+                          int64_t* dst, double* val) {
+    if (n < 0) return -1;
+    int64_t isz = wide ? 8 : 4;
+    int64_t want = n * isz * 2 + (has_val ? n * 8 : 0);
+    if (nbytes != want) return -1;
+    if (wide) {
+        memcpy(src, payload, (size_t)(n * 8));
+        memcpy(dst, payload + n * 8, (size_t)(n * 8));
+    } else {
+        // widen int32 -> int64 (the engine's raw-id dtype) in one pass
+        int32_t s32, d32;
+        const char* ps = payload;
+        const char* pd = payload + n * 4;
+        for (int64_t i = 0; i < n; ++i) {
+            memcpy(&s32, ps + i * 4, 4);
+            memcpy(&d32, pd + i * 4, 4);
+            src[i] = s32;
+            dst[i] = d32;
+        }
+    }
+    if (has_val) memcpy(val, payload + n * isz * 2, (size_t)(n * 8));
+    return 0;
+}
+
+// Parse a memory buffer of complete text edge lines (the socket text hot
+// path, core/sources.py): same accepted grammar as the file reader
+// (parse_line_fast), one call per recv batch instead of per-line Python
+// split()/int(). Unlike the file path, MALFORMED lines are counted —
+// a live socket's noise is data the operator should know about — where
+// malformed means a non-blank, non-comment line the grammar rejects.
+// [buf, buf+len) must carry READ_PAD zero bytes past len (SWAR loads).
+// Returns edges written (never exceeds cap; the caller sizes cap at the
+// line count), with *malformed_out the rejected-line count.
+int64_t parse_edge_lines(const char* buf, int64_t len, int64_t* src,
+                         int64_t* dst, double* val, int64_t cap,
+                         int32_t* has_val, int64_t* malformed_out) {
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t n = 0, malformed = 0;
+    bool av = false;
+    int64_t s, d;
+    double v;
+    bool h;
+    while (p < end && n < cap) {
+        const char* q = skip_sep(p, end);
+        if (q >= end) break;
+        if (*q == '#' || *q == '%' || *q == '\n') {
+            p = skip_line(q, end);
+            continue;
+        }
+        if (parse_line_fast(p, end, &s, &d, &v, &h)) {
+            src[n] = s;
+            dst[n] = d;
+            val[n] = v;
+            av |= h;
+            ++n;
+        } else {
+            ++malformed;  // non-blank, non-comment, rejected: counted
+        }
+    }
+    *has_val = av ? 1 : 0;
+    *malformed_out = malformed;
+    return n;
 }
 
 }  // extern "C"

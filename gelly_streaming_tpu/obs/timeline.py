@@ -75,6 +75,15 @@ STORY = {
     "rpc.disconnects": "DISCONNECT",
     "rpc.malformed": "MALFORMED",
     "serving.lease_lapse": "LEASE-LAPSE",
+    # the sharded-ingest story (ISSUE 11): reconnects, malformed wire
+    # frames, and the backpressure lifecycle — a reader blocked past
+    # the stall threshold on a full shard queue (recv stopped, TCP
+    # pushing back on the producer) and its later resume render as an
+    # INGEST-STALL / INGEST-RESUME pair alongside the rest
+    "source.reconnects": "RECONNECT",
+    "source.malformed_frames": "MALFORMED",
+    "source.backpressure_stalls": "INGEST-STALL",
+    "source.backpressure_resumes": "INGEST-RESUME",
     "flight": "BLACKBOX",
 }
 

@@ -8,6 +8,8 @@ import json
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # `python -m pytest` from the checkout has it
     sys.path.insert(0, REPO)
@@ -15,6 +17,7 @@ if REPO not in sys.path:  # `python -m pytest` from the checkout has it
 from tools.benchguard import (  # noqa: E402
     WATCHED,
     WATCHED_CHAOS,
+    WATCHED_INGEST,
     compare,
     dig,
     main,
@@ -80,6 +83,44 @@ def test_chaos_watch_list_matches_the_chaos_artifact():
         committed = json.load(f)
     for metric in WATCHED_CHAOS:
         assert isinstance(dig(committed, metric), (int, float)), metric
+
+
+def ingest_doc(eps=8_000_000.0):
+    return {"cells": {"c4_binary": {"eps": eps}}}
+
+
+def test_min_prefix_flips_the_bound_to_throughput_direction():
+    # fresh above committed/ratio passes; below it regresses (ISSUE 11:
+    # eps is higher-is-better, the opposite of every latency metric)
+    verdicts = compare(ingest_doc(), ingest_doc(eps=4_000_000.0),
+                       ratio=3.0, watched=WATCHED_INGEST)
+    assert [v["metric"] for v in verdicts] == ["min:cells.c4_binary.eps"]
+    assert verdicts[0]["ok"] is True
+    assert verdicts[0]["bound"] == pytest.approx(8_000_000.0 / 3.0)
+    verdicts = compare(ingest_doc(), ingest_doc(eps=2_000_000.0),
+                       ratio=3.0, watched=WATCHED_INGEST)
+    assert verdicts[0]["ok"] is False
+    assert "<" in verdicts[0]["note"]
+
+
+def test_min_prefix_missing_metric_still_skips():
+    verdicts = compare(ingest_doc(), {"cells": {}},
+                       watched=WATCHED_INGEST)
+    assert verdicts[0]["ok"] is None
+    assert "skipped" in verdicts[0]["note"]
+
+
+def test_ingest_watch_list_matches_the_ingest_artifact():
+    # the ISSUE 11 satellite: the CI ingest step watches the sharded
+    # binary eps cell from the committed artifact — the path (behind
+    # its min: direction prefix) must resolve
+    path = os.path.join(REPO, "BENCH_INGEST_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_INGEST:
+        assert metric.startswith("min:")
+        value = dig(committed, metric[4:])
+        assert isinstance(value, (int, float)), metric
 
 
 def test_explicit_watch_list_overrides_default():

@@ -19,7 +19,10 @@ Watched metrics default to the serving-RPC artifact's
 batch latency); ``--watch`` overrides the list for other artifacts —
 the CI chaos step passes ``--watch recovery_s.p50`` against
 ``BENCH_CHAOS_CPU.json`` (supervisor-measured recovery latency, the
-resilience layer's own p50). The promotion window is NOT guarded: its
+resilience layer's own p50), and the ingest step passes
+``--watch min:cells.c4_binary.eps`` against ``BENCH_INGEST_CPU.json``:
+the ``min:`` prefix marks a THROUGHPUT metric, whose regression
+direction is downward (fresh must stay >= committed / ratio). The promotion window is NOT guarded: its
 latency is dominated by the configured lease timeout, which is a
 correctness parameter, not a perf trajectory. ``resume_wall_s`` is not
 guarded either — it is dominated by interpreter/jax boot, a hosting
@@ -46,6 +49,11 @@ WATCHED = ("steady.p50_ms", "steady.p99_ms")
 #: the chaos-sweep artifact's guarded metric (BENCH_CHAOS_CPU.json)
 WATCHED_CHAOS = ("recovery_s.p50",)
 
+#: the ingest artifact's guarded metric (BENCH_INGEST_CPU.json):
+#: throughput, so HIGHER is better — the ``min:`` prefix flips the
+#: bound direction (fresh must stay above committed / ratio)
+WATCHED_INGEST = ("min:cells.c4_binary.eps",)
+
 #: a fresh value may be up to this many times the committed one
 DEFAULT_RATIO = 3.0
 
@@ -71,11 +79,18 @@ def compare(
     "ok", "note"}``. A metric missing from either side is reported
     (``ok=None``, a skip) rather than failed — an artifact-shape change
     must read as 'benchguard needs updating', not as a perf regression.
-    A committed value of 0 cannot bound anything and also skips."""
+    A committed value of 0 cannot bound anything and also skips.
+
+    Latency-shaped metrics (the default) regress UPWARD: fresh must stay
+    at or below ``committed * ratio``. A metric spelled with a ``min:``
+    prefix (throughput — the ingest eps cells) regresses DOWNWARD:
+    fresh must stay at or above ``committed / ratio``."""
     out = []
     for metric in watched:
-        want = dig(committed, metric)
-        got = dig(fresh, metric)
+        lower_bound = metric.startswith("min:")
+        path = metric[4:] if lower_bound else metric
+        want = dig(committed, path)
+        got = dig(fresh, path)
         entry = {"metric": metric, "committed": want, "fresh": got,
                  "bound": None, "ok": None, "note": ""}
         if not isinstance(want, (int, float)) or \
@@ -83,6 +98,15 @@ def compare(
             entry["note"] = "missing on one side; skipped"
         elif want <= 0:
             entry["note"] = "committed value is 0; nothing to bound"
+        elif lower_bound:
+            bound = want / ratio
+            entry["bound"] = round(bound, 3)
+            entry["ok"] = bool(got >= bound)
+            if not entry["ok"]:
+                entry["note"] = (
+                    f"{got:.3f} < {bound:.3f} "
+                    f"({got / want:.2f}x the committed {want:.3f})"
+                )
         else:
             bound = want * ratio
             entry["bound"] = round(bound, 3)

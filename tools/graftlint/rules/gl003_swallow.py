@@ -42,10 +42,15 @@ from ..graph import module_view
 _BROAD = {"Exception", "BaseException"}
 
 #: modules whose worker/handler threads sit directly on sockets; broad
-#: handlers here must leave registry evidence (check #2)
+#: handlers here must leave registry evidence (check #2). ISSUE 11 adds
+#: the sharded ingest module: its per-shard reader threads own sockets
+#: the same way the RPC handler threads do, and its fuzz contract
+#: ("every malformed frame is a counted source.malformed_frames{kind}")
+#: is only structural under the same bar.
 THREADED_SOCKET_MODULES = (
     "serving/rpc.py",
     "serving/client.py",
+    "core/ingest.py",
 )
 
 #: calls that count as "left registry evidence": instrument factories
