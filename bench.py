@@ -2237,6 +2237,85 @@ def main():
             sys.exit(1)
         return
 
+    if "--serving" in sys.argv and "--sharded" in sys.argv:
+        # sharded serving (ISSUE 12): shard replicas + the routing tier
+        # as real processes — aggregate QPS scaling across 1/2/4
+        # shards, Zipfian latency with the hot-key cache off vs on
+        # (the headline compares the 2-shard cached tier against a
+        # single replica on the same box), cross-shard CC answers
+        # checked oracle-identical, a traced batch joining client ->
+        # router -> both shards, and a kill-one-shard point where only
+        # that shard's keyspace sees the outage (its standby promotes;
+        # the other shard's keys see zero failures).
+        import tempfile
+
+        from gelly_streaming_tpu.resilience.chaos import (
+            run_sharded_scenario,
+        )
+
+        root = tempfile.mkdtemp(prefix="bench_sharded_")
+        # --smoke (the CI liveness step): shrunken geometry + shorter
+        # measure windows, nothing committed. The ok verdict still
+        # computes, but a smoke run is a liveness probe, not the
+        # committed perf claim — its CI step is non-blocking for the
+        # same hosting-noise reason as the ingest smoke.
+        smoke = "--smoke" in sys.argv
+        if smoke:
+            artifact = None
+            obs_log = os.path.join(root, "obs_smoke.jsonl")
+            kw = dict(
+                n_edges=1 << 13, measure_s=1.0, oracle_checks=128,
+                post_kill_batches=10,
+            )
+        else:
+            artifact = "BENCH_SERVING_SHARDED_CPU.json"
+            obs_log = "BENCH_SERVING_SHARDED_CPU_OBS.jsonl"
+            kw = {}
+        obs_f = open(obs_log, "w")
+        scenario_ok = False
+        try:
+            doc = run_sharded_scenario(root, log=log, obs_f=obs_f, **kw)
+            scenario_ok = bool(doc.get("ok"))
+        finally:
+            obs_f.close()
+            import shutil
+
+            # the run directory (replica/router logs, portfiles,
+            # un-shipped event streams) IS the post-mortem for a failed
+            # scenario — keep it unless the run passed (or is a smoke
+            # probe, whose geometry makes its numbers uncommittable)
+            if (scenario_ok or smoke) and os.path.isdir(root):
+                shutil.rmtree(root, ignore_errors=True)
+            elif not scenario_ok:
+                log(f"serving-sharded: scenario artifacts kept at "
+                    f"{root} for post-mortem")
+        doc["platform"] = "cpu-xla"
+        if artifact is not None:
+            doc["obs_log"] = obs_log
+            with open(artifact, "w") as f:
+                json.dump(doc, f, indent=2)
+        log(f"serving-sharded: ok={doc['ok']} "
+            f"scaling={ {k: v['qps'] for k, v in doc['scaling'].items()} } "
+            f"headline={doc['headline']} "
+            f"kill={doc.get('shard_kill', {}).get('promoted')}")
+        print(json.dumps({
+            "metric": "serving_sharded_headline_qps",
+            "value": doc["headline"]["qps"],
+            "unit": "queries_per_second",
+            "vs_single_x": doc["headline"]["vs_single_x"],
+            "scaling": {k: v["qps"] for k, v in doc["scaling"].items()},
+            "zipf_cache_on_p50_ms": doc["zipf"]["cache_on"]["p50_ms"],
+            "zipf_cache_off_p50_ms": doc["zipf"]["cache_off"]["p50_ms"],
+            "oracle_mismatches": doc["oracle"]["mismatches"],
+            "joined_trace": doc["trace"]["joined_trace"],
+            "ok": doc["ok"],
+            "artifact": artifact,
+            "obs_log": obs_log if artifact else None,
+        }))
+        if not doc["ok"]:
+            sys.exit(1)
+        return
+
     if "--serving" in sys.argv and "--rpc" in sys.argv:
         # wire-level serving resilience (ISSUE 8): a primary + standby
         # serving BINARY pair on a shared snapshot directory, a

@@ -308,6 +308,39 @@ def partition_edges(
     return out
 
 
+def vertex_owner(ids, nshards: int) -> np.ndarray:
+    """Deterministic vertex -> shard assignment for SERVING keyspace
+    partitioning: the one vertex rule, DERIVED from :func:`shard_of`
+    (a vertex is the degenerate edge ``(v, v)``) so producers, the
+    query router, and the oracle tests all agree through one hash."""
+    return shard_of(ids, ids, nshards)
+
+
+def partition_edges_by_vertex(
+    src, dst, val=None, nshards: int = 1
+) -> List[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+    """Split edge columns by VERTEX ownership: each edge is delivered
+    to the owner of EACH endpoint (one copy when both endpoints share
+    an owner), stream order preserved within each shard.
+
+    This is the sharded-serving delivery rule (:func:`vertex_owner`):
+    a vertex's owner shard receives every edge incident to it, so
+    per-vertex answers (degree, rank mass) are owner-complete, while
+    global connectivity stays reconstructable as the union of per-shard
+    summaries (every edge lives in at least one shard)."""
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    os_ = vertex_owner(src, nshards)
+    od = vertex_owner(dst, nshards)
+    out = []
+    for i in range(nshards):
+        m = (os_ == i) | (od == i)
+        out.append((
+            src[m], dst[m], None if val is None else np.asarray(val)[m]
+        ))
+    return out
+
+
 # --------------------------------------------------------------------- #
 # The sharded source
 # --------------------------------------------------------------------- #

@@ -478,9 +478,18 @@ class CCServable:
     need per-window snapshot pinning should run ``superbatch=1``."""
 
     def __init__(self, agg, vdict=None):
-        from ..serving import ComponentSizeQuery, ConnectedQuery
+        from ..serving import (
+            ComponentSizeQuery,
+            ConnectedQuery,
+            SummaryPullQuery,
+        )
 
-        self.query_classes = (ConnectedQuery, ComponentSizeQuery)
+        # SummaryPullQuery makes the servable ROUTABLE: a shard router
+        # pulls the forest as a raw-id mergeable summary (the
+        # cross-shard union input) through the same query wire
+        self.query_classes = (
+            ConnectedQuery, ComponentSizeQuery, SummaryPullQuery,
+        )
         self._agg = agg
         self._vdict = vdict
 

@@ -84,6 +84,17 @@ STORY = {
     "source.malformed_frames": "MALFORMED",
     "source.backpressure_stalls": "INGEST-STALL",
     "source.backpressure_resumes": "INGEST-RESUME",
+    # the sharded-serving story (ISSUE 12): the router's cross-shard
+    # merge refreshes (one per shard snapshot-version bump, not per
+    # query), merge failures, per-shard fan-out errors (the signal a
+    # single shard's outage leaves while the other shards keep
+    # answering), and hot-key cache invalidations — so a shard kill
+    # under a router renders as DISCONNECT / SHARD-ERROR / LEASE-LAPSE
+    # / PROMOTE with the router's own lines interleaved
+    "router.pulls": "CC-PULL",
+    "router.pull_errors": "PULL-ERROR",
+    "router.shard_errors": "SHARD-ERROR",
+    "router.cache_invalidations": "CACHE-INVAL",
     "flight": "BLACKBOX",
 }
 

@@ -29,6 +29,11 @@ stack:
 - :mod:`stats` — per-query-class latency histograms + staleness gauges,
   exported as plain dict snapshots (metrics stay ordinary output
   streams, the reference's design stance).
+- :mod:`router` — :class:`ShardRouter`: the sharded-serving tier —
+  vertex-ownership partition over N shard servers, scatter-gather
+  fan-out with per-class merges (cross-shard CC union via summary
+  pulls + the group-fold merge), a version-stamped hot-key answer
+  cache, and per-shard failover through each shard's address list.
 
 Workloads opt in via a small ``servable()`` adapter
 (``library/connected_components.py``, ``library/degrees.py``,
@@ -45,6 +50,7 @@ from .query import (
     Query,
     QueryEngine,
     RankQuery,
+    SummaryPullQuery,
 )
 from ..resilience.errors import DeadlineExceeded
 from ..resilience.retry import RetryPolicy
@@ -67,6 +73,7 @@ _LAZY = {
     "RpcServer": ".rpc",
     "RpcClient": ".client",
     "RpcError": ".client",
+    "ShardRouter": ".router",
 }
 
 
@@ -101,9 +108,11 @@ __all__ = [
     "RpcServer",
     "Servable",
     "ServingStats",
+    "ShardRouter",
     "Shed",
     "SnapshotMirror",
     "SnapshotStore",
     "StreamServer",
+    "SummaryPullQuery",
     "follow_snapshots",
 ]

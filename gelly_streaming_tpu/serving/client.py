@@ -79,10 +79,14 @@ class _Batch:
     :class:`~gelly_streaming_tpu.obs.trace.TraceContext` (None when
     tracing was off at submit): every send — first, retry, reconnect
     resubmit — rides the SAME context, so server-side spans on every
-    replica that ever touched the batch join one trace."""
+    replica that ever touched the batch join one trace. ``parent_sid``
+    is the span the batch ROOT parents to when the caller handed in an
+    upstream context (the router's fan-out span) — None for a true
+    root."""
 
     __slots__ = ("id", "enc", "futures", "deadline_abs",
-                 "attempts", "routes", "ctx", "t0", "t_send", "t_resp")
+                 "attempts", "routes", "ctx", "parent_sid",
+                 "t0", "t_send", "t_resp")
 
     def __init__(self, qid: str, enc: list, futures: list,
                  deadline_abs: Optional[float]):
@@ -93,6 +97,7 @@ class _Batch:
         self.attempts = 0   # overloaded re-asks
         self.routes = 0     # not_primary re-asks
         self.ctx = None
+        self.parent_sid = None
         self.t0 = 0.0       # perf_counter at submit (e2e measurement)
         self.t_send = 0.0   # perf_counter at the LAST send attempt
         self.t_resp = 0.0   # perf_counter when the RESP frame arrived
@@ -187,12 +192,19 @@ class RpcClient:
         queries: Sequence[Query],
         *,
         deadline_s: Optional[float] = None,
+        ctx=None,
     ) -> List["Future[Answer]"]:
         """Send one query batch; one future per query. ``deadline_s``
         bounds each query's TOTAL budget — network, retries, reconnects,
         and the server-side wait all spend it; expiry fails the future
         with :class:`DeadlineExceeded` (client- or server-side,
-        whichever notices first)."""
+        whichever notices first).
+
+        ``ctx`` (optional, tracing only) is an UPSTREAM
+        :class:`~gelly_streaming_tpu.obs.trace.TraceContext` to join:
+        the batch stays on that trace id and its root span parents to
+        ``ctx.parent_sid`` — the hop a fan-out router makes so client,
+        router, and shard spans form one causal tree."""
         if self._closing.is_set():
             raise RuntimeError("rpc client is closed")
         enc = encode_queries(queries)
@@ -207,10 +219,18 @@ class RpcClient:
         if _trace.on():
             # mint ONE context per batch; its parent sid is reserved
             # now so server-side spans can parent to the client's root
-            # span before that root is emitted (at settle)
-            batch.ctx = _trace.TraceContext(
-                parent_sid=_trace.next_sid()
-            )
+            # span before that root is emitted (at settle). With an
+            # upstream ctx the trace id is INHERITED, not minted.
+            if ctx is not None:
+                batch.ctx = _trace.TraceContext(
+                    trace_id=ctx.trace_id,
+                    parent_sid=_trace.next_sid(),
+                )
+                batch.parent_sid = ctx.parent_sid
+            else:
+                batch.ctx = _trace.TraceContext(
+                    parent_sid=_trace.next_sid()
+                )
         with self._lock:
             self._pending[qid] = batch
         wire = self._wire
@@ -227,8 +247,11 @@ class RpcClient:
         return futures
 
     def submit(self, query: Query, *,
-               deadline_s: Optional[float] = None) -> "Future[Answer]":
-        return self.submit_batch([query], deadline_s=deadline_s)[0]
+               deadline_s: Optional[float] = None,
+               ctx=None) -> "Future[Answer]":
+        return self.submit_batch(
+            [query], deadline_s=deadline_s, ctx=ctx
+        )[0]
 
     def ask_batch(
         self,
@@ -580,6 +603,7 @@ class RpcClient:
                 "rpc.client.batch", e2e_s,
                 trace_id=batch.ctx.trace_id,
                 sid=batch.ctx.parent_sid,
+                parent=batch.parent_sid,
                 attrs={"n": len(batch.futures),
                        "attempts": batch.attempts,
                        "routes": batch.routes,
@@ -595,6 +619,10 @@ class RpcClient:
                     self._set_res(f, Answer(
                         value=a[1], window=int(a[2]),
                         watermark=int(a[3]), staleness=int(a[4]),
+                        # the snapshot version rides newer servers'
+                        # replies (cache-invalidation key); absent on a
+                        # v1 peer's answers, which read as version 0
+                        version=int(a[5]) if len(a) > 5 else 0,
                     ))
                 elif a[0] == "deadline":
                     # a SERVER-reported expiry (the answer rode a RESP

@@ -96,15 +96,32 @@ class ComponentSizeQuery(Query):
 
 
 @dataclass(frozen=True)
+class SummaryPullQuery(Query):
+    """Pull this snapshot's CC forest as a mergeable summary (the
+    sharded-serving router's cross-shard union input): per seen slot,
+    the RAW vertex id and its component root's RAW id, as packed
+    little-endian int64 columns (base64 in the JSON answer value).
+    RAW-id space is the join key — per-shard compact ids never leave
+    their shard. O(vcap) per snapshot version, cached by the engine, so
+    any number of pulls per version cost one canonicalization."""
+
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
 class Answer:
     """One query's result, stamped with the snapshot it was answered
     from: ``window`` is that snapshot's window index, ``staleness`` the
-    windows-behind-head gap at answer time (0 = answered at the head)."""
+    windows-behind-head gap at answer time (0 = answered at the head),
+    ``version`` the snapshot's publish version — the monotone counter a
+    routing tier keys its cache invalidation on (reply frames carry it,
+    so a router learns of shard progress from ordinary answers)."""
 
     value: Any
     window: int
     watermark: int
     staleness: int
+    version: int = 0
 
 
 # --------------------------------------------------------------------- #
@@ -205,6 +222,7 @@ class QueryEngine:
     PAYLOAD_KEYS = {
         ConnectedQuery: "labels",
         ComponentSizeQuery: "labels",
+        SummaryPullQuery: "labels",
         DegreeQuery: "deg",
         RankQuery: "ranks",
     }
@@ -217,6 +235,9 @@ class QueryEngine:
             None, None, None,
         )
         self._host_cache: dict = {}  # (version, payload key) -> np array
+        self._pull_cache: Tuple[Optional[int], Optional[dict]] = (
+            None, None,
+        )
 
     # -- table access (per-version host cache on the host path) -------- #
     def _table(self, snap: PublishedSnapshot, key: str):
@@ -302,6 +323,47 @@ class QueryEngine:
             )[: len(cv)]
         return np.where(valid, out, 0).astype(np.int64)
 
+    def summary_pull(self, snap: PublishedSnapshot) -> dict:
+        """The snapshot's CC forest as a mergeable raw-id summary (the
+        :class:`SummaryPullQuery` answer value)::
+
+            {"n": slots, "u64": b64(int64 raw ids),
+             "r64": b64(int64 root raw ids)}
+
+        Slot coverage is what the payload's vertex dict can decode
+        (``len(vdict)`` slots): the shard's SEEN keyspace. Deployments
+        that want untouched in-bound ids to count as singletons (the
+        ``IdentityDict`` single-host semantics) observe their bound up
+        front, like the serving demos do. Cached per snapshot version —
+        the O(vcap) canonicalize + decode runs once however many
+        routers pull."""
+        import base64
+
+        ver, cached = self._pull_cache
+        if ver == snap.version and cached is not None:
+            return cached
+        from ..summaries.forest import resolve_flat_host
+
+        canon = np.asarray(self._table(snap, "labels"))
+        vdict = snap.payload["vdict"]
+        lab = resolve_flat_host(canon)
+        n = min(int(lab.shape[0]), len(vdict))
+        slots = np.arange(n, dtype=np.int64)
+        raws = np.asarray(vdict.decode(slots), np.int64)
+        # min-rooted invariant: lab[i] <= i, so every root of the first
+        # n slots is itself within the first n slots
+        roots = np.asarray(vdict.decode(lab[:n].astype(np.int64)),
+                           np.int64)
+        doc = {
+            "n": int(n),
+            "u64": base64.b64encode(
+                np.ascontiguousarray(raws).tobytes()).decode("ascii"),
+            "r64": base64.b64encode(
+                np.ascontiguousarray(roots).tobytes()).decode("ascii"),
+        }
+        self._pull_cache = (snap.version, doc)
+        return doc
+
     def degree(self, snap: PublishedSnapshot, vs: np.ndarray) -> np.ndarray:
         return self._table_gather(snap, "deg", vs, fill=0)
 
@@ -349,6 +411,17 @@ class QueryEngine:
                     f"snapshot payload (keys {sorted(snap.payload)}) does "
                     f"not serve {qcls.__name__}"
                 )
+            if qcls is SummaryPullQuery:
+                # one cached doc answers the whole group (dict-valued,
+                # so it bypasses the ndarray tail below)
+                doc = self.summary_pull(snap)
+                for i in idxs:
+                    out[i] = Answer(
+                        value=doc, window=snap.window,
+                        watermark=snap.watermark, staleness=staleness,
+                        version=snap.version,
+                    )
+                continue
             if qcls is ConnectedQuery:
                 us = np.asarray([queries[i].u for i in idxs], np.int64)
                 vs = np.asarray([queries[i].v for i in idxs], np.int64)
@@ -365,5 +438,6 @@ class QueryEngine:
                 out[i] = Answer(
                     value=v, window=snap.window,
                     watermark=snap.watermark, staleness=staleness,
+                    version=snap.version,
                 )
         return out  # type: ignore[return-value]

@@ -1,0 +1,1082 @@
+"""Sharded serving: multi-shard query fan-out with scatter-gather merge.
+
+Until now every replica answered from ONE whole snapshot: a keyspace
+bigger than one host's memory was unservable and query throughput was
+capped by a single serving worker. This module is the routing tier in
+front of N shard servers:
+
+- **One partition rule.** The vertex-id space is partitioned by
+  :func:`~gelly_streaming_tpu.core.ingest.vertex_owner` — derived from
+  ``shard_of``, the SAME endpoint hash the sharded-ingest wire uses —
+  and each shard ingests the edges incident to the vertices it owns
+  (:func:`~gelly_streaming_tpu.core.ingest.partition_edges_by_vertex`:
+  every edge reaches the owner of each endpoint, so per-vertex answers
+  are owner-complete and every edge lives in at least one shard).
+- **Scatter-gather fan-out.** :class:`ShardRouter` drains concurrent
+  submissions in sweeps (the serving worker's coalescing discipline),
+  splits each sweep's degree/rank queries into per-owner sub-batches,
+  fans them to the owning shards in parallel over the existing GSRP
+  wire (one :class:`~.client.RpcClient` per shard — idempotent batch
+  ids, reconnect-and-resubmit, per-shard failover all inherited), and
+  merges the partial answer lists back into submission order. Each
+  query spends ONE deadline end-to-end: the budget is pinned at
+  admission and every shard call ships only what REMAINS.
+- **Cross-shard union for CC.** Connectivity queries cannot be answered
+  by any single shard (a component may span shards through boundary
+  vertices), so the router pulls each shard's forest summary
+  (:class:`~.query.SummaryPullQuery` — raw-id ``(vertex, root)``
+  columns) and merges them with the group-fold union step
+  (:func:`~gelly_streaming_tpu.summaries.forest.fold_edges_host`): the
+  union of per-shard spanning forests has exactly the components of the
+  union of per-shard edge sets, so ``connected``/``component size``
+  answers are byte-identical to a single host folding the whole
+  stream. Pulls are per shard snapshot VERSION (lazy, cached), not per
+  query.
+- **Hot-key answer cache.** A bounded LRU keyed on
+  ``(query class, vertex key)`` and STAMPED with the shard snapshot
+  versions the answer was computed from. Reply frames carry each
+  shard's snapshot version; a version bump observed in any reply
+  lazily invalidates stale entries at their next lookup (counted).
+  Power-law traffic — millions of users hammering a small hot set —
+  short-circuits the fan-out entirely on the hit path.
+  ``cache_ttl_s`` optionally bounds hit age for deployments whose
+  traffic could go 100% hot (no misses means no version observations).
+
+Observability: ``router.cache_hits`` / ``router.cache_misses`` /
+``router.cache_invalidations``, ``router.fanouts``, ``router.pulls`` /
+``router.pull_errors{shard}``, ``router.stale_merges``, and — with
+tracing on — one ``serving.router.fanout`` span per traced wire batch,
+parented under the client's batch root, with every shard sub-batch's
+spans parented under IT: one trace joins client, router, and every
+shard that answered.
+
+``python -m gelly_streaming_tpu.serving.router --router '<json cfg>'``
+runs the router as a standalone binary (an :class:`~.rpc.RpcServer`
+front end over the fan-out), the shape the sharded bench deploys.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future, InvalidStateError
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.ingest import vertex_owner
+from ..obs import trace as _trace
+from ..obs.registry import get_registry
+from .client import RpcClient
+from .query import (
+    Answer,
+    ComponentSizeQuery,
+    ConnectedQuery,
+    DegreeQuery,
+    Query,
+    RankQuery,
+    SummaryPullQuery,
+)
+from .server import Overloaded
+
+#: hot-key LRU capacity default (answers, not bytes: each entry is one
+#: Answer + a version stamp)
+DEFAULT_CACHE_CAP = 8192
+
+#: query classes the router serves (fan-out or merged-forest path)
+ROUTED_CLASSES = (
+    ConnectedQuery, ComponentSizeQuery, DegreeQuery, RankQuery,
+)
+
+
+def _b64_i64(s: str) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(s), dtype="<i8")
+
+
+def decode_pull(doc: dict) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode a :meth:`~.query.QueryEngine.summary_pull` answer value
+    into ``(raw vertex ids, raw root ids)`` int64 columns. Raises
+    ``ValueError`` on a malformed doc (wrong length vs ``n``, missing
+    keys) — a torn summary must never silently merge as empty."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"summary pull answered {type(doc).__name__}")
+    n = int(doc["n"])
+    u = _b64_i64(doc["u64"])
+    r = _b64_i64(doc["r64"])
+    if len(u) != n or len(r) != n:
+        raise ValueError(
+            f"summary pull geometry mismatch: n={n}, got "
+            f"{len(u)}/{len(r)} ids"
+        )
+    return u, r
+
+
+class _Entry:
+    """One admitted query riding the router's pending queue."""
+
+    __slots__ = ("q", "f", "t0", "dl", "ctx", "grp", "key", "done")
+
+    def __init__(self, q, f, t0, dl, ctx):
+        self.q = q
+        self.f = f
+        self.t0 = t0
+        self.dl = dl
+        self.ctx = ctx
+        self.grp = None
+        self.key = None
+        self.done = False
+
+
+class _Group:
+    """Per-(traced wire batch, sweep) fan-out accounting: the
+    ``serving.router.fanout`` span is emitted when the LAST entry of
+    the group settles, so its duration covers the whole scatter-gather
+    including the slowest shard."""
+
+    __slots__ = ("ctx", "sid", "t0", "left", "hits", "misses",
+                 "shards", "lock")
+
+    def __init__(self, ctx, sid: int, t0: float, left: int):
+        self.ctx = ctx
+        self.sid = sid
+        self.t0 = t0
+        self.left = left
+        self.hits = 0
+        self.misses = 0
+        self.shards: set = set()
+        self.lock = threading.Lock()
+
+    def done_one(self) -> bool:
+        with self.lock:
+            self.left -= 1
+            return self.left == 0
+
+
+class _CacheEntry:
+    """``owner`` is the key's owning shard for owner-routed classes
+    (so validity checks one version slot without re-hashing), None for
+    router-merged classes (validity checks the whole vector)."""
+
+    __slots__ = ("ans", "vers", "ts", "owner")
+
+    def __init__(self, ans: Answer, vers: tuple, ts: float,
+                 owner: Optional[int]):
+        self.ans = ans
+        self.vers = vers
+        self.ts = ts
+        self.owner = owner
+
+
+class ShardRouter:
+    """Scatter-gather query router over N shard serving replicas.
+
+    ``shard_addrs`` is one address LIST per shard (give each shard's
+    primary AND standby; the per-shard :class:`~.client.RpcClient`
+    cycles them, so each shard fails over independently without the
+    router noticing beyond a latency blip). The router has the same
+    ``submit``/``ask`` surface as a ``StreamServer`` — put it behind an
+    :class:`~.rpc.RpcServer` and clients cannot tell it from a single
+    replica.
+
+    Merge semantics per query class (the contract README documents):
+
+    - ``DegreeQuery`` / ``RankQuery``: routed to the key's OWNER shard,
+      whose partial is the whole answer (the delivery rule hands every
+      incident edge to the owner); the router's merge re-interleaves
+      per-shard sub-batch answers into submission order. Rank is exact
+      only as far as the shard's local summary is (an edge-subset
+      PageRank is the shard's declared partial).
+    - ``ConnectedQuery`` / ``ComponentSizeQuery``: answered at the
+      router from the merged cross-shard forest (see module docstring);
+      ``window`` is the MINIMUM shard window merged (the conservative
+      progress claim), ``watermark`` the sum, ``staleness`` the max,
+      ``version`` the sum of shard versions (monotone under any bump).
+
+    A cache hit re-serves the answer computed at its stamped snapshot
+    versions; the invalidation contract bounds how stale a hit can be:
+    any reply frame observing a newer shard version invalidates the
+    entry at its next lookup, and ``cache_ttl_s`` (optional) bounds the
+    window in which NO reply was observed at all.
+    """
+
+    def __init__(
+        self,
+        shard_addrs: Sequence,
+        *,
+        max_pending: int = 1 << 14,
+        cache: bool = True,
+        cache_cap: int = DEFAULT_CACHE_CAP,
+        cache_ttl_s: Optional[float] = None,
+        client_factory=None,
+        seed: int = 0,
+    ):
+        if not shard_addrs:
+            raise ValueError("at least one shard address is required")
+        factory = client_factory or (
+            lambda addrs, i: RpcClient(addrs, seed=seed + i)
+        )
+        self._clients: List[RpcClient] = [
+            factory(a if isinstance(a, (list, tuple)) and not (
+                isinstance(a, tuple) and len(a) == 2
+                and isinstance(a[1], int)
+            ) else [a], i)
+            for i, a in enumerate(shard_addrs)
+        ]
+        self.nshards = len(self._clients)
+        self.max_pending = int(max_pending)
+        self.cache_enabled = bool(cache)
+        self.cache_cap = int(cache_cap)
+        self.cache_ttl_s = cache_ttl_s
+        self._cache: "OrderedDict[tuple, _CacheEntry]" = OrderedDict()
+        self._lock = threading.Lock()       # pending/admission/cache
+        self._pending: deque = deque()
+        self._inflight = 0
+        self._wake = threading.Event()
+        self._closing = False
+        # merged cross-shard CC state (all under _mlock)
+        self._mlock = threading.Lock()
+        self._vers = [0] * self.nshards       # newest observed version
+        self._pulled_vers = [-1] * self.nshards
+        self._pairs: list = [None] * self.nshards   # (u_raw, r_raw)
+        self._pull_meta: list = [None] * self.nshards  # (win, wm, stale)
+        self._pulls: dict = {}                # shard -> in-flight pull
+        self._pull_err: list = [None] * self.nshards
+        self._cc_waiting: list = []           # jobs parked on pulls
+        self._merged = None                   # (uniq, lab, sizes, meta)
+        # hot-path instruments resolved once (a cache hit should cost
+        # a dict probe + a counter bump, not two registry lookups)
+        reg = get_registry()
+        self._c_hits = reg.counter("router.cache_hits")
+        self._c_misses = reg.counter("router.cache_misses")
+        self._c_inval = reg.counter("router.cache_invalidations")
+        self._worker = threading.Thread(
+            target=self._run, name="shard-router", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------------ #
+    # Submission surface (StreamServer.submit contract)
+    # ------------------------------------------------------------------ #
+    def submit(
+        self,
+        query: Query,
+        *,
+        deadline_s: Optional[float] = None,
+        ctx=None,
+    ) -> "Future[Answer]":
+        """Admit one query; resolves to a merged :class:`Answer`.
+        Raises :class:`~.server.Overloaded` at the admission limit and
+        ``TypeError`` for classes the router cannot merge. The deadline
+        is a TOTAL budget pinned here: cache lookup, fan-out, shard
+        retries, and merge all spend the one clock."""
+        if not isinstance(query, ROUTED_CLASSES):
+            raise TypeError(
+                f"ShardRouter routes "
+                f"{[c.__name__ for c in ROUTED_CLASSES]}, not "
+                f"{type(query).__name__}"
+            )
+        t0 = time.perf_counter()
+        dl = None if deadline_s is None else t0 + float(deadline_s)
+        if ctx is None and _trace.on():
+            ctx = _trace.current_context()
+        e = _Entry(query, Future(), t0, dl, ctx)
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("router is closed")
+            admitted = len(self._pending) + self._inflight
+            if admitted >= self.max_pending:
+                get_registry().counter("router.rejected").inc()
+                raise Overloaded(
+                    f"{admitted} queries in flight at the router "
+                    f"(max_pending={self.max_pending})"
+                )
+            self._pending.append(e)
+        self._wake.set()
+        return e.f
+
+    def submit_many(
+        self,
+        queries,
+        *,
+        deadline_s: Optional[float] = None,
+        ctx=None,
+    ) -> list:
+        """Admit a whole wire batch under ONE lock acquisition (the
+        RPC front end's fast path; all-or-nothing admission, like
+        ``StreamServer.submit_many``)."""
+        for q in queries:
+            if not isinstance(q, ROUTED_CLASSES):
+                raise TypeError(
+                    f"ShardRouter routes "
+                    f"{[c.__name__ for c in ROUTED_CLASSES]}, not "
+                    f"{type(q).__name__}"
+                )
+        t0 = time.perf_counter()
+        dl = None if deadline_s is None else t0 + float(deadline_s)
+        if ctx is None and _trace.on():
+            ctx = _trace.current_context()
+        entries = [_Entry(q, Future(), t0, dl, ctx) for q in queries]
+        with self._lock:
+            if self._closing:
+                raise RuntimeError("router is closed")
+            admitted = len(self._pending) + self._inflight
+            if admitted + len(queries) > self.max_pending:
+                get_registry().counter("router.rejected").inc()
+                raise Overloaded(
+                    f"{admitted} queries in flight at the router "
+                    f"(max_pending={self.max_pending})"
+                )
+            self._pending.extend(entries)
+        self._wake.set()
+        return [e.f for e in entries]
+
+    def ask(self, query: Query, timeout: Optional[float] = None,
+            deadline_s: Optional[float] = None) -> Answer:
+        return self.submit(query, deadline_s=deadline_s).result(timeout)
+
+    def ask_batch(
+        self,
+        queries: Sequence[Query],
+        *,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Answer]:
+        futures = [
+            self.submit(q, deadline_s=deadline_s) for q in queries
+        ]
+        # one budget across the whole wait (GL008)
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        return [
+            f.result(
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            for f in futures
+        ]
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending) + self._inflight
+
+    def health(self) -> dict:
+        with self._lock:
+            cache_n = len(self._cache)
+            pending = len(self._pending) + self._inflight
+        return {
+            "shards": self.nshards,
+            "pending": pending,
+            "cache_entries": cache_n,
+            "shard_versions": list(self._vers),
+            "ok": self._worker.is_alive(),
+        }
+
+    def stats_snapshot(self) -> dict:
+        """Router counters as a plain dict (cache hit/miss/invalidation
+        evidence the bench commits)."""
+        reg = get_registry()
+
+        def _count(name: str) -> int:
+            return int(sum(i.value for _l, i in reg.find(name)))
+
+        return {
+            "pending": self.pending(),
+            "cache_hits": _count("router.cache_hits"),
+            "cache_misses": _count("router.cache_misses"),
+            "cache_invalidations": _count("router.cache_invalidations"),
+            "fanouts": _count("router.fanouts"),
+            "pulls": _count("router.pulls"),
+            "pull_errors": _count("router.pull_errors"),
+            "stale_merges": _count("router.stale_merges"),
+            "rejected": _count("router.rejected"),
+        }
+
+    # ------------------------------------------------------------------ #
+    # Worker (drain-and-coalesce, like the serving worker)
+    # ------------------------------------------------------------------ #
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                batch = list(self._pending)
+                self._pending.clear()
+                self._inflight += len(batch)
+                closing = self._closing
+            if batch:
+                try:
+                    self._sweep(batch)
+                except BaseException as e:
+                    # the router worker must survive any sweep error —
+                    # a dead worker hangs every future forever
+                    get_registry().counter(
+                        "router.swallowed", site="sweep"
+                    ).inc()
+                    for e_ in batch:
+                        self._settle(e_, exc=e)
+                continue
+            if closing:
+                return
+            self._wake.wait(0.05)
+            self._wake.clear()
+
+    def _sweep(self, batch: List[_Entry]) -> None:
+        reg = get_registry()
+        now = time.perf_counter()
+        t_sweep = now
+        live: List[_Entry] = []
+        groups: dict = {}
+        tracing = _trace.on()
+        for e in batch:
+            if e.dl is not None and now > e.dl:
+                self._expire(e)
+                continue
+            e.key = self._cache_key(e.q)
+            if tracing and e.ctx is not None:
+                g = groups.get(id(e.ctx))
+                if g is None:
+                    g = _Group(e.ctx, _trace.next_sid(), t_sweep, 0)
+                    groups[id(e.ctx)] = g
+                g.left += 1
+                e.grp = g
+            live.append(e)
+        if not live:
+            return
+        # ---- cache pass (counters aggregated per sweep: a hot sweep
+        # must cost probes, not one event emission per query) --------- #
+        misses: List[_Entry] = []
+        n_hits = 0
+        for e in live:
+            hit = self._cache_get(e.key) if self.cache_enabled else None
+            if hit is not None:
+                if e.grp is not None:
+                    e.grp.hits += 1
+                n_hits += 1
+                self._settle(e, ans=hit)
+            else:
+                if e.grp is not None:
+                    e.grp.misses += 1
+                misses.append(e)
+        if n_hits:
+            self._c_hits.inc(n_hits)
+        if not misses:
+            return
+        if self.cache_enabled:
+            self._c_misses.inc(len(misses))
+        reg.counter("router.fanouts").inc()
+        # ---- split by path ------------------------------------------- #
+        dr: List[_Entry] = []      # owner fan-out classes
+        cc: List[_Entry] = []      # merged-forest classes
+        for e in misses:
+            (dr if isinstance(e.q, (DegreeQuery, RankQuery))
+             else cc).append(e)
+        if dr:
+            self._fan_out(dr)
+        if cc:
+            self._route_cc(cc)
+
+    # ------------------------------------------------------------------ #
+    # Degree / rank: owner fan-out
+    # ------------------------------------------------------------------ #
+    def _fan_out(self, entries: List[_Entry]) -> None:
+        owners = vertex_owner(
+            np.asarray([e.q.v for e in entries], np.int64), self.nshards
+        )
+        # sub-batch per (shard, trace group, has-deadline): untraced
+        # entries coalesce per shard; traced ones split per group so
+        # every shard batch stays on exactly one trace; deadline-less
+        # entries ride their own sub-batch so they neither STRIP the
+        # wire deadline from bounded peers (which would let a wedged
+        # shard hang them past their budget) nor inherit one
+        subs: dict = {}
+        for e, s in zip(entries, owners.tolist()):
+            subs.setdefault(
+                (s, id(e.grp) if e.grp else None, e.dl is None),
+                []).append(e)
+        for (s, _gk, dl_free), es in subs.items():
+            grp = es[0].grp
+            if grp is not None:
+                grp.shards.add(s)
+            now = time.perf_counter()
+            remaining = None
+            if not dl_free:
+                # the LOOSEST member deadline bounds the wire call; each
+                # entry still re-checks its own budget at settle
+                remaining = max(
+                    0.001, max(e.dl for e in es) - now)
+            ctx2 = None
+            if grp is not None:
+                ctx2 = _trace.TraceContext(
+                    trace_id=grp.ctx.trace_id, parent_sid=grp.sid
+                )
+            try:
+                futs = self._clients[s].submit_batch(
+                    [e.q for e in es], deadline_s=remaining, ctx=ctx2
+                )
+            except BaseException as exc:
+                # a synchronously-failing shard client (closed mid-
+                # sweep): the error reaches the callers, but it must
+                # ALSO leave fan-out evidence — an uncounted shard
+                # failure would make a partial outage invisible
+                get_registry().counter(
+                    "router.shard_errors", shard=str(s)
+                ).inc()
+                for e in es:
+                    self._settle(e, exc=exc)
+                continue
+            for e, f in zip(es, futs):
+                f.add_done_callback(partial(self._shard_done, e, s))
+
+    def _shard_done(self, e: _Entry, shard: int, fut) -> None:
+        """Shard answer callback (the shard client's io thread): settle
+        ONE entry — per-entry settling keeps a slow shard from holding
+        up answers that already arrived from faster shards."""
+        exc = fut.exception()
+        if exc is not None:
+            get_registry().counter(
+                "router.shard_errors", shard=str(shard)
+            ).inc()
+            self._settle(e, exc=exc)
+            return
+        ans = fut.result()
+        self._observe_version(shard, ans.version)
+        if self.cache_enabled:
+            self._cache_put(e.key, ans, (int(ans.version),),
+                            owner=shard)
+        self._settle(e, ans=ans)
+
+    # ------------------------------------------------------------------ #
+    # Connected / component size: merged cross-shard forest
+    # ------------------------------------------------------------------ #
+    def _route_cc(self, entries: List[_Entry]) -> None:
+        to_pull: list = []
+        ready = False
+        with self._mlock:
+            stale = [
+                s for s in range(self.nshards)
+                if self._pulled_vers[s] < max(1, self._vers[s])
+            ]
+            if not stale and self._merged is not None:
+                ready = True
+            else:
+                self._cc_waiting.append(entries)
+                for s in stale:
+                    if s not in self._pulls:
+                        self._pulls[s] = True
+                        to_pull.append(s)
+        if ready:
+            self._answer_cc(entries)
+            return
+        # fire pulls OUTSIDE the lock (socket sends must never run
+        # under router state locks)
+        now = time.perf_counter()
+        dls = [e.dl for e in entries if e.dl is not None]
+        # bound the pull by the LOOSEST bounded requester: a
+        # deadline-less co-swept entry must not make the pull (and the
+        # bounded entries parked on it) unexpirable against a wedged
+        # shard. Entries without a deadline accept the pull's outcome
+        # either way — a failed pull fails them visibly, and the next
+        # CC miss re-triggers a fresh pull.
+        remaining = max(0.001, max(dls) - now) if dls else None
+        # pulls serve EVERY parked group; attribute their spans to the
+        # first TRACED entry's group (a shared refresh has one causal
+        # home, and an untraced head entry must not orphan the join)
+        grp = next((e.grp for e in entries if e.grp is not None), None)
+        for s in to_pull:
+            get_registry().counter("router.pulls").inc()
+            ctx2 = None
+            if grp is not None:
+                grp.shards.add(s)
+                ctx2 = _trace.TraceContext(
+                    trace_id=grp.ctx.trace_id, parent_sid=grp.sid
+                )
+            try:
+                fut = self._clients[s].submit(
+                    SummaryPullQuery(), deadline_s=remaining, ctx=ctx2,
+                )
+            except BaseException as exc:
+                self._pull_done(s, _FailedFuture(exc))
+                continue
+            fut.add_done_callback(partial(self._pull_done, s))
+
+    def _pull_done(self, shard: int, fut) -> None:
+        jobs: list = []
+        with self._mlock:
+            self._pulls.pop(shard, None)
+            exc = fut.exception()
+            if exc is None:
+                try:
+                    ans = fut.result()
+                    u, r = decode_pull(ans.value)
+                    v = int(ans.version)
+                    self._pairs[shard] = (u, r)
+                    self._pulled_vers[shard] = v
+                    self._pull_meta[shard] = (
+                        int(ans.window), int(ans.watermark),
+                        int(ans.staleness),
+                    )
+                    self._pull_err[shard] = None
+                    cur = self._vers[shard]
+                    if v > cur:
+                        self._vers[shard] = v
+                    elif v + self.VERSION_RESTART_SLACK < cur:
+                        # the pull itself met a restarted sequence
+                        # (promoted standby): adopt it — pulled_vers
+                        # already records the new sequence's version
+                        get_registry().counter(
+                            "router.shard_restarts", shard=str(shard)
+                        ).inc()
+                        self._vers[shard] = v
+                except (ValueError, KeyError, TypeError) as e:
+                    exc = e
+            if exc is not None:
+                get_registry().counter(
+                    "router.pull_errors", shard=str(shard)
+                ).inc()
+                self._pull_err[shard] = exc
+                if self._pairs[shard] is not None:
+                    # a previous pull exists: the merge proceeds on the
+                    # stale summary (bounded-staleness availability)
+                    get_registry().counter("router.stale_merges").inc()
+            if self._pulls:
+                return  # later pulls complete the rendezvous
+            never = [
+                s for s in range(self.nshards)
+                if self._pairs[s] is None
+            ]
+            if not never:
+                self._rebuild_merged_locked()
+            jobs = self._cc_waiting
+            self._cc_waiting = []
+        if never:
+            # a shard that never delivered ANY summary cannot be merged
+            # around: exactness over availability at boot — fail these
+            # entries with the shard's own error
+            err = next(
+                (self._pull_err[s] for s in never
+                 if self._pull_err[s] is not None),
+                RuntimeError(f"shards {never} never delivered a "
+                             "summary pull"),
+            )
+            for entries in jobs:
+                for e in entries:
+                    self._settle(e, exc=err)
+            return
+        for entries in jobs:
+            self._answer_cc(entries)
+
+    def _rebuild_merged_locked(self) -> None:
+        """Rebuild the merged forest from the newest per-shard pulls.
+        Caller holds ``_mlock``. Each shard's raw-id pairs densify into
+        a forest table over the UNION id space (sorted raw order
+        preserves the min-rooted invariant), and one
+        :func:`~gelly_streaming_tpu.summaries.forest.merge_forest_tables_host`
+        call — THE cross-shard union step — merges them all."""
+        from ..summaries.forest import merge_forest_tables_host
+
+        us = [p[0] for p in self._pairs]
+        uniq = np.unique(np.concatenate(us)) if us else \
+            np.zeros(0, np.int64)
+        n = len(uniq)
+        tables = []
+        for u, r in self._pairs:
+            t = np.arange(n, dtype=np.int64)
+            t[np.searchsorted(uniq, u)] = np.searchsorted(uniq, r)
+            tables.append(t)
+        lab = merge_forest_tables_host(tables)
+        sizes = np.bincount(lab, minlength=n) if n else \
+            np.zeros(0, np.int64)
+        metas = [m for m in self._pull_meta if m is not None]
+        meta = (
+            min(m[0] for m in metas) if metas else -1,   # window
+            sum(m[1] for m in metas),                     # watermark
+            max(m[2] for m in metas) if metas else 0,     # staleness
+            sum(max(0, v) for v in self._pulled_vers),    # version
+        )
+        self._merged = (uniq, lab, sizes, meta,
+                        tuple(self._pulled_vers))
+
+    def _answer_cc(self, entries: List[_Entry]) -> None:
+        with self._mlock:
+            uniq, lab, sizes, meta, stamp = self._merged
+        window, watermark, staleness, version = meta
+        qs = [e.q for e in entries]
+        conn_idx = [i for i, q in enumerate(qs)
+                    if isinstance(q, ConnectedQuery)]
+        size_idx = [i for i, q in enumerate(qs)
+                    if isinstance(q, ComponentSizeQuery)]
+        vals: dict = {}
+        if conn_idx:
+            us = np.asarray([qs[i].u for i in conn_idx], np.int64)
+            vs = np.asarray([qs[i].v for i in conn_idx], np.int64)
+            iu, fu = self._lookup(uniq, us)
+            iv, fv = self._lookup(uniq, vs)
+            ok = fu & fv
+            same = lab[iu] == lab[iv]
+            # an unseen vertex is its own singleton — connected only to
+            # itself (the single-host engine's exact semantics)
+            got = np.where(ok, same, us == vs)
+            for i, v in zip(conn_idx, got.tolist()):
+                vals[i] = bool(v)
+        if size_idx:
+            vs = np.asarray([qs[i].v for i in size_idx], np.int64)
+            iv, fv = self._lookup(uniq, vs)
+            got = np.where(fv, sizes[lab[iv]], 0)
+            for i, v in zip(size_idx, got.tolist()):
+                vals[i] = int(v)
+        for i, e in enumerate(entries):
+            ans = Answer(
+                value=vals[i], window=window, watermark=watermark,
+                staleness=staleness, version=version,
+            )
+            if self.cache_enabled:
+                self._cache_put(e.key, ans, stamp)
+            self._settle(e, ans=ans)
+
+    @staticmethod
+    def _lookup(uniq: np.ndarray, raw: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        """(dense index, found mask) of raw ids in the merged id table;
+        missing ids index slot 0 with found=False."""
+        if len(uniq) == 0:
+            z = np.zeros(len(raw), np.int64)
+            return z, np.zeros(len(raw), bool)
+        i = np.searchsorted(uniq, raw)
+        i = np.minimum(i, len(uniq) - 1)
+        return i, uniq[i] == raw
+
+    # ------------------------------------------------------------------ #
+    # Cache
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _cache_key(q: Query) -> tuple:
+        if isinstance(q, ConnectedQuery):
+            u, v = int(q.u), int(q.v)
+            # connectivity is symmetric; one entry serves both orders
+            return ("C", min(u, v), max(u, v))
+        tag = {DegreeQuery: "D", RankQuery: "R",
+               ComponentSizeQuery: "S"}[type(q)]
+        return (tag, int(q.v))
+
+    def _cache_get(self, key: tuple) -> Optional[Answer]:
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is None:
+                return None
+            if self.cache_ttl_s is not None and \
+                    time.monotonic() - entry.ts > self.cache_ttl_s:
+                del self._cache[key]
+                self._c_inval.inc()
+                return None
+        expected = (
+            (self._vers[entry.owner],) if entry.owner is not None
+            else tuple(self._vers)
+        )
+        if entry.vers != expected:
+            # a reply frame observed a newer shard version than this
+            # answer was computed from: lazily invalidate (counted) —
+            # the next miss re-fans-out / re-pulls at the new version
+            with self._lock:
+                self._cache.pop(key, None)
+            self._c_inval.inc()
+            return None
+        with self._lock:
+            if key in self._cache:
+                self._cache.move_to_end(key)
+        return entry.ans
+
+    def _cache_put(self, key: tuple, ans: Answer, vers: tuple,
+                   owner: Optional[int] = None) -> None:
+        with self._lock:
+            self._cache[key] = _CacheEntry(
+                ans, vers, time.monotonic(), owner
+            )
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.cache_cap:
+                self._cache.popitem(last=False)
+
+    #: how far BELOW the observed high-water a reply's version may sit
+    #: before it reads as a RESTARTED sequence rather than ordinary
+    #: answer skew (prefer_ready serves up to READY_LOOKBACK=3 windows
+    #: behind head; sweeps add a little more)
+    VERSION_RESTART_SLACK = 8
+
+    def _observe_version(self, shard: int, version: int) -> None:
+        version = int(version)
+        if not version or version == self._vers[shard]:
+            return
+        with self._mlock:
+            cur = self._vers[shard]
+            if version > cur:
+                self._vers[shard] = version
+            elif version + self.VERSION_RESTART_SLACK < cur:
+                # a version sequence far below this shard's observed
+                # high-water: a promoted standby publishes from a FRESH
+                # store whose counter restarts at 1, so monotone
+                # ratcheting would pin the old primary's answers in the
+                # cache forever. Adopt the new sequence: the version
+                # vector changes, so every entry stamped against the
+                # old sequence lazily invalidates, and the merged CC
+                # forest re-pulls at the new shard's state.
+                get_registry().counter(
+                    "router.shard_restarts", shard=str(shard)
+                ).inc()
+                self._vers[shard] = version
+                self._pulled_vers[shard] = -1
+
+    # ------------------------------------------------------------------ #
+    # Settling
+    # ------------------------------------------------------------------ #
+    def _expire(self, e: _Entry) -> None:
+        from ..resilience.errors import DeadlineExceeded
+
+        get_registry().counter("serving.deadline_expired").inc()
+        self._set_exc(e.f, DeadlineExceeded(
+            f"{type(e.q).__name__} unanswered after its "
+            f"{(e.dl - e.t0):.3f}s deadline"
+        ))
+        self._finish(e)
+
+    def _settle(self, e: _Entry, ans: Optional[Answer] = None,
+                exc: Optional[BaseException] = None) -> None:
+        if ans is not None:
+            now = time.perf_counter()
+            if e.dl is not None and now > e.dl:
+                # answered late: honor the deadline over a stale answer
+                self._expire(e)
+                return
+            self._set_res(e.f, ans)
+        else:
+            self._set_exc(e.f, exc)
+        self._finish(e)
+
+    def _finish(self, e: _Entry) -> None:
+        with self._lock:
+            if e.done:
+                return  # the sweep guard may re-settle an entry a
+                # callback already answered; account it exactly once
+            e.done = True
+            self._inflight -= 1
+        g = e.grp
+        if g is not None and g.done_one():
+            _trace.record_span(
+                "serving.router.fanout",
+                time.perf_counter() - g.t0,
+                trace_id=g.ctx.trace_id,
+                parent=g.ctx.parent_sid,
+                sid=g.sid,
+                attrs={
+                    "n": g.hits + g.misses,
+                    "hits": g.hits,
+                    "misses": g.misses,
+                    "shards": len(g.shards),
+                },
+            )
+
+    @staticmethod
+    def _set_res(f: Future, ans: Answer) -> None:
+        if not f.done():
+            try:
+                f.set_result(ans)
+            except InvalidStateError:
+                get_registry().counter(
+                    "router.swallowed", site="settle_race"
+                ).inc()
+
+    @staticmethod
+    def _set_exc(f: Future, exc: BaseException) -> None:
+        if not f.done():
+            try:
+                f.set_exception(exc)
+            except InvalidStateError:
+                get_registry().counter(
+                    "router.swallowed", site="settle_race"
+                ).inc()
+
+    # ------------------------------------------------------------------ #
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the worker, fail leftovers, close every shard client.
+        One budget across all the joins/closes (GL008)."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        deadline = time.monotonic() + float(timeout)
+        self._wake.set()
+        self._worker.join(max(0.0, deadline - time.monotonic()))
+        with self._lock:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        err = RuntimeError("router closed with the query pending")
+        for e in leftovers:
+            self._set_exc(e.f, err)
+        for c in self._clients:
+            c.close()
+
+
+class _FailedFuture:
+    """Minimal already-failed future (submit raised synchronously)."""
+
+    __slots__ = ("_exc",)
+
+    def __init__(self, exc: BaseException):
+        self._exc = exc
+
+    def exception(self):
+        return self._exc
+
+    def result(self):
+        raise self._exc
+
+
+# --------------------------------------------------------------------- #
+# Shard demo servable (real CC + degrees over a partitioned stream)
+# --------------------------------------------------------------------- #
+def demo_shard_edges(n_vertices: int, n_edges: int, seed: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """The sharded bench/test stream: deterministic uniform edges, the
+    SAME columns in every process that passes the same arguments — the
+    property the cross-process oracle identity rests on."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_vertices, n_edges, dtype=np.int64)
+    dst = rng.integers(0, n_vertices, n_edges, dtype=np.int64)
+    return src, dst
+
+
+def shard_demo_payloads(
+    *,
+    n_vertices: int,
+    n_edges: int,
+    seed: int = 7,
+    window: int = 1024,
+    shard: int = 0,
+    nshards: int = 1,
+    pace_s: float = 0.0,
+):
+    """One shard's servable: fold the edges this shard OWNS
+    (:func:`~gelly_streaming_tpu.core.ingest.partition_edges_by_vertex`)
+    into a live min-rooted CC forest + degree table, one snapshot per
+    count window. ``nshards=1`` is the single-host oracle — the same
+    code folding the WHOLE stream, which is what the identity tests and
+    the bench baseline serve from."""
+    from ..datasets import IdentityDict
+    from ..core.ingest import partition_edges_by_vertex
+    from ..summaries.forest import fold_edges_host
+
+    src, dst = demo_shard_edges(n_vertices, n_edges, seed)
+    s, d, _v = partition_edges_by_vertex(src, dst, None, nshards)[shard]
+    vd = IdentityDict(n_vertices)
+    vd.observe(n_vertices - 1)  # full-keyspace parity (see summary_pull)
+    lab = np.arange(n_vertices, dtype=np.int32)
+    deg = np.zeros(n_vertices, np.int64)
+    done = 0
+    for a in range(0, max(1, len(s)), window):
+        b = min(a + window, len(s))
+        if b > a:
+            lab = fold_edges_host(lab, s[a:b], d[a:b])
+            deg += np.bincount(s[a:b], minlength=n_vertices)
+            deg += np.bincount(d[a:b], minlength=n_vertices)
+            done += b - a
+        yield {"labels": lab, "deg": deg.copy(), "vdict": vd}, done
+        if pace_s:
+            time.sleep(pace_s)
+
+
+# --------------------------------------------------------------------- #
+# Router binary (subprocess entry, mirrors rpc.replica_main)
+# --------------------------------------------------------------------- #
+def router_main(cfg: dict) -> None:
+    """The router as a real process. ``cfg`` keys: ``shards`` (one
+    address list per shard), ``portfile``, optional ``events`` (ShardSink
+    path + ``shard`` label), ``cache``/``cache_cap``/``cache_ttl_s``,
+    ``run_s``, ``meta``."""
+    import json
+    import signal
+
+    from ..obs import trace as obs_trace
+    from ..obs.cluster import ShardSink
+    from .rpc import RpcServer
+
+    sink = None
+    if cfg.get("events"):
+        sink = ShardSink(cfg["events"], shard=cfg.get("shard"))
+        get_registry().add_sink(sink)
+        obs_trace.add_sink(sink)
+        obs_trace.enable(registry_spans=False)
+    router = ShardRouter(
+        cfg["shards"],
+        cache=bool(cfg.get("cache", True)),
+        cache_cap=int(cfg.get("cache_cap", DEFAULT_CACHE_CAP)),
+        cache_ttl_s=cfg.get("cache_ttl_s"),
+        max_pending=int(cfg.get("max_pending", 1 << 14)),
+    )
+    rpc = RpcServer(router).start()
+    if cfg.get("portfile"):
+        from ..resilience import integrity
+
+        tmp = cfg["portfile"] + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(rpc.port))
+        integrity.replace_atomic(tmp, cfg["portfile"])
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    deadline = time.monotonic() + float(cfg.get("run_s", 600.0))
+    while not stop.is_set() and time.monotonic() < deadline:
+        stop.wait(0.05)
+    meta = dict(router.stats_snapshot(), port=rpc.port)
+    rpc.close()
+    router.close()
+    if cfg.get("meta"):
+        with open(cfg["meta"], "w") as f:
+            json.dump(meta, f)
+    if sink is not None:
+        sink.close()
+        get_registry().remove_sink(sink)
+
+
+def spawn_router(cfg: dict):
+    """Launch the router binary detached, logging next to its portfile
+    (same discipline as :func:`~.rpc.spawn_replica`)."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    from .rpc import REPO_ROOT
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    log_path = (cfg.get("portfile") or "router") + ".log"
+    code = (
+        "import sys, json; "
+        f"sys.path.insert(0, {REPO_ROOT!r}); "
+        "from gelly_streaming_tpu.serving import router; "
+        "router.router_main(json.loads(sys.argv[1]))"
+    )
+    logf = open(log_path, "wb")
+    try:
+        p = subprocess.Popen(
+            [_sys.executable, "-c", code, json.dumps(cfg)],
+            stdout=logf, stderr=subprocess.STDOUT, env=env,
+        )
+    finally:
+        logf.close()  # the child holds its own dup of the fd
+    p.log_path = log_path
+    return p
+
+
+if __name__ == "__main__":
+    import json
+    import sys
+
+    if "--router" in sys.argv:
+        router_main(json.loads(
+            sys.argv[sys.argv.index("--router") + 1]
+        ))
+        sys.exit(0)
+    print(
+        "usage: python -m gelly_streaming_tpu.serving.router "
+        "--router '<json cfg>'",
+        file=sys.stderr,
+    )
+    sys.exit(2)

@@ -76,6 +76,7 @@ from .query import (
     DegreeQuery,
     Query,
     RankQuery,
+    SummaryPullQuery,
 )
 from .server import Overloaded, Shed, StreamServer
 from .snapshot_store import (
@@ -240,12 +241,14 @@ _Q_KINDS = {
     "D": (DegreeQuery, 1),
     "R": (RankQuery, 1),
     "S": (ComponentSizeQuery, 1),
+    "P": (SummaryPullQuery, 0),
 }
 _Q_TAGS = {
     ConnectedQuery: "C",
     DegreeQuery: "D",
     RankQuery: "R",
     ComponentSizeQuery: "S",
+    SummaryPullQuery: "P",
 }
 
 
@@ -259,6 +262,8 @@ def encode_queries(queries) -> List[list]:
             )
         if tag == "C":
             out.append([tag, int(q.u), int(q.v)])
+        elif tag == "P":
+            out.append([tag])
         else:
             out.append([tag, int(q.v)])
     return out
@@ -278,7 +283,12 @@ def encode_answer(ans: Answer) -> list:
     v = ans.value
     if hasattr(v, "item"):
         v = v.item()
-    return ["ok", v, ans.window, ans.watermark, ans.staleness]
+    # the trailing snapshot version is what a routing tier keys its
+    # hot-key cache invalidation on (decoders tolerate its absence, so
+    # v1 peers stay interoperable — GL011: written here, read in
+    # client._settle_ok)
+    return ["ok", v, ans.window, ans.watermark, ans.staleness,
+            ans.version]
 
 
 # --------------------------------------------------------------------- #
@@ -529,12 +539,19 @@ class RpcServer:
             return
         t_admit = time.perf_counter()
         futures: list = []
+        # one-lock batch admission when the server offers it (the
+        # whole-frame fast path); the per-query loop stays the
+        # compatibility path for bare submit-only servers
+        many = getattr(self.server, "submit_many", None)
         try:
-            for q in queries:
-                futures.append(
-                    self.server.submit(q, deadline_s=deadline_s,
-                                       ctx=ctx)
-                )
+            if many is not None:
+                futures = many(queries, deadline_s=deadline_s, ctx=ctx)
+            else:
+                for q in queries:
+                    futures.append(
+                        self.server.submit(q, deadline_s=deadline_s,
+                                           ctx=ctx)
+                    )
         except Shed as e:
             self._cancel(futures)
             self._respond(conn, qid, SHED, error=str(e)[:200])
@@ -822,6 +839,16 @@ class ReplicaServer:
     (``serving.lease_lapse``, ``serving.failover{reason=lease_lapse}``,
     ``serving.promotion_seconds``, a ``serving.promotion`` span).
 
+    A replica constructed with ``role="primary"`` whose serving
+    directory already holds a FRESH lease (another replica actively
+    beating — the standby a previous incarnation failed over to)
+    REJOINS AS STANDBY instead of seizing serving back:
+    ``self.rejoined`` is set, ``serving.rejoin_demoted`` counted, and
+    the replica behaves exactly like a booted standby — following the
+    directory, refusing ``not_primary``, promoting only if the current
+    holder's lease lapses. A promoted replica therefore stays promoted
+    until IT fails, however many times the old primary restarts.
+
     Ingest does NOT fail over: the dead primary's stream dies with it,
     and the promoted standby serves the last mirrored snapshot — the
     same keep-serving-from-final-state contract a closed stream has.
@@ -848,6 +875,26 @@ class ReplicaServer:
         if role not in ("primary", "standby"):
             raise ValueError(f"role must be primary/standby, got {role!r}")
         self.dirpath = dirpath
+        self.rejoined = False
+        if role == "primary":
+            # failed-back primary REJOINS as standby: if another
+            # replica HOLDS the lease in this serving directory (the
+            # standby this process's predecessor failed over to),
+            # seizing serving back would put two primaries on one
+            # keyspace. A fresh record alone is not proof of a holder
+            # — a fast supervisor restart can boot the SAME replica
+            # within its own predecessor's lease window, and
+            # self-demoting then would discard ingest forever. So a
+            # fresh record is confirmed by watching for a BEAT: only a
+            # record whose timestamp advances within the declared
+            # lease window has a live writer behind it. Observed beat
+            # -> demote (follow the directory, promote only if that
+            # holder lapses); no beat / stale record -> a dead
+            # predecessor's leftovers, normal primary boot proceeds.
+            if self._lease_actively_held(dirpath):
+                role = "standby"
+                self.rejoined = True
+                get_registry().counter("serving.rejoin_demoted").inc()
         self.role = role
         self.lease_s = float(lease_s)
         self.beat_s = beat_s
@@ -883,6 +930,27 @@ class ReplicaServer:
         )
 
     # ------------------------------------------------------------------ #
+    @staticmethod
+    def _lease_actively_held(dirpath: str) -> bool:
+        """True when a LIVE replica is beating the directory's lease:
+        the newest record is fresh AND its timestamp advances within
+        one declared lease window (beats land every ``lease_s / 5``).
+        Blocks at most one lease window — paid only on the rare boot
+        into a directory with a fresh record."""
+        got = HeartbeatLease.age_s(dirpath)
+        if got is None or got[0] > got[1]:
+            return False  # no record, or already lapsed: no holder
+        first = HeartbeatLease.read(dirpath)
+        if first is None:
+            return False
+        deadline = time.monotonic() + float(got[1])
+        while time.monotonic() < deadline:
+            time.sleep(min(0.02, got[1] / 10))
+            rec = HeartbeatLease.read(dirpath)
+            if rec is not None and rec.get("ts") != first.get("ts"):
+                return True  # the writer beat: genuinely held
+        return False  # fresh but silent: a dead predecessor's record
+
     def _gate(self) -> Optional[str]:
         return None if self.role == "primary" else NOT_PRIMARY
 
@@ -1015,6 +1083,7 @@ class ReplicaServer:
         doc = {
             "role": self.role,
             "promoted": bool(self.promoted),
+            "rejoined": bool(self.rejoined),
             "worker_alive": bool(self.server.worker_alive()),
             "pending": len(self.server._pending),
             "heartbeat_age_s": self.heartbeat_age_s(),
@@ -1146,11 +1215,19 @@ def replica_main(cfg: dict) -> None:
             kill_exit_code=KILL_RC,
         ))
     if role == "primary":
-        servable = demo_payloads(
-            windows=int(cfg.get("windows", 200)),
-            vcap=int(cfg.get("vcap", 64)),
-            pace_s=float(cfg.get("pace_s", 0.005)),
-        )
+        if cfg.get("cc_shard"):
+            # one SHARD of the partitioned serving deployment: real CC
+            # forest + degree folds over the edges this shard owns
+            # (serving/router.py — the sharded bench's replica shape)
+            from .router import shard_demo_payloads
+
+            servable = shard_demo_payloads(**cfg["cc_shard"])
+        else:
+            servable = demo_payloads(
+                windows=int(cfg.get("windows", 200)),
+                vcap=int(cfg.get("vcap", 64)),
+                pace_s=float(cfg.get("pace_s", 0.005)),
+            )
         rep = ReplicaServer(
             servable, None, dirpath=cfg["dir"], role="primary",
             lease_s=float(cfg.get("lease_s", 0.5)),

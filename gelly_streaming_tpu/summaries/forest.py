@@ -600,6 +600,70 @@ def resolve_flat_host(canon_np: np.ndarray) -> np.ndarray:
         lab = nxt
 
 
+def fold_edges_host(canon_np: np.ndarray, src: np.ndarray,
+                    dst: np.ndarray) -> np.ndarray:
+    """Fold ONE edge-column group into a host forest table, returning a
+    fully-canonical min-rooted flat table (``out[v] <= v``, depth 1).
+
+    The host analog of the group-fold window step: min-label hooking
+    over the group's edges alternated with :func:`resolve_flat_host`
+    pointer jumping until fixpoint — every pass is whole-array numpy,
+    never a per-edge Python loop. Monotone (labels only decrease), so
+    it terminates; the result's components are exactly the input
+    table's components unioned with the group's edges. Callers pass
+    MANY windows' (or many shards') columns concatenated as one group —
+    one fold call for the whole group is the group-fold shape."""
+    lab = resolve_flat_host(np.asarray(canon_np))
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    if len(src) == 0:
+        return lab
+    lab = lab.copy()
+    while True:
+        lo = np.minimum(lab[src], lab[dst])
+        before = lab
+        lab = lab.copy()
+        # hook both endpoints' current ROOTS down to the edge minimum;
+        # the flat invariant between passes makes lab[src] the root
+        np.minimum.at(lab, before[src], lo)
+        np.minimum.at(lab, before[dst], lo)
+        lab = resolve_flat_host(lab)
+        if np.array_equal(lab, before):
+            return lab
+
+
+def merge_forest_tables_host(tables) -> np.ndarray:
+    """Cross-shard union step: merge N same-length forest tables into
+    one canonical table whose components are the components of the
+    UNION of the inputs' edge sets.
+
+    Each input forest IS a spanning structure of its own components
+    (edges ``(i, t[i])`` where ``t[i] != i``), so concatenating every
+    table's non-trivial pointer edges into ONE group and folding them
+    with :func:`fold_edges_host` yields exactly the union connectivity
+    — the scatter-gather merge a sharded serving router performs, in
+    one group-fold call rather than N incremental ones."""
+    tables = [np.asarray(t) for t in tables]
+    if not tables:
+        raise ValueError("merge_forest_tables_host needs >= 1 table")
+    n = len(tables[0])
+    for t in tables:
+        if len(t) != n:
+            raise ValueError(
+                f"forest tables disagree on length: {len(t)} != {n}"
+            )
+    srcs, dsts = [], []
+    for t in tables:
+        i = np.nonzero(t != np.arange(len(t), dtype=t.dtype))[0]
+        srcs.append(i.astype(np.int64))
+        dsts.append(t[i].astype(np.int64))
+    return fold_edges_host(
+        np.arange(n, dtype=np.int32),
+        np.concatenate(srcs) if srcs else np.zeros(0, np.int64),
+        np.concatenate(dsts) if dsts else np.zeros(0, np.int64),
+    )
+
+
 class TouchLog:
     """Append-only first-seen log of touched compact ids.
 

@@ -177,3 +177,37 @@ def test_cli_watch_flag_targets_the_chaos_artifact(tmp_path, capsys):
                  "--watch", "recovery_s.p50", "--ratio", "10"]) == 0
     out = capsys.readouterr().out
     assert "recovery_s.p50" in out
+
+
+def test_sharded_watch_list_matches_the_sharded_artifact():
+    # the ISSUE 12 satellite: the CI sharded-serving step watches the
+    # cached tier's aggregate QPS (min: direction — throughput) and
+    # its steady cache-on p99 (latency direction) from the committed
+    # artifact — both paths must resolve
+    from tools.benchguard import WATCHED_SHARDED
+
+    path = os.path.join(REPO, "BENCH_SERVING_SHARDED_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_SHARDED:
+        lower = metric.startswith("min:")
+        value = dig(committed, metric[4:] if lower else metric)
+        assert isinstance(value, (int, float)), metric
+    assert any(m.startswith("min:") for m in WATCHED_SHARDED)
+
+
+def test_sharded_watch_directions():
+    from tools.benchguard import WATCHED_SHARDED
+
+    base = {"headline": {"qps": 9000.0},
+            "zipf": {"cache_on": {"p99_ms": 40.0}}}
+    good = {"headline": {"qps": 8000.0},
+            "zipf": {"cache_on": {"p99_ms": 60.0}}}
+    verdicts = compare(base, good, ratio=3.0, watched=WATCHED_SHARDED)
+    assert [v["ok"] for v in verdicts] == [True, True]
+    bad = {"headline": {"qps": 2000.0},
+           "zipf": {"cache_on": {"p99_ms": 200.0}}}
+    verdicts = compare(base, bad, ratio=3.0, watched=WATCHED_SHARDED)
+    by = {v["metric"]: v for v in verdicts}
+    assert by["min:headline.qps"]["ok"] is False
+    assert by["zipf.cache_on.p99_ms"]["ok"] is False

@@ -16,6 +16,7 @@ from gelly_streaming_tpu.example import (
     incidence_sampling_triangle_count,
     incremental_pagerank,
     iterative_connected_components,
+    sharded_ingest_serving,
     spanner,
     streaming_graphsage,
     window_triangles,
@@ -299,3 +300,31 @@ def test_cc_supervised_checkpoint_dir_flags(tmp_path, capsys):
              "--every", "2", "--fresh"])
     assert "resuming" not in capsys.readouterr().out
     assert open(out).read() == first
+
+
+def test_sharded_ingest_serving_example():
+    """ISSUE 12 satellite (PR 11 residual): ShardedEdgeSource feeds a
+    LIVE aggregation + serving stack — the example's final answers must
+    match a union-find oracle over the same synthesized stream."""
+    from _uf import union_find_components
+
+    nv, ne, seed = 1 << 9, 1 << 12, 23
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, nv, ne, dtype=np.int64)
+    dst = rng.integers(0, nv, ne, dtype=np.int64)
+    queries = [(int(a), int(b)) for a, b in rng.integers(0, nv, (3, 2))]
+    comps = union_find_components(zip(src.tolist(), dst.tolist()))
+    root_of = {}
+    for comp in comps:
+        r = min(comp)
+        for m in comp:
+            root_of[m] = r
+    lines = sharded_ingest_serving.run(
+        2, 128, ne, queries, n_vertices=nv, seed=seed
+    )
+    finals = [ln for ln in lines if ln.startswith("final ")]
+    assert len(finals) == len(queries)
+    for (u, v), line in zip(queries, finals):
+        want = root_of.get(u, u) == root_of.get(v, v)
+        assert f"connected({u},{v}) = {want}" in line, (line, want)
+    assert any("2-shard live ingest" in ln for ln in lines)

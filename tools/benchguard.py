@@ -54,6 +54,14 @@ WATCHED_CHAOS = ("recovery_s.p50",)
 #: bound direction (fresh must stay above committed / ratio)
 WATCHED_INGEST = ("min:cells.c4_binary.eps",)
 
+#: the sharded-serving artifact's guarded metrics
+#: (BENCH_SERVING_SHARDED_CPU.json): the cached routing tier's
+#: aggregate Zipfian QPS is throughput (``min:`` — regression is
+#: downward), its steady cache-on p99 is latency (regression upward).
+#: The kill/promotion columns are NOT guarded: their latency is
+#: dominated by the configured lease timeout, a correctness parameter.
+WATCHED_SHARDED = ("min:headline.qps", "zipf.cache_on.p99_ms")
+
 #: a fresh value may be up to this many times the committed one
 DEFAULT_RATIO = 3.0
 

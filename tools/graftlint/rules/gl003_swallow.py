@@ -46,10 +46,14 @@ _BROAD = {"Exception", "BaseException"}
 #: the sharded ingest module: its per-shard reader threads own sockets
 #: the same way the RPC handler threads do, and its fuzz contract
 #: ("every malformed frame is a counted source.malformed_frames{kind}")
-#: is only structural under the same bar.
+#: is only structural under the same bar. ISSUE 12 adds the shard
+#: router: its worker + per-shard client callbacks are the fan-out's
+#: only witnesses — a swallowed shard error there would silently turn
+#: a partial outage into a hung future.
 THREADED_SOCKET_MODULES = (
     "serving/rpc.py",
     "serving/client.py",
+    "serving/router.py",
     "core/ingest.py",
 )
 
