@@ -64,12 +64,6 @@ class _SkipStream:
     """View of a stream whose first ``skip`` windows are consumed (for
     vertex-dictionary replay) but not surfaced to the workload."""
 
-    #: disable the wrapped stream's superbatch fast path: the replay
-    #: skip applies to blocks(), which the generic group packer
-    #: (``core.window.iter_superbatches``) consumes — forwarding the
-    #: inner packer would resurface the skipped windows
-    superbatches = None
-
     def __init__(self, stream, skip: int):
         self._stream = stream
         self._skip = skip
@@ -82,6 +76,33 @@ class _SkipStream:
         for i, block in enumerate(it):
             if i >= self._skip:
                 yield block
+
+    def superbatches(self, k: int):
+        """Group-granular replay skip. ``skip`` is always group-aligned
+        (barriers land on ``checkpoint_granularity`` multiples), so when
+        the wrapped stream has a packer and the tiling agrees we skip
+        ``skip // k`` whole groups THROUGH it: the skipped groups still
+        pack (one group encode each — the vertex-dictionary replay),
+        they are just never surfaced, and the resumed run keeps the
+        packer's exact per-window seen-count watermark
+        (``SuperbatchGroup.n_seen_per_window`` — a workload like
+        IncrementalPageRank reads it for value-identical resume). A
+        misaligned ``k`` (the work was reconfigured between runs) falls
+        back to generic packing of the skipped block iterator."""
+        inner = getattr(self._stream, "superbatches", None)
+        if callable(inner) and self._skip % k == 0:
+            it = inner(k)
+            for _ in range(self._skip // k):
+                if next(it, None) is None:
+                    break
+            yield from it
+            return
+        from ..core.pipeline import prefetch, superbatch_prefetch_depth
+        from ..core.window import superbatches_from_blocks
+
+        yield from superbatches_from_blocks(
+            prefetch(self.blocks(), superbatch_prefetch_depth(k)), k
+        )
 
 
 class AutoCheckpoint:

@@ -47,6 +47,7 @@ from ..obs import trace as _trace
 from ..obs.registry import get_registry
 from ..parallel import comm
 from ..parallel.mesh import EDGE_AXIS
+from ..summaries.groupfold import GroupFoldable, drive_group_folded
 from jax.sharding import PartitionSpec as P
 
 
@@ -67,7 +68,7 @@ def _step_cache_put(key, fn) -> None:
     _STEP_CACHE[key] = fn
 
 
-class SummaryAggregation(abc.ABC):
+class SummaryAggregation(GroupFoldable, abc.ABC):
     """Abstract engine config (``SummaryAggregation.java:22-137``).
 
     Parameters
@@ -119,6 +120,11 @@ class SummaryAggregation(abc.ABC):
         self._summary = None
         self._vcap = 0
         self._sync_ref = None  # last dispatched window state (sync target)
+        # run-loop context for the declared group fold (set by the
+        # superbatched drive loops before drive_group_folded delegates
+        # back into fold_group)
+        self._gf_mesh = None
+        self._gf_vdict = None
         #: whether the last superbatch dispatch DONATED the carried
         #: summary (in-place HBM update). Consumers that publish live
         #: carry buffers (``CCServable._payload``) read this to know
@@ -378,19 +384,24 @@ class SummaryAggregation(abc.ABC):
                 )
 
     def _run_superbatched(self, stream, mesh, vdict) -> Iterator[Any]:
-        """The fused-group drive loop: pack K windows per group, one
-        scan dispatch, unstack K emissions lazily (see :meth:`run`).
-        Groups come from the stream's superbatch packer when it has one
-        (zero per-window device assembly on the windower fast path) and
-        are PREFETCHED one group ahead — the host assembles superbatch
-        N+1 while the device scans N, the group-granular form of the
-        pipeline coupling (:mod:`gelly_streaming_tpu.core.pipeline`)."""
-        from ..core.pipeline import prefetch
-        from ..core.window import iter_superbatches
+        """The fused-group drive loop — the engine's
+        :class:`~gelly_streaming_tpu.summaries.groupfold.GroupFoldable`
+        declaration driven by the shared
+        :func:`~gelly_streaming_tpu.summaries.groupfold.drive_group_folded`
+        loop (groups from the stream's packer, prefetched one ahead so
+        the host assembles superbatch N+1 while the device scans N)."""
+        self._gf_mesh = mesh
+        self._gf_vdict = vdict
+        yield from drive_group_folded(self, stream, self.superbatch)
 
-        for group in prefetch(iter_superbatches(stream, self.superbatch), 2):
-            for state in self._fold_group_states(group, mesh):
-                yield self.transform(state, vdict)
+    def fold_group(self, group) -> Iterator[Any]:
+        """The engine's declared group fold (see
+        :class:`~gelly_streaming_tpu.summaries.groupfold.GroupFoldable`):
+        one fused scan over the group's stacked block, per-window
+        summaries unstacked lazily. Supports EVERY group — device-
+        transformed members dispatch on the device stack."""
+        for state in self._fold_group_states(group, self._gf_mesh):
+            yield self.transform(state, self._gf_vdict)
 
     def _fold_group_states(self, group, mesh) -> Iterator[Any]:
         """Grow + fold one :class:`SuperbatchGroup` through the fused

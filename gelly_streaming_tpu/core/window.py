@@ -152,7 +152,11 @@ class Windower:
         self.capacity = capacity  # fixed capacity override (else bucketed)
 
     # ------------------------------------------------------------------ #
-    def _make_block(self, rows: Sequence[Tuple]) -> EdgeBlock:
+    def _rows_to_cols(self, rows: Sequence[Tuple]) -> Tuple:
+        """One window's record tuples -> raw ``(src, dst, val|None)``
+        columns — THE record-parsing rule (val presence decided by the
+        window's first record), shared by the per-window block path and
+        the record superbatch packer so the two cannot drift."""
         n = len(rows)
         raw_src = np.fromiter((r[0] for r in rows), dtype=np.int64, count=n)
         raw_dst = np.fromiter((r[1] for r in rows), dtype=np.int64, count=n)
@@ -160,7 +164,10 @@ class Windower:
             val = np.asarray([r[2] for r in rows], dtype=self.val_dtype)
         else:
             val = None
-        return self._block_from_arrays(raw_src, raw_dst, val)
+        return raw_src, raw_dst, val
+
+    def _make_block(self, rows: Sequence[Tuple]) -> EdgeBlock:
+        return self._block_from_arrays(*self._rows_to_cols(rows))
 
     def _block_from_arrays(
         self, raw_src: np.ndarray, raw_dst: np.ndarray, val: Optional[np.ndarray]
@@ -341,14 +348,22 @@ class Windower:
         if isinstance(policy, CountWindow) and is_column_input(edges):
             yield from self._array_superbatches(edges, k)
             return
+        if isinstance(policy, CountWindow) and not callable(
+            getattr(edges, "iter_chunks", None)
+        ):
+            yield from self._record_superbatches(iter(edges), k)
+            return
         yield from superbatches_from_blocks(
             self.blocks_with_info(edges), k, with_info=True,
             val_dtype=self.val_dtype,
         )
 
     def _array_superbatches(self, edges, k: int) -> Iterator["SuperbatchGroup"]:
-        """Count-window column fast path: slice + one group encode, zero
-        per-window device work."""
+        """Count-window column fast path: slice the raw columns into
+        per-window triples and delegate to :meth:`pack_window_cols` —
+        THE one group-packing implementation (slicing here, encode +
+        group assembly there), so the fast path, the sharded-ingest
+        path, and the latency-curve bench all measure the same code."""
         if isinstance(edges, np.ndarray):
             if edges.ndim != 2 or not 2 <= edges.shape[1] <= 3:
                 raise ValueError("edge array must be [N, 2] or [N, 3]")
@@ -363,39 +378,52 @@ class Windower:
         index = 0
         for g0 in range(0, n, size * k):
             g1 = min(g0 + size * k, n)
-            # span covers the whole group assembly: one group encode +
-            # per-window column views (ZERO per-window device work —
-            # exactly the cost the superbatch ingest fusion exists to
-            # amortize, so it is the one worth measuring)
-            with _trace.span(
-                "window.superbatch_pack",
-                {"k": k, "edges": int(g1 - g0), "window_index": index}
-                if _trace.on() else None,
-            ):
-                # paired group encode: same first-seen order as
-                # per-window encodes run back to back (concatenation in
-                # window order)
-                s_g, d_g = self.vertex_dict.encode_pair(
-                    src[g0:g1], dst[g0:g1]
-                )
-                s_g = np.asarray(s_g, np.int32)
-                d_g = np.asarray(d_g, np.int32)
-                nv = self.vertex_dict.capacity
-                win_cols = []
-                infos = []
-                for w0 in range(g0, g1, size):
-                    w1 = min(w0 + size, g1)
-                    a, b = w0 - g0, w1 - g0
-                    win_cols.append((
-                        s_g[a:b], d_g[a:b],
-                        None if val is None else val[w0:w1],
-                    ))
-                    infos.append(WindowInfo(index, None, None))
-                    index += 1
-                group = SuperbatchGroup(
-                    infos, win_cols, nv, val_dtype=self.val_dtype
-                )
-            yield group
+            win_cols = [
+                (src[w0:min(w0 + size, g1)], dst[w0:min(w0 + size, g1)],
+                 None if val is None else val[w0:min(w0 + size, g1)])
+                for w0 in range(g0, g1, size)
+            ]
+            yield self.pack_window_cols(win_cols, first_index=index)
+            index += len(win_cols)
+
+    def _record_superbatches(
+        self, edges: Iterator[Tuple], k: int
+    ) -> Iterator["SuperbatchGroup"]:
+        """Count-window RECORD path: buffer K windows' raw records,
+        convert each window to raw columns once, and pack the group
+        through :meth:`pack_window_cols` — the same one-group-encode
+        ingest fusion the column fast path gets. Record streams
+        previously fell back to per-window block assembly + generic
+        packing, which both paid the per-window device cost the
+        superbatch exists to amortize AND left the group without the
+        packer's seen-count watermark (``SuperbatchGroup.n_seen_before``).
+        Live-source ``None`` ticks are ignored, as in :meth:`blocks`."""
+        size = self.policy.size
+        index = 0
+        win_rows: list = []
+        rows: list = []
+
+        def flush():
+            nonlocal win_rows, index
+            cols = [self._rows_to_cols(rws) for rws in win_rows]
+            group = self.pack_window_cols(cols, first_index=index)
+            index += len(cols)
+            win_rows = []
+            return group
+
+        for e in edges:
+            if e is None:  # live-source time tick; count windows ignore
+                continue
+            rows.append(e)
+            if len(rows) >= size:
+                win_rows.append(rows)
+                rows = []
+                if len(win_rows) >= k:
+                    yield flush()
+        if rows:
+            win_rows.append(rows)
+        if win_rows:
+            yield flush()
 
     def pack_window_cols(
         self, win_cols: Sequence[Tuple], first_index: int = 0
@@ -415,6 +443,14 @@ class Windower:
             {"k": k, "edges": int(sum(lens)), "window_index": first_index}
             if _trace.on() else None,
         ):
+            # seen-vertex watermark BEFORE the group encode: together
+            # with the encoded columns this reconstructs every member
+            # window's post-encode len(vertex_dict)
+            # (SuperbatchGroup.n_seen_per_window) — the per-window value
+            # group-folded workloads that read the seen count
+            # (IncrementalPageRank's teleport mass) need for value
+            # identity with the per-window path
+            n_seen_before = len(self.vertex_dict)
             if k == 1:
                 src = np.ascontiguousarray(win_cols[0][0], np.int64)
                 dst = np.ascontiguousarray(win_cols[0][1], np.int64)
@@ -442,7 +478,8 @@ class Windower:
                 infos.append(WindowInfo(first_index + j, None, None))
                 a = b
             return SuperbatchGroup(
-                infos, cols, nv, val_dtype=self.val_dtype
+                infos, cols, nv, val_dtype=self.val_dtype,
+                n_seen_before=n_seen_before,
             )
 
     # ------------------------------------------------------------------ #
@@ -709,22 +746,78 @@ class SuperbatchGroup:
     consumers that dispatch on the device stack — built from ``cols``
     with ONE host->device transfer per column, or from the member
     blocks' device arrays as the fallback.
+
+    ``n_seen_before`` records ``len(vertex_dict)`` at the moment the
+    packer started the group encode (None when the group was packed
+    from pre-built blocks and the watermark is unknown); see
+    :meth:`n_seen_per_window`.
     """
 
     __slots__ = ("infos", "cols", "n_vertices", "val_dtype", "_blocks",
-                 "_stacked")
+                 "_stacked", "n_seen_before")
 
     def __init__(self, infos, cols, n_vertices: int, *,
-                 val_dtype=np.float32, blocks=None):
+                 val_dtype=np.float32, blocks=None,
+                 n_seen_before: Optional[int] = None):
         self.infos = infos
         self.cols = cols
         self.n_vertices = n_vertices
         self.val_dtype = val_dtype
         self._blocks = blocks
         self._stacked = None
+        self.n_seen_before = n_seen_before
 
     def __len__(self) -> int:
         return len(self.infos)
+
+    def n_seen_per_window(self) -> Optional[list]:
+        """Per-member-window seen-vertex counts — the ``len(vertex_dict)``
+        a per-window consumer would have read after each window's encode
+        — reconstructed from the group's encoded columns.
+
+        Both dictionary kinds assign/observe monotonically in first-seen
+        order (``VertexDict`` hands out sequential compact ids;
+        ``IdentityDict.observe`` tracks ``max raw id + 1``), so the count
+        after window ``i`` is exactly ``max(n_seen_before, 1 + max
+        compact id over windows <= i)``. Returns None when the packer
+        did not record the pre-encode watermark (generic block packing)
+        — consumers needing per-window counts then take their
+        per-window fallback."""
+        if self.cols is None or self.n_seen_before is None:
+            return None
+        out = []
+        n = int(self.n_seen_before)
+        for s, d, _ in self.cols:
+            if len(s):
+                hi = 1 + int(max(s.max(), d.max()))
+                if hi > n:
+                    n = hi
+            out.append(n)
+        return out
+
+    def blocks(self) -> Iterator[EdgeBlock]:
+        """The member windows as per-window :class:`EdgeBlock`\\ s — the
+        group's PER-WINDOW fallback view (``GroupFoldable``
+        implementations route unsupported groups through it). Pre-built
+        blocks are handed out as-is; column-backed groups assemble one
+        block per window (paying exactly the per-window device cost the
+        fused path avoids — that is the point of a fallback)."""
+        if self._blocks is not None:
+            yield from self._blocks
+            return
+        for s, d, v in self.cols:
+            block = EdgeBlock.from_arrays(
+                np.ascontiguousarray(s, np.int32),
+                np.ascontiguousarray(d, np.int32),
+                v, n_vertices=self.n_vertices, val_dtype=self.val_dtype,
+            )
+            host_val = (
+                np.zeros(len(s), dtype=self.val_dtype) if v is None
+                else np.asarray(v, self.val_dtype)
+            )
+            yield block.with_host_cache(
+                np.asarray(s, np.int32), np.asarray(d, np.int32), host_val
+            )
 
     def stacked(self) -> StackedEdgeBlock:
         if self._stacked is not None:
@@ -792,11 +885,11 @@ def superbatches_from_blocks(
 def iter_superbatches(stream, k: int) -> Iterator[SuperbatchGroup]:
     """Superbatch groups for any stream: the stream's own packer when it
     offers one (``SimpleEdgeStream.superbatches`` routes to the
-    Windower's zero-per-window-device-work fast path), else generic
-    packing of its block iterator. Streams can OPT OUT of the fast path
-    by setting ``superbatches = None`` (``autockpt._SkipStream`` does:
-    its replay-skip applies to ``blocks()``, which the generic packer
-    consumes).
+    Windower's zero-per-window-device-work fast path;
+    ``autockpt._SkipStream`` wraps the inner packer with a
+    group-granular replay skip), else generic packing of its block
+    iterator. Streams can OPT OUT of the fast path by setting
+    ``superbatches = None``.
 
     On the generic path the block iterator is prefetched
     :func:`~gelly_streaming_tpu.core.pipeline.superbatch_prefetch_depth`

@@ -5,9 +5,21 @@ merge machinery with CC over the signed double cover (see
 ``summaries/candidates.py``): bipartite iff no vertex's (+) and (-) cover
 nodes share a component.
 
-Two carries (``carry=`` option, default ``auto``):
+Three carries (``carry=`` option, default ``auto`` — the same auto rule
+as CC: the host union-find where the native toolchain runs on a CPU
+backend, the device forest where an accelerator is attached):
 
-- **Cover forest** (auto default on the single-device ingest path): the
+- **Host cover union-find** (auto default on a CPU backend with the
+  native toolchain): the CC host carry applied to the double cover —
+  every window's edges expand to cover edges ((u,+)~(v,-), (u,-)~(v,+))
+  and fold through the SAME native ``CompactUnionFind`` over 2*vcap
+  cover ids (one ``cuf_fold_group`` call per superbatch group), with a
+  device pointer-forest mirror and the odd-cycle latch checked on host
+  from each window's touched delta (both cover nodes of every endpoint
+  are touched, so sibling-root equality over the delta witnesses every
+  new conflict). Union-find is control flow, not math — the P6
+  placement rationale, same as CC.
+- **Cover forest** (auto default with an accelerator attached): the
   round-5 window-local treatment — a pointer forest over the 2*vcap
   cover ids updated by window-sized kernels, with the odd-cycle latch
   computed in-step from the touched lanes' sibling roots and carried on
@@ -18,12 +30,20 @@ Two carries (``carry=`` option, default ``auto``):
   compiled baseline on the CPU bracket.
 - **Dense cover labels**: the full-table fixpoint + pointer-graph
   combine, used under a sharded mesh and for device-transformed streams
-  (the forest's touched set is host-computed). Downgrade is one
-  canonicalization; checkpoints share one format (flat cover labels +
-  touched), so the carries are cross-restorable.
+  (the windowed carries' touched set is host-computed). Downgrade is
+  one canonicalization; checkpoints share one format (flat cover
+  labels + touched), so the carries are cross-restorable.
+
+``superbatch=K`` fuses K windows into one group fold on every carry
+(the ISSUE 14 ``GroupFoldable`` declaration): the host carry folds the
+group's cover edges in ONE native call with one batched mirror commit
+(the CC ``_host_group`` shape — the CPU fast path), the forest carry
+runs the group-local fused cover scan (the accelerator shape — on CPU
+its group-sized carried label table costs more than it saves), and
+dense mode scans the group through the generic engine.
 
 Emission reproduces the reference's ``(true,{...})`` / ``(false,{})``
-output format in both carries.
+output format in every carry.
 """
 
 from __future__ import annotations
@@ -34,16 +54,56 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..aggregate.summary import SummaryBulkAggregation
+from ..obs import trace as _trace
 from ..summaries.candidates import (
     Candidates,
     cover_fold,
+    cover_forest_superbatch,
     cover_forest_window,
     cover_grow,
     cover_grow_forest,
     init_cover,
 )
-from ..summaries.forest import TouchLog, WindowPrep, resolve_flat, resolve_flat_host
+from ..summaries.candidates import _shift_cover_labels
+from ..summaries.forest import (
+    MirrorReplay,
+    TouchLog,
+    WindowPrep,
+    mirror_update,
+    resolve_flat,
+    resolve_flat_host,
+)
+from ..summaries.groupfold import drive_group_folded
 from ..summaries.labels import label_combine
+from .connected_components import _auto_carry
+
+
+def _cover_cols(src: np.ndarray, dst: np.ndarray, vcap: int):
+    """Expand one window's base edge columns to the signed-cover edge
+    columns ((u,+)~(v,-) and (u,-)~(v,+)) for the host union-find."""
+    s = np.asarray(src, np.int32)
+    d = np.asarray(dst, np.int32)
+    return (
+        np.concatenate([s, s + vcap]),
+        np.concatenate([d + vcap, d]),
+    )
+
+
+def _delta_conflict(t: np.ndarray, r: np.ndarray, vcap: int) -> bool:
+    """Odd-cycle check over ONE window's union-find touched delta
+    ``(ids, roots)``: does any base endpoint's sibling share its root?
+    Complete for NEW conflicts because both cover nodes of every window
+    endpoint are touched (the cover fold adds both edges) and a
+    conflict's merged component is sign-symmetric — its window-touched
+    members witness it."""
+    base = t[t < vcap]
+    if not len(base):
+        return False
+    order = np.argsort(t)
+    ts, rs = t[order], r[order]
+    rb = rs[np.searchsorted(ts, base)]
+    rn = rs[np.searchsorted(ts, base + vcap)]
+    return bool(np.any(rb == rn))
 
 
 class BipartitenessCheck(SummaryBulkAggregation):
@@ -51,14 +111,18 @@ class BipartitenessCheck(SummaryBulkAggregation):
 
     def __init__(self, *args, carry: str = "auto", **kwargs):
         super().__init__(*args, **kwargs)
-        if carry not in ("auto", "forest", "dense"):
-            raise ValueError(f"carry must be auto/forest/dense, got {carry!r}")
+        if carry not in ("auto", "forest", "host", "dense"):
+            raise ValueError(
+                f"carry must be auto/forest/host/dense, got {carry!r}"
+            )
         self.carry = carry
-        self._bp_mode = None  # None | "forest" | "dense"
-        self._canon = None    # cover forest int32[2*vcap]
-        self._failed = None   # device bool latch
-        self._log = None      # host TouchLog over COVER ids
-        self._prep = None
+        self._bp_mode = None  # None | "forest" | "host" | "dense"
+        self._canon = None    # cover forest int32[2*vcap] (device mirror)
+        self._failed = None   # odd-cycle latch: device bool (forest) /
+        #                       host bool (host carry)
+        self._log = None      # host TouchLog over BASE ids
+        self._prep = None     # WindowPrep scratch (forest carry)
+        self._uf = None       # native CompactUnionFind over cover ids
 
     # ---- dense-engine hooks (mesh / device-transformed fallback) ---- #
     def initial_state(self, vcap: int):
@@ -85,6 +149,15 @@ class BipartitenessCheck(SummaryBulkAggregation):
     def run(self, stream) -> Iterator[Candidates]:
         mesh = self._resolve_mesh(stream)
         vdict = stream.vertex_dict
+        k = int(getattr(self, "superbatch", 1) or 1)
+        if k > 1 and not self.transient_state:
+            # the fused K-window drive loop (the GroupFoldable
+            # declaration); transient_state keeps the per-window loop —
+            # its per-yield carry reset is window-granular by definition
+            self._gf_mesh = mesh
+            self._gf_vdict = vdict
+            yield from drive_group_folded(self, stream, k)
+            return
         for block in stream.blocks():
             cache = getattr(block, "_host_cache", None)
             if (
@@ -93,34 +166,205 @@ class BipartitenessCheck(SummaryBulkAggregation):
                 or self.carry == "dense"
                 or self._bp_mode == "dense"
             ):
-                if self._bp_mode == "forest":
+                if self._bp_mode in ("forest", "host"):
                     self._to_dense()
                 self._bp_mode = "dense"
                 self._device_block(block, mesh)
                 self._sync_ref = self._summary
                 yield self.transform(self._summary, vdict)
             else:
-                self._bp_mode = "forest"
+                if self._bp_mode is None:
+                    self._bp_mode = (
+                        self.carry if self.carry != "auto"
+                        else _auto_carry()
+                    )
                 self._ensure_forest(block.n_vertices)
-                self._canon, self._failed, tids = cover_forest_window(
-                    self._canon, self._failed, cache[0], cache[1],
-                    self._vcap, self._prep,
-                )
-                # the log tracks BASE ids only; the negative cover half
-                # derives as base + vcap at emission/checkpoint time, so
-                # growth never needs a log rebuild and held emissions
-                # cannot leak grown ids into the negative half
-                self._log.add(tids)
-                self._summary = {"labels": self._canon}
-                self._sync_ref = (self._canon, self._failed)
-                yield Candidates.from_forest(
-                    self._canon, self._failed, self._log, self._log.count,
-                    self._vcap, vdict,
-                )
+                if self._bp_mode == "host":
+                    yield self._host_window(cache[0], cache[1], vdict)
+                else:
+                    self._canon, self._failed, tids = cover_forest_window(
+                        self._canon, self._failed, cache[0], cache[1],
+                        self._vcap, self._prep,
+                    )
+                    # the log tracks BASE ids only; the negative cover
+                    # half derives as base + vcap at emission/checkpoint
+                    # time, so growth never needs a log rebuild and held
+                    # emissions cannot leak grown ids into the negative
+                    # half
+                    self._log.add(tids)
+                    self._summary = {"labels": self._canon}
+                    self._sync_ref = (self._canon, self._failed)
+                    yield Candidates.from_forest(
+                        self._canon, self._failed, self._log,
+                        self._log.count, self._vcap, vdict,
+                    )
             if self.transient_state:
                 self._reset_transient()
 
+    def _host_window(self, src_h, dst_h, vdict) -> Candidates:
+        """One window through the host cover union-find: fold both cover
+        edges per base edge, mirror the delta to the device forest, and
+        advance the odd-cycle latch from the window's touched delta."""
+        vcap = self._vcap
+        s2, d2 = _cover_cols(src_h, dst_h, vcap)
+        t, r, c, cr = self._uf.fold(s2, d2, 2 * vcap)
+        self._canon = mirror_update(
+            self._canon,
+            np.concatenate([t, c]),
+            np.concatenate([r, cr]),
+            2 * vcap,
+        )
+        if not self._failed:
+            self._failed = _delta_conflict(t, r, vcap)
+        self._log.add(t[t < vcap])
+        self._summary = {"labels": self._canon}
+        self._sync_ref = self._canon
+        return Candidates.from_forest(
+            self._canon, self._failed, self._log, self._log.count,
+            vcap, vdict,
+        )
+
+    # ---- GroupFoldable declaration (summaries/groupfold.py) ---------- #
+    def fold_group(self, group) -> Iterator[Candidates]:
+        """The cover carry's declared group fold: the host carry folds
+        the group's cover edges in ONE native union-find call with one
+        batched mirror commit (:meth:`_host_group` — the CPU fast path,
+        the CC ``_host_group`` shape); the forest carry runs ONE fused
+        group-local cover dispatch
+        (:func:`~gelly_streaming_tpu.summaries.candidates.cover_forest_superbatch`
+        — one 2*vcap chase/commit per GROUP, a scan over group-local
+        cover label tables with the per-window conflict latch riding the
+        carry). Mid-group canons reconstruct lazily on first read.
+        Groups without host column views — and sharded meshes, whose
+        cover fold runs the dense engine — downgrade to dense, exactly
+        like the per-window loop."""
+        mesh, vdict = self._gf_mesh, self._gf_vdict
+        windowed = (
+            mesh is None
+            and group.cols is not None
+            and self.carry != "dense"
+            and self._bp_mode != "dense"
+        )
+        if not windowed:
+            if self._bp_mode in ("forest", "host"):
+                self._to_dense()
+            self._bp_mode = "dense"
+            for state in self._fold_group_states(group, mesh):
+                yield self.transform(state, vdict)
+            return
+        if self._bp_mode is None:
+            self._bp_mode = (
+                self.carry if self.carry != "auto" else _auto_carry()
+            )
+        if self._bp_mode == "host":
+            yield from self._host_group(group, vdict)
+            return
+        # span covers the fold dispatch + log advance, NOT the lazy
+        # per-window emissions reconstructed later on first read
+        with _trace.span(
+            "bp.cover_group",
+            {"k": len(group), "n_vertices": int(group.n_vertices)}
+            if _trace.on() else None,
+        ):
+            self._ensure_forest(group.n_vertices)
+            windows = [(c[0], c[1]) for c in group.cols]
+            (self._canon, self._failed, tids_list, replay,
+             fail_s) = cover_forest_superbatch(
+                self._canon, self._failed, windows, self._vcap,
+                self._prep,
+            )
+            counts = []
+            for tids in tids_list:
+                self._log.add(tids)
+                counts.append(self._log.count)
+            self._summary = {"labels": self._canon}
+            self._sync_ref = (self._canon, self._failed)
+        for i, count in enumerate(counts):
+            yield Candidates.from_forest_replay(
+                replay, i, fail_s, self._log, count, self._vcap, vdict
+            )
+
+    def _host_group(self, group, vdict) -> Iterator[Candidates]:
+        """Host-carry superbatch: K windows' cover edges in ONE native
+        ``cuf_fold_group`` call, one numpy group commit on the device
+        mirror (the CC host-group contract: the published canon is a
+        fresh immutable buffer per group), per-window odd-cycle latches
+        resolved lazily — the end-of-group state answers the whole group
+        when the verdict does not flip inside it (the monotone-latch
+        fast path; a flip resolves per window from the deltas the
+        union-find computed anyway, at most once per run)."""
+        with _trace.span(
+            "bp.cover_host_group",
+            {"k": len(group), "n_vertices": int(group.n_vertices)}
+            if _trace.on() else None,
+        ):
+            self._ensure_forest(group.n_vertices)
+            vcap = self._vcap
+            cover_cols = [
+                _cover_cols(c[0], c[1], vcap) for c in group.cols
+            ]
+            wins, gids, groots, gtcnt = self._uf.fold_group(
+                cover_cols, 2 * vcap
+            )
+            ngt = int(np.sum(gtcnt))
+            # base-only grouped log advance: filter the group-unique
+            # touched prefix to the base half, preserving window order
+            gt = gids[:ngt]
+            base_mask = gt < vcap
+            ends = np.cumsum(np.asarray(gtcnt, np.int64))
+            starts = np.concatenate([[0], ends[:-1]])
+            counts_base = [
+                int(base_mask[a:b].sum()) for a, b in zip(starts, ends)
+            ]
+            counts = self._log.add_grouped(
+                gt[base_mask], np.asarray(counts_base, np.int64)
+            )
+            base_np = np.asarray(self._canon)  # zero-copy view on CPU
+            new_np = base_np.copy()
+            new_np[gids] = groots
+            self._canon = jnp.asarray(new_np)
+            replay = MirrorReplay(base_np, wins)
+            fails = self._host_group_fails(wins, new_np, gt, vcap)
+            self._summary = {"labels": self._canon}
+            self._sync_ref = self._canon
+        for i, count in enumerate(counts):
+            yield Candidates.from_forest_replay(
+                replay, i, fails, self._log, count, vcap, vdict
+            )
+
+    def _host_group_fails(self, wins, end_np, gt, vcap: int) -> list:
+        """Per-window odd-cycle latch values for one host group. The
+        latch is monotone, so only a group containing the flip needs
+        per-window resolution (from the per-window deltas); every other
+        group answers from the carried latch or the end-of-group roots
+        (``end_np[id]`` IS the post-group root for every re-rooted id —
+        ``cuf_fold_group``'s group delta contract)."""
+        k = len(wins)
+        if self._failed:
+            return [True] * k
+        base_g = gt[gt < vcap]
+        end_conflict = bool(
+            len(base_g)
+            and np.any(end_np[base_g] == end_np[base_g + vcap])
+        )
+        if not end_conflict:
+            return [False] * k
+        fails = []
+        failed = False
+        for t, r, _c, _cr in wins:
+            if not failed:
+                failed = _delta_conflict(t, r, vcap)
+            fails.append(failed)
+        self._failed = failed
+        return fails
+
+    def checkpoint_granularity(self) -> int:
+        """Like the CC mixin: superbatching (and thus group-aligned
+        barriers) is skipped under ``transient_state``."""
+        return 1 if self.transient_state else super().checkpoint_granularity()
+
     def _ensure_forest(self, vcap: int) -> None:
+        host = self._bp_mode == "host"
         if self._canon is None:
             if self._summary is not None and "touched" in self._summary:
                 # restored (or converted) dense state: flat cover labels
@@ -133,24 +377,45 @@ class BipartitenessCheck(SummaryBulkAggregation):
                 base = np.nonzero(tch[: self._vcap])[0].astype(np.int32)
                 self._log.add(base)
                 flat = resolve_flat_host(lab.astype(np.int32))
-                self._failed = jnp.bool_(
+                failed = (
                     bool(np.any(flat[base] == flat[base + self._vcap]))
                     if len(base) else False
                 )
             else:
                 self._vcap = vcap
                 self._canon = jnp.arange(2 * vcap, dtype=jnp.int32)
-                self._failed = jnp.bool_(False)
                 self._log = TouchLog(vcap)
-            self._prep = WindowPrep()
+                failed = False
+            self._failed = failed if host else jnp.bool_(failed)
+            if host:
+                from .. import native
+
+                self._uf = native.CompactUnionFind()
+                self._uf.load(np.asarray(self._canon))
+            else:
+                self._prep = WindowPrep()
         if vcap > self._vcap:
-            self._canon = cover_grow_forest(self._canon, self._vcap, vcap)
+            if host:
+                # the cover re-index rule applies to the union-find's
+                # table too: flatten, shift the negative half, reload
+                shifted = _shift_cover_labels(
+                    self._uf.flatten(2 * self._vcap), self._vcap, vcap
+                )
+                self._uf.load(shifted)
+                self._canon = jnp.asarray(shifted)
+            else:
+                self._canon = cover_grow_forest(
+                    self._canon, self._vcap, vcap
+                )
             # base-only log: base ids never shift on growth
             self._vcap = vcap
         self._log.grow(self._vcap)
 
     def _to_dense(self) -> None:
-        flat = resolve_flat(self._canon)
+        if self._bp_mode == "host":
+            flat = jnp.asarray(self._uf.flatten(2 * self._vcap))
+        else:
+            flat = resolve_flat(self._canon)
         touched2 = np.zeros(2 * self._vcap, bool)
         touched2[: self._vcap] = self._log.touched_bool(self._vcap)
         self._summary = {"labels": flat, "touched": jnp.asarray(touched2)}
@@ -158,20 +423,28 @@ class BipartitenessCheck(SummaryBulkAggregation):
         self._failed = None
         self._log = None
         self._prep = None
+        self._uf = None
 
     def _reset_transient(self) -> None:
-        if self._bp_mode == "forest":
+        if self._bp_mode in ("forest", "host"):
             self._canon = jnp.arange(2 * self._vcap, dtype=jnp.int32)
-            self._failed = jnp.bool_(False)
             self._log = TouchLog(self._vcap)
             self._summary = {"labels": self._canon}
+            if self._bp_mode == "host":
+                self._failed = False
+                self._uf.load(np.arange(2 * self._vcap, dtype=np.int32))
+            else:
+                self._failed = jnp.bool_(False)
         else:
             self._summary = self.initial_state(self._vcap)
 
-    # ---- checkpoint surface: one format for both carries ---- #
+    # ---- checkpoint surface: one format for all carries ---- #
     def snapshot_state(self) -> Any:
-        if self._bp_mode == "forest":
-            lab = resolve_flat_host(np.asarray(self._canon))
+        if self._bp_mode in ("forest", "host"):
+            if self._bp_mode == "host":
+                lab = self._uf.flatten(2 * self._vcap)
+            else:
+                lab = resolve_flat_host(np.asarray(self._canon))
             touched2 = np.zeros(2 * self._vcap, bool)
             touched2[: self._vcap] = self._log.touched_bool(self._vcap)
             return {"labels": lab, "touched": touched2}
@@ -184,3 +457,89 @@ class BipartitenessCheck(SummaryBulkAggregation):
         self._failed = None
         self._log = None
         self._prep = None
+        self._uf = None
+
+    # ---- serving surface (serving/server.py Servable contract) ------- #
+    def servable(self, vdict=None) -> "BipartitenessServable":
+        """Adapter publishing the live cover table per window for
+        :class:`~gelly_streaming_tpu.serving.query.BipartiteQuery`
+        (typed yes/no + odd-cycle conflict witness). ``vdict`` seeds the
+        boot payload when restoring from a checkpoint before any stream
+        is attached."""
+        return BipartitenessServable(self, vdict)
+
+
+class BipartitenessServable:
+    """:class:`~gelly_streaming_tpu.serving.server.Servable` adapter for
+    :class:`BipartitenessCheck`. Every carry publishes the 2*vcap cover
+    table per window — the live cover pointer forest (forest carry: each
+    window's functional scatter leaves the published buffer immutable)
+    or the dense flat cover labels — plus touch evidence for the seen
+    set: the forest carry ships its append-only log by reference and
+    COUNT (the first ``tcount`` entries never change, so the published
+    view is a valid snapshot with zero per-publish O(vcap) work), the
+    dense carry its ``touched`` table. The
+    :class:`~gelly_streaming_tpu.serving.query.QueryEngine` recomputes
+    the verdict + witness from the cover structure, so a query never
+    trusts a carried latch.
+
+    SUPERBATCH GRANULARITY: with ``superbatch=K`` the published cover
+    is the END-of-group state for all K publishes — safe (the cover
+    merge is monotone: a query sees a FRESHER verdict, never a wrong
+    one; bipartite->non-bipartite only ever flips forward), with the
+    same group-granular snapshot caveat as ``CCServable``."""
+
+    def __init__(self, agg, vdict=None):
+        from ..serving import BipartiteQuery
+
+        self.query_classes = (BipartiteQuery,)
+        self._agg = agg
+        self._vdict = vdict
+
+    def _payload(self, vdict) -> Optional[dict]:
+        agg = self._agg
+        if agg._bp_mode in ("forest", "host") and agg._canon is not None:
+            return {
+                "cover": agg._canon,
+                "tids": agg._log.ids,
+                "tcount": agg._log.count,
+                "vdict": vdict,
+            }
+        if (
+            agg._summary is not None
+            and "labels" in agg._summary
+            and "touched" in agg._summary
+        ):
+            labels = agg._summary["labels"]
+            if agg._donated_carry:
+                # dense superbatch carries are DONATED to the next
+                # group's dispatch — published snapshots must own
+                # their buffer (the CCServable rule)
+                labels = jnp.array(labels)
+            return {
+                "cover": labels,
+                "touched": agg._summary["touched"],
+                "vdict": vdict,
+            }
+        return None
+
+    def payloads(self, stream):
+        vdict = stream.vertex_dict
+        self._vdict = vdict
+        window = 0
+        for _ in self._agg.run(stream):
+            window += 1
+            payload = self._payload(vdict)
+            if payload is None:  # carry not inspectable this window
+                continue
+            yield payload, window
+
+    def boot_payload(self):
+        """The restored summary as a servable payload (None when nothing
+        was restored yet, or no vdict is known)."""
+        if self._vdict is None:
+            return None
+        payload = self._payload(self._vdict)
+        if payload is None:
+            return None
+        return payload, 0

@@ -140,6 +140,7 @@ class _CCMixin:
         self._log = None      # host TouchLog
         self._uf = None       # native CompactUnionFind (host carry)
         self._prep = None     # WindowPrep scratch (forest carry)
+        self._gf_degree = 2   # resolved tree degree for the group fold
 
     # ---- dense-engine hooks (mesh / device-transformed fallback) ---- #
     def initial_state(self, vcap: int):
@@ -188,40 +189,48 @@ class _CCMixin:
             yield from self._one_window(block, cache, mesh, eff_degree, vdict)
 
     def _run_superbatched_cc(self, stream, mesh, eff_degree, vdict, k):
-        """Drive the stream in fused K-window groups: the host/forest
-        carries fold each group as ONE batched device dispatch
-        (``_host_group`` / ``_forest_group``) with mid-group canons
-        reconstructed lazily by the group's emissions; dense mode
-        superbatches through the generic engine scan (``_dense_group``).
-        Groups come from the stream's packer (zero per-window device
-        assembly on the windower fast path) and are PREFETCHED one group
-        ahead — host assembly of group N+1 overlaps the fold of N, the
-        pipeline coupling at group granularity."""
-        from ..core.pipeline import prefetch
-        from ..core.window import iter_superbatches
+        """Drive the stream in fused K-window groups through the shared
+        :func:`~gelly_streaming_tpu.summaries.groupfold.drive_group_folded`
+        loop — the CC carries' ``GroupFoldable`` declaration. Each group
+        folds as ONE batched dispatch (``_host_group`` /
+        ``_forest_group``) with mid-group canons reconstructed lazily by
+        the group's emissions; dense mode superbatches through the
+        generic engine scan (``_dense_group``)."""
+        from ..summaries.groupfold import drive_group_folded
 
-        for group in prefetch(iter_superbatches(stream, k), 2):
-            windowed = (
-                group.cols is not None
-                and self.carry != "dense"
-                and self._cc_mode != "dense"
+        self._gf_mesh = mesh
+        self._gf_vdict = vdict
+        self._gf_degree = eff_degree
+        yield from drive_group_folded(self, stream, k)
+
+    def fold_group(self, group) -> Iterator[Components]:
+        """The CC carries' declared group fold: host union-find group
+        call / forest group-local fused scan / dense engine scan, picked
+        by the live carry mode. Supports every group — members without
+        host column views downgrade to the dense carry, exactly like the
+        per-window path."""
+        mesh, vdict = self._gf_mesh, self._gf_vdict
+        windowed = (
+            group.cols is not None
+            and self.carry != "dense"
+            and self._cc_mode != "dense"
+        )
+        if windowed and self._cc_mode is None:
+            self._cc_mode = (
+                self.carry if self.carry != "auto" else _auto_carry()
             )
-            if windowed and self._cc_mode is None:
-                self._cc_mode = (
-                    self.carry if self.carry != "auto" else _auto_carry()
-                )
-            if windowed and self._cc_mode in ("forest", "host"):
-                if self._cc_mode == "host":
-                    yield from self._host_group(group, vdict)
-                else:
-                    yield from self._forest_group(
-                        group, mesh, eff_degree, vdict
-                    )
+        if windowed and self._cc_mode in ("forest", "host"):
+            if self._cc_mode == "host":
+                yield from self._host_group(group, vdict)
             else:
-                if self._cc_mode in ("forest", "host"):
-                    self._to_dense()
-                self._cc_mode = "dense"
-                yield from self._dense_group(group, mesh, vdict)
+                yield from self._forest_group(
+                    group, mesh, self._gf_degree, vdict
+                )
+        else:
+            if self._cc_mode in ("forest", "host"):
+                self._to_dense()
+            self._cc_mode = "dense"
+            yield from self._dense_group(group, mesh, vdict)
 
     def _one_window(self, block, cache, mesh, eff_degree, vdict):
         """The per-window path (every carry; superbatch groups bypass it)."""

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.edgeblock import bucket_capacity
+from ..summaries.groupfold import GroupFoldable
 
 
 class PageRankEmission(NamedTuple):
@@ -53,11 +54,14 @@ class PageRankEmission(NamedTuple):
 
 
 @functools.lru_cache(maxsize=None)
-def _build_pr_step(mesh, chunk: int, max_chunks: int):
-    """Build the jitted window step, optionally edge-sharded over a mesh.
-    Memoized on (mesh, chunk, max_chunks): every instance with the same
-    config shares one jit (and therefore XLA's compile cache) — a
-    per-instance wrapper would re-trace the whole fixpoint each time.
+def _make_pr_window_body(mesh, chunk: int, max_chunks: int):
+    """Build the UN-jitted one-window fold ``step(carry, bsrc, bdst,
+    n_edges0, n_new, n_seen, damping, tol) -> (carry, delta, iters)``.
+
+    Shared verbatim by the per-window jit (:func:`_build_pr_step`) and
+    the superbatch scan body (:func:`_build_pr_group_step`) so the two
+    paths cannot drift — the group fold's value-identity contract
+    (``summaries/groupfold.py``) rests on this being ONE function.
 
     One window = append + warm-start + chunked fixpoint, one dispatch.
     ``carry`` is ``(src, dst, ranks)`` device arrays at bucketed capacity,
@@ -168,15 +172,70 @@ def _build_pr_step(mesh, chunk: int, max_chunks: int):
             )(src, dst, mask, ranks, active, n, damping, tol)
         return (src, dst, ranks), delta, iters
 
-    return jax.jit(step, donate_argnums=(0,))
+    return step
 
 
-class IncrementalPageRank:
+@functools.lru_cache(maxsize=None)
+def _build_pr_step(mesh, chunk: int, max_chunks: int):
+    """The jitted per-window step over the shared window body, carry
+    donated (in-place HBM reuse; see :func:`_make_pr_window_body`)."""
+    return jax.jit(
+        _make_pr_window_body(mesh, chunk, max_chunks), donate_argnums=(0,)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_pr_group_step(mesh, chunk: int, max_chunks: int):
+    """K window steps fused into ONE jitted ``lax.scan`` dispatch — the
+    :class:`~gelly_streaming_tpu.summaries.groupfold.GroupFoldable`
+    fold for PageRank, mirroring the engine's ``_superbatch_step``.
+
+    ``superstep(carry, bsrc, bdst, n_edges0, n_new, n_seen, damping,
+    tol)`` scans the shared window body over the stacked ``[K, cap]``
+    block columns with per-window ``n_new``/``n_seen`` scalars riding
+    the scan's xs and the edge watermark carried as a traced scalar
+    (window k appends where windows < k left off — sequential window
+    semantics preserved inside one dispatch). The carry is DONATED like
+    the per-window step's; the stacked per-window ``(delta, iters)``
+    outputs are fresh buffers backing the group's lazy emissions."""
+    window_body = _make_pr_window_body(mesh, chunk, max_chunks)
+
+    def superstep(carry, bsrc, bdst, n_edges0, n_new, n_seen, damping,
+                  tol):
+        def body(c, xs):
+            cr, n_e = c
+            bs, bd, nn, ns = xs
+            cr, delta, iters = window_body(
+                cr, bs, bd, n_e, nn, ns, damping, tol
+            )
+            return (cr, n_e + nn), (delta, iters)
+
+        (carry, _n_end), (deltas, iters) = jax.lax.scan(
+            body, (carry, n_edges0), (bsrc, bdst, n_new, n_seen)
+        )
+        return carry, deltas, iters
+
+    return jax.jit(superstep, donate_argnums=(0,))
+
+
+class IncrementalPageRank(GroupFoldable):
     """``run(stream)`` folds each window's edges into the carried graph and
     re-converges ranks from the previous fixpoint.
 
     ``max_iter`` bounds total power iterations per window (rounded up to a
     multiple of ``chunk``, the early-exit granularity).
+
+    ``superbatch=K`` fuses K consecutive windows into ONE scanned
+    dispatch (the :class:`GroupFoldable` declaration — the same
+    small-window latency-cliff fix the engine and CC carries got in
+    PR 2): the shared window body scans over the group's stacked
+    columns with the rank/edge carry donated, per-window
+    ``(iterations, l1_delta)`` surfacing as lazy device slices of the
+    scan's stacked outputs. Emission VALUES are per-window identical
+    (the per-window seen-vertex counts reconstruct exactly from the
+    group encode — ``SuperbatchGroup.n_seen_per_window``); a group's K
+    emissions surface together after its dispatch, and checkpoint
+    barriers land on group boundaries (:meth:`checkpoint_granularity`).
     """
 
     def __init__(
@@ -186,6 +245,7 @@ class IncrementalPageRank:
         max_iter: int = 100,
         chunk: int = 10,
         mesh=None,
+        superbatch: int = 1,
     ):
         self.damping = damping
         self.tol = tol
@@ -194,10 +254,23 @@ class IncrementalPageRank:
         #: optional device mesh: the per-window fixpoint shards the edge
         #: columns over the ``"edges"`` axis with per-iteration psum
         self.mesh = mesh
+        if superbatch < 1:
+            raise ValueError(f"superbatch must be >= 1, got {superbatch}")
+        self.superbatch = int(superbatch)
         self._step = _build_pr_step(mesh, self.chunk, self.max_chunks)
+        self._group_step = None  # built on first group fold
         self._carry = None  # (src, dst, ranks) device arrays
         self._n_edges = 0  # host mirror of the append position
         self._vdict = None
+        self._w = 0  # next emission's window index (run-scoped)
+        #: carried seen-vertex watermark: ``max(restored, 1 + max compact
+        #: id streamed so far)``. Derived from the STREAM's ids, not from
+        #: ``len(vertex_dict)`` — the live dict runs ahead of consumption
+        #: under prefetch/group packing (and a group-boundary checkpoint
+        #: therefore restores an over-full dict), so dict length is not a
+        #: per-window value; the id watermark is, for both dictionary
+        #: kinds (sequential first-seen assignment / identity observe).
+        self._n_seen = 0
 
     # ------------------------------------------------------------------ #
     def _ensure_capacity(self, block_cap: int, vcap: int) -> None:
@@ -231,17 +304,127 @@ class IncrementalPageRank:
 
     def run(self, stream) -> Iterator[PageRankEmission]:
         self._vdict = stream.vertex_dict
-        for w, block in enumerate(stream.blocks()):
-            n_new = int(np.asarray(block.to_host()[0]).shape[0])
-            n_seen = len(self._vdict)
-            self._ensure_capacity(block.capacity, block.n_vertices)
-            self._carry, delta, iters = self._step(
-                self._carry, block.src, block.dst,
-                jnp.int32(self._n_edges), jnp.int32(n_new),
-                jnp.int32(n_seen), self.damping, self.tol,
+        self._w = 0
+        if self.superbatch > 1:
+            from ..summaries.groupfold import drive_group_folded
+
+            yield from drive_group_folded(self, stream, self.superbatch)
+            return
+        for block in stream.blocks():
+            yield self._one_window(block)
+
+    def _one_window(self, block) -> PageRankEmission:
+        """The per-window fold (shared by the plain run loop and the
+        group-fold fallback for groups packed without column views)."""
+        n_new = int(np.asarray(block.to_host()[0]).shape[0])
+        cache = getattr(block, "_host_cache", None)
+        if cache is not None and len(cache[0]):
+            self._n_seen = max(
+                self._n_seen,
+                1 + int(max(cache[0].max(), cache[1].max())),
             )
-            self._n_edges += n_new
-            yield PageRankEmission(w, n_seen, iters, delta)
+        elif cache is None:
+            # device-transformed block: no host ids to advance the
+            # watermark from; the live dict is the only source
+            self._n_seen = max(self._n_seen, len(self._vdict))
+        n_seen = self._n_seen
+        self._ensure_capacity(block.capacity, block.n_vertices)
+        self._carry, delta, iters = self._step(
+            self._carry, block.src, block.dst,
+            jnp.int32(self._n_edges), jnp.int32(n_new),
+            jnp.int32(n_seen), self.damping, self.tol,
+        )
+        self._n_edges += n_new
+        w = self._w
+        self._w += 1
+        return PageRankEmission(w, n_seen, iters, delta)
+
+    # ---- GroupFoldable declaration (summaries/groupfold.py) ---------- #
+    def group_supported(self, group) -> bool:
+        """The fused path needs the packer's host column views (the
+        per-window seen-vertex watermark reconstructs from their compact
+        ids); groups packed from pre-built blocks fall back."""
+        return group.cols is not None
+
+    def fold_group(self, group) -> Iterator[PageRankEmission]:
+        """K windows as ONE scanned dispatch (see class docstring): pad
+        the group's columns to one ``[K, wcap]`` stack, advance the
+        carried seen-vertex watermark per member window, scan the shared
+        window body with the carry donated, and emit the K per-window
+        ``(iterations, l1_delta)`` as lazy device slices of the scan's
+        stacked outputs."""
+        from ..core.emission import iter_unstacked
+        from ..obs import trace as _trace
+
+        k = len(group)
+        cols = group.cols
+        lens = [len(c[0]) for c in cols]
+        # per-window seen counts from the carried watermark + each
+        # window's compact ids — exactly the per-window path's sequence
+        # (SuperbatchGroup.n_seen_per_window applies the same rule from
+        # the packer's side; the carried form survives checkpoint
+        # restore, where the dict itself may have run ahead)
+        n_seen_w = []
+        n = self._n_seen
+        for s, d, _v in cols:
+            if len(s):
+                n = max(n, 1 + int(max(s.max(), d.max())))
+            n_seen_w.append(n)
+        self._n_seen = n
+        wmin = 8
+        if self.mesh is not None:
+            wmin = max(wmin, dict(self.mesh.shape).get("edges", 1))
+        wcap = bucket_capacity(max(lens), minimum=wmin)
+        total_new = int(sum(lens))
+        # edge capacity must hold every member window's padded append:
+        # the LAST window writes [wcap] at n_edges + (total_new - its
+        # own length), the deepest offset of the group
+        self._ensure_capacity(
+            total_new - lens[-1] + wcap, group.n_vertices
+        )
+        bsrc = np.zeros((k, wcap), np.int32)
+        bdst = np.zeros((k, wcap), np.int32)
+        for i, (s, d, _v) in enumerate(cols):
+            bsrc[i, : lens[i]] = s
+            bdst[i, : lens[i]] = d
+        if self._group_step is None:
+            self._group_step = _build_pr_group_step(
+                self.mesh, self.chunk, self.max_chunks
+            )
+        with _trace.span(
+            "pagerank.group",
+            {"k": k, "edges": total_new,
+             "n_vertices": int(group.n_vertices)}
+            if _trace.on() else None,
+        ):
+            self._carry, deltas, iters = self._group_step(
+                self._carry, jnp.asarray(bsrc), jnp.asarray(bdst),
+                jnp.int32(self._n_edges),
+                jnp.asarray(np.asarray(lens, np.int32)),
+                jnp.asarray(np.asarray(n_seen_w, np.int32)),
+                self.damping, self.tol,
+            )
+        self._n_edges += total_new
+        w0 = self._w
+        self._w += k
+        for i, (delta_i, iters_i) in enumerate(
+            iter_unstacked((deltas, iters), k)
+        ):
+            yield PageRankEmission(
+                w0 + i, int(n_seen_w[i]), iters_i, delta_i
+            )
+
+    def fold_group_fallback(self, group) -> Iterator[PageRankEmission]:
+        """Per-window fold of a group without usable column views —
+        correctness never depends on how a group was packed. Cache-less
+        (device-transformed) blocks carry no host ids, so their seen
+        count falls back to the live dict, which may run AHEAD of
+        consumption under the drive loop's group prefetch — the same
+        documented looseness every prefetched per-window stream has
+        (``SimpleEdgeStream.prefetched``); streams that need exact
+        per-window teleport mass keep host column views."""
+        for block in group.blocks():
+            yield self._one_window(block)
 
     def sync(self) -> None:
         """Block until the carried (edges, ranks) device state is complete
@@ -267,18 +450,24 @@ class IncrementalPageRank:
         return {
             "edges": {"src": np.asarray(src)[:n], "dst": np.asarray(dst)[:n]},
             "ranks": np.asarray(ranks),
+            "n_seen": int(self._n_seen),
         }
 
     def load_state_dict(self, d: dict) -> None:
         if d["ranks"] is None:
             self._carry = None
             self._n_edges = 0
+            self._n_seen = 0
             return
         s = np.asarray(d["edges"]["src"], np.int32)
         t = np.asarray(d["edges"]["dst"], np.int32)
         self._n_edges = len(s)
         ecap = bucket_capacity(self._n_edges)
         ranks = np.asarray(d["ranks"], np.float32)
+        # legacy checkpoints predate the carried watermark: every seen
+        # vertex holds strictly positive mass after a fixpoint (teleport
+        # term), padding slots hold exactly 0 — the count reconstructs
+        self._n_seen = int(d.get("n_seen", np.count_nonzero(ranks)))
         self._carry = (
             jnp.asarray(np.pad(s, (0, ecap - len(s)))),
             jnp.asarray(np.pad(t, (0, ecap - len(t)))),
@@ -309,7 +498,11 @@ class RankServable:
     :class:`IncrementalPageRank`. The window step donates its carry, so
     each published snapshot is ``jnp.copy`` of the rank vector — one
     device-side copy per window; readers must never hold a donated
-    buffer (accessing it after the next dispatch raises)."""
+    buffer (accessing it after the next dispatch raises). With
+    ``superbatch=K`` a group's K emissions surface together, so all K
+    publishes copy the END-of-group ranks and snapshots advance at
+    group granularity (the CCServable caveat; run ``superbatch=1`` for
+    per-window snapshot pinning)."""
 
     def __init__(self, workload: IncrementalPageRank, vdict=None):
         from ..serving import RankQuery

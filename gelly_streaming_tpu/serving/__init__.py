@@ -44,6 +44,7 @@ checkpoint so it serves the restored summary while catching up.
 
 from .query import (
     Answer,
+    BipartiteQuery,
     ComponentSizeQuery,
     ConnectedQuery,
     DegreeQuery,
@@ -90,6 +91,7 @@ def __getattr__(name):
 
 __all__ = [
     "Answer",
+    "BipartiteQuery",
     "ComponentSizeQuery",
     "ConnectedQuery",
     "DeadlineExceeded",

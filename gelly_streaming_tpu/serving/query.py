@@ -109,6 +109,25 @@ class SummaryPullQuery(Query):
 
 
 @dataclass(frozen=True)
+class BipartiteQuery(Query):
+    """Is the streamed graph (still) bipartite? Graph-global, like
+    :class:`SummaryPullQuery`. The answer value is a typed dict::
+
+        {"bipartite": bool, "witness": raw_id | None}
+
+    ``witness`` is the smallest RAW vertex id whose two signed-cover
+    nodes share a component — a vertex on an odd cycle, the conflict
+    witness — when the graph is non-bipartite, else None. Answered from
+    the published cover forest (``summaries/candidates.py`` layout:
+    cover node (v,+) = v, (v,-) = v + vcap in a 2*vcap table), so the
+    verdict recomputes from the structural truth rather than trusting a
+    carried latch. O(vcap) per snapshot version, cached by the engine.
+    """
+
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
 class Answer:
     """One query's result, stamped with the snapshot it was answered
     from: ``window`` is that snapshot's window index, ``staleness`` the
@@ -225,6 +244,7 @@ class QueryEngine:
         SummaryPullQuery: "labels",
         DegreeQuery: "deg",
         RankQuery: "ranks",
+        BipartiteQuery: "cover",
     }
 
     def __init__(self, prefer_host="auto"):
@@ -236,6 +256,9 @@ class QueryEngine:
         )
         self._host_cache: dict = {}  # (version, payload key) -> np array
         self._pull_cache: Tuple[Optional[int], Optional[dict]] = (
+            None, None,
+        )
+        self._bp_cache: Tuple[Optional[int], Optional[dict]] = (
             None, None,
         )
 
@@ -364,6 +387,45 @@ class QueryEngine:
         self._pull_cache = (snap.version, doc)
         return doc
 
+    def bipartite(self, snap: PublishedSnapshot) -> dict:
+        """The :class:`BipartiteQuery` answer value (see its docstring).
+
+        Seen base vertices come from the payload's touch evidence —
+        either the append-only log view (``tids``/``tcount``, the
+        forest-carry publish shape: the first ``tcount`` entries of an
+        append-only log never change, so the published ref is a valid
+        snapshot) or a ``touched`` bool table (the dense carry /
+        restored-checkpoint shape). Cached per snapshot version: the
+        O(vcap) canonicalize + conflict scan runs once however many
+        clients ask."""
+        ver, cached = self._bp_cache
+        if ver == snap.version and cached is not None:
+            return cached
+        from ..summaries.forest import resolve_flat_host
+
+        cover = np.asarray(self._table(snap, "cover"))
+        vdict = snap.payload["vdict"]
+        vcap = cover.shape[0] // 2
+        lab = resolve_flat_host(cover)
+        if "tids" in snap.payload:
+            tids = np.asarray(
+                snap.payload["tids"][: snap.payload["tcount"]], np.int64
+            )
+            tids = tids[tids < vcap]
+        else:
+            touched = np.asarray(snap.payload["touched"])
+            tids = np.nonzero(touched[:vcap])[0]
+        conflicted = tids[lab[tids] == lab[tids + vcap]]
+        if len(conflicted):
+            witness = int(
+                np.min(np.asarray(vdict.decode(conflicted), np.int64))
+            )
+            doc = {"bipartite": False, "witness": witness}
+        else:
+            doc = {"bipartite": True, "witness": None}
+        self._bp_cache = (snap.version, doc)
+        return doc
+
     def degree(self, snap: PublishedSnapshot, vs: np.ndarray) -> np.ndarray:
         return self._table_gather(snap, "deg", vs, fill=0)
 
@@ -411,10 +473,13 @@ class QueryEngine:
                     f"snapshot payload (keys {sorted(snap.payload)}) does "
                     f"not serve {qcls.__name__}"
                 )
-            if qcls is SummaryPullQuery:
+            if qcls in (SummaryPullQuery, BipartiteQuery):
                 # one cached doc answers the whole group (dict-valued,
                 # so it bypasses the ndarray tail below)
-                doc = self.summary_pull(snap)
+                doc = (
+                    self.summary_pull(snap)
+                    if qcls is SummaryPullQuery else self.bipartite(snap)
+                )
                 for i in idxs:
                     out[i] = Answer(
                         value=doc, window=snap.window,

@@ -71,6 +71,7 @@ from ..obs.registry import get_registry
 from ..resilience import faults as _faults
 from .query import (
     Answer,
+    BipartiteQuery,
     ComponentSizeQuery,
     ConnectedQuery,
     DegreeQuery,
@@ -242,6 +243,7 @@ _Q_KINDS = {
     "R": (RankQuery, 1),
     "S": (ComponentSizeQuery, 1),
     "P": (SummaryPullQuery, 0),
+    "B": (BipartiteQuery, 0),
 }
 _Q_TAGS = {
     ConnectedQuery: "C",
@@ -249,6 +251,7 @@ _Q_TAGS = {
     RankQuery: "R",
     ComponentSizeQuery: "S",
     SummaryPullQuery: "P",
+    BipartiteQuery: "B",
 }
 
 
@@ -262,7 +265,7 @@ def encode_queries(queries) -> List[list]:
             )
         if tag == "C":
             out.append([tag, int(q.u), int(q.v)])
-        elif tag == "P":
+        elif tag in ("P", "B"):
             out.append([tag])
         else:
             out.append([tag, int(q.v)])

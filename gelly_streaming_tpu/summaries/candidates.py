@@ -26,7 +26,9 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
+from ..core.edgeblock import bucket_capacity
 from .forest import chase_and_group, commit_roots, pad_window
 from .labels import _propagate, init_labels
 
@@ -163,6 +165,145 @@ def cover_forest_window(canon, failed, src_h, dst_h, vcap: int, prep):
     return canon, failed, tids
 
 
+def _cover_superbatch_fn(tcap: int, wcap: int, vcap: int, k: int):
+    """K cover window-steps fused into one jitted dispatch, GROUP-LOCAL —
+    the signed-cover analog of ``forest._forest_superbatch_fn`` (the
+    bipartiteness carry's ``GroupFoldable`` kernel):
+
+    1. ONE root chase + same-root grouping over the group's union
+       touched set, expanded to BOTH cover halves (lane i = (t_i, +),
+       lane i + tcap = (t_i, -)) — one 2*vcap scratch memset per GROUP;
+    2. a ``lax.scan`` over the K windows whose carry is the 2*tcap-sized
+       local label table plus the failure latch: window k folds its
+       cover edges ((u,+)~(v,-), (u,-)~(v,+); pad rows carry a real edge
+       mask, the ``_cover_step_fn`` caveat) into the carried table and
+       emits its new-root assignment ``nr_k`` PLUS the latch after the
+       window (the per-window sibling-conflict check runs over the
+       GROUP's touched lanes — sound, because ``nr_k`` equality means
+       "same cover component as of window k" for every group-touched
+       lane, and complete, because a conflict arising at window k lives
+       in a sign-symmetric component whose touched members witness it);
+    3. ONE masked scatter pair commits the final assignment.
+
+    Mid-group canons reconstruct lazily from ``(r, nr_k)`` via
+    :class:`~gelly_streaming_tpu.summaries.forest.ForestReplay` (the
+    cover id space is just a forest of 2*vcap nodes, so the CC replay
+    applies verbatim); the input canon is NOT donated — the pre-group
+    buffer backs the group's lazy emissions."""
+    key = ("superbatch", tcap, wcap, vcap, k)
+    fn = _COVER_STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    tcap2, vcap2 = 2 * tcap, 2 * vcap
+
+    def step(canon, failed, tid, tmask, lu, lv, emask):
+        # cover touched bucket + per-window cover edges, derived
+        # in-graph from the base prep (lu/lv/emask are [k, wcap])
+        tid2 = jnp.concatenate([tid, tid + vcap])
+        tmask2 = jnp.concatenate([tmask, tmask])
+        lu2 = jnp.concatenate([lu, lu + tcap], axis=1)
+        lv2 = jnp.concatenate([lv + tcap, lv], axis=1)
+        emask2 = jnp.concatenate([emask, emask], axis=1)
+        r, v2, key_, iota = chase_and_group(canon, tid2, tmask2, tcap2, vcap2)
+        # v2 is a depth-1 min-rooted forest encoding the pre-group
+        # same-root constraints — already a valid label table seed
+        lab0 = v2
+
+        def body(c, xs):
+            lab, fail = c
+            lu_k, lv_k, em_k = xs
+            u = jnp.concatenate([lu_k, iota])
+            w = jnp.concatenate([lv_k, lab])
+            m = jnp.concatenate([em_k, jnp.ones(tcap2, bool)])
+            lab = _propagate(lab, u, w, m)
+            minr = jnp.full(tcap2, _I32_MAX, jnp.int32).at[lab].min(key_)
+            nr = minr[lab]
+            fail = fail | jnp.any(tmask & (nr[:tcap] == nr[tcap:]))
+            return (lab, fail), (nr, fail)
+
+        (_lab_end, fail_end), (nr_s, fail_s) = lax.scan(
+            body, (lab0, failed), (lu2, lv2, emask2)
+        )
+        nr_end = nr_s[-1]
+        sid_r = jnp.where(tmask2, r, vcap2)
+        canon = canon.at[sid_r].set(nr_end, mode="drop")
+        tid_s = jnp.where(tmask2, tid2, vcap2)
+        canon = canon.at[tid_s].set(nr_end, mode="drop")
+        return canon, fail_end, r, nr_s, fail_s
+
+    fn = jax.jit(step)
+    if len(_COVER_STEP_CACHE) >= _COVER_STEP_CACHE_MAX:
+        _COVER_STEP_CACHE.pop(next(iter(_COVER_STEP_CACHE)))
+    _COVER_STEP_CACHE[key] = fn
+    return fn
+
+
+def cover_forest_superbatch(canon, failed, windows, vcap: int, prep):
+    """Fold K windows (list of host base ``(src_h, dst_h)`` column
+    pairs) into the cover forest as ONE fused group-local dispatch —
+    the cover analog of :func:`~gelly_streaming_tpu.summaries.forest.forest_superbatch`,
+    sharing its host prep shape: one prep per window for the per-window
+    touched ids (the first-seen log advances in window order), one prep
+    over the concatenated columns for the group touched set + the
+    group-local renumbering.
+
+    Returns ``(new_canon, new_failed, [touched_ids per window], replay,
+    fail_stack)`` — ``replay`` is a cover-space
+    :class:`~gelly_streaming_tpu.summaries.forest.ForestReplay` for lazy
+    mid-group canon reconstruction, ``fail_stack`` the device ``[k]``
+    per-window failure latches."""
+    from .forest import ForestReplay
+
+    if prep is None:
+        raise ValueError(
+            "cover_forest_superbatch requires a per-stream WindowPrep "
+            "(see forest_window)"
+        )
+    k = len(windows)
+    _e = np.zeros(0, np.int32)
+    win_tids = [
+        prep.prep(s, d, vcap)[0] if len(s) else _e for s, d in windows
+    ]
+    src_g = np.concatenate([s for s, _ in windows]) if k else _e
+    dst_g = np.concatenate([d for _, d in windows]) if k else _e
+    if len(src_g):
+        tids_g, lu_all, lv_all = prep.prep(src_g, dst_g, vcap)
+    else:
+        tids_g, lu_all, lv_all = _e, _e, _e
+    n_max = max((len(s) for s, _ in windows), default=0)
+    tcap = bucket_capacity(len(tids_g), minimum=8)
+    wcap = bucket_capacity(n_max, minimum=8)
+    t = len(tids_g)
+    tid = np.zeros(tcap, np.int32)
+    tid[:t] = tids_g
+    tmask = np.zeros(tcap, bool)
+    tmask[:t] = True
+    lu = np.zeros((k, wcap), np.int32)
+    lv = np.zeros((k, wcap), np.int32)
+    emask = np.zeros((k, wcap), bool)
+    off = 0
+    for i, (s, _) in enumerate(windows):
+        n = len(s)
+        lu[i, :n] = lu_all[off:off + n]
+        lv[i, :n] = lv_all[off:off + n]
+        emask[i, :n] = True
+        off += n
+    step = _cover_superbatch_fn(tcap, wcap, vcap, k)
+    new_canon, new_failed, r_dev, nr_s, fail_s = step(
+        canon, failed,
+        jnp.asarray(tid), jnp.asarray(tmask),
+        jnp.asarray(lu), jnp.asarray(lv), jnp.asarray(emask),
+    )
+    # the replay works in the 2*vcap cover id space: both cover halves
+    # of the touched bucket, the chased old roots, the per-window
+    # assignments — exactly the CC replay's contract
+    tid2 = np.concatenate([tid, tid + vcap])
+    tmask2 = np.concatenate([tmask, tmask])
+    replay = ForestReplay(canon, tid2, tmask2, r_dev, nr_s)
+    return new_canon, new_failed, win_tids, replay, fail_s
+
+
 def cover_grow_forest(canon, old_vcap: int, new_vcap: int):
     """Re-index the cover forest when the vertex capacity bucket grows
     (one host rebuild per pow2 growth event, same cost shape and SAME
@@ -188,9 +329,11 @@ class Candidates:
     def __init__(self, success=None, components=None, *, _lazy=None):
         self._success = success
         self._components = components
-        # (canon_dev, failed_dev, touch_log, count, vcap, vdict): forest-
-        # carry emission — one device read + host canonicalization on
-        # first access, so unread windows cost nothing
+        # (canon_dev | (replay, window_k, fail_stack), failed_dev,
+        # touch_log, count, vcap, vdict): forest-carry emission — one
+        # device read + host canonicalization on first access, so
+        # unread windows cost nothing. The replay form is the
+        # superbatched carry's mid-group view (from_forest_replay).
         self._lazy = _lazy
 
     def _mat(self) -> None:
@@ -199,12 +342,23 @@ class Candidates:
         from .forest import resolve_flat_host
 
         canon, failed, log, count, vcap, vdict = self._lazy
-        lab_np, failed_np = jax.device_get((canon, failed))
-        self._lazy = None
-        if bool(failed_np):
-            self._success, self._components = False, {}
-            return
-        lab = resolve_flat_host(np.asarray(lab_np))
+        if isinstance(canon, tuple):
+            # superbatch replay: reconstruct this window's cover canon
+            # from the group's delta stack, verdict from the stacked
+            # per-window latch (one device read each, on first access)
+            replay, kk, fail_s = canon
+            self._lazy = None
+            if bool(np.asarray(fail_s[kk])):
+                self._success, self._components = False, {}
+                return
+            lab = resolve_flat_host(replay.canon_np(kk))
+        else:
+            lab_np, failed_np = jax.device_get((canon, failed))
+            self._lazy = None
+            if bool(failed_np):
+                self._success, self._components = False, {}
+                return
+            lab = resolve_flat_host(np.asarray(lab_np))
         # the log holds BASE ids only (< vcap at snapshot time); the
         # negative cover half derives as base + vcap, and from_cover only
         # reads the base half of the mask — so a dict that grew past the
@@ -230,6 +384,17 @@ class Candidates:
     @staticmethod
     def from_forest(canon, failed, log, count, vcap, vdict) -> "Candidates":
         return Candidates(_lazy=(canon, failed, log, count, vcap, vdict))
+
+    @staticmethod
+    def from_forest_replay(replay, k, fail_stack, log, count, vcap,
+                           vdict) -> "Candidates":
+        """Lazy mid-group emission for the superbatched cover carry
+        (:func:`cover_forest_superbatch`): window ``k``'s cover canon
+        reconstructs from the group ``replay`` on first read, its
+        verdict from the stacked per-window latch ``fail_stack[k]``."""
+        return Candidates(
+            _lazy=((replay, k, fail_stack), None, log, count, vcap, vdict)
+        )
 
     def __bool__(self) -> bool:
         """Truthiness == the bipartiteness verdict (``success``): a

@@ -1,0 +1,161 @@
+"""The group-fold execution contract: ANY carry can declare a fused path.
+
+PR 2's superbatch work flattened the small-window latency cliff (208k ->
+5.99M eps at 1024-edge windows) but wired the fused K-window paths ad
+hoc: the engine scan lives in ``SummaryAggregation._superbatch_step``,
+the CC carries fork their own run loop (``forest_superbatch`` /
+``cuf_fold_group``), and every other workload — ``IncrementalPageRank``'s
+custom loop, the bipartiteness cover carry — stayed on the per-window
+cliff. This module extracts the contract those paths implement into ONE
+declared protocol, so a library algorithm gets the superbatch path by
+declaring a fold, not by forking the engine.
+
+The contract (:class:`GroupFoldable`):
+
+1. **Pack once.** A :class:`~gelly_streaming_tpu.core.window.SuperbatchGroup`
+   arrives with K windows' host column views from ONE group encode
+   (``Windower.pack_window_cols`` — zero per-window device work on the
+   ingest fast path). The fold consumes the group, never re-packs.
+2. **Fold fused.** :meth:`GroupFoldable.fold_group` folds the whole
+   group as ONE dispatch — a ``lax.scan`` over stacked columns (the
+   engine, PageRank, the cover carry) or one native call (the host CC
+   union-find) — and yields exactly ``len(group)`` per-window emissions
+   whose VALUES are identical to the per-window path's.
+3. **Reconstruct lazily.** Mid-group carry states exist only as the
+   group's delta stack; an emission that is actually read rebuilds its
+   window's view on first access (``ForestReplay`` / ``MirrorReplay`` /
+   stacked-row slices via ``emission.iter_unstacked``). Unread windows
+   cost nothing.
+4. **Checkpoint on boundaries.** The carried summary is observable only
+   between groups; :meth:`GroupFoldable.checkpoint_granularity` reports
+   the effective stride so barrier drivers
+   (:class:`~gelly_streaming_tpu.aggregate.autockpt.AutoCheckpoint`)
+   align — a mid-group snapshot can never pair an end-of-group summary
+   with a mid-group window count.
+
+:func:`drive_group_folded` is THE superbatch drive loop shared by every
+implementation (the engine, the CC mixin, bipartiteness, PageRank):
+groups come from the stream's packer and are prefetched one group ahead
+so the host assembles group N+1 while the device folds N.
+
+:func:`verify_group_fold` is the reusable conformance check — a new
+``GroupFoldable`` carry pins its per-window/group value identity with
+one call (``tests/test_groupfold.py`` uses it for all four
+implementations).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Iterator, Optional
+
+#: groups prefetched ahead of the fold — the group-granular pipeline
+#: coupling every drive loop uses (host assembly of group N+1 overlaps
+#: the fold of N; deeper would only hold more packed columns live)
+GROUP_PREFETCH_DEPTH = 2
+
+
+class GroupFoldable(abc.ABC):
+    """A workload whose carry declares a fused K-window group path.
+
+    Implementations fold one
+    :class:`~gelly_streaming_tpu.core.window.SuperbatchGroup` per
+    dispatch and yield per-window emissions that are VALUE-IDENTICAL to
+    their per-window path (the module-doc contract). The protocol is
+    deliberately engine-agnostic: ``SummaryAggregation`` subclasses and
+    standalone workloads (``IncrementalPageRank``) implement it alike.
+    """
+
+    @abc.abstractmethod
+    def fold_group(self, group) -> Iterator[Any]:
+        """Fold one supported group as ONE fused dispatch; yield its
+        ``len(group)`` per-window emissions (lazy mid-group views)."""
+
+    def group_supported(self, group) -> bool:
+        """Whether THIS group can take the fused path. Implementations
+        that depend on the packer's host column views or its seen-count
+        record override this (an unsupported group runs through
+        :meth:`fold_group_fallback` — correctness never depends on how
+        a group was packed)."""
+        return True
+
+    def fold_group_fallback(self, group) -> Iterator[Any]:
+        """Per-window fold of an unsupported group. Only reached when
+        :meth:`group_supported` can return False; the default keeps the
+        contract loud for implementations that claimed universal
+        support."""
+        raise NotImplementedError(
+            f"{type(self).__name__}.group_supported rejected a group "
+            "but no fold_group_fallback is implemented"
+        )
+
+    def checkpoint_granularity(self) -> int:
+        """Window stride at which the carried state is observable: the
+        group size where the run loop folds fused, 1 where it opts out.
+        Subclasses whose run loop opts out under extra conditions
+        (transient CC/bipartiteness) override this."""
+        return int(getattr(self, "superbatch", 1) or 1)
+
+
+def drive_group_folded(workload: GroupFoldable, stream, k: int,
+                       prefetch_groups: int = GROUP_PREFETCH_DEPTH
+                       ) -> Iterator[Any]:
+    """THE superbatch drive loop: pack K windows per group through the
+    stream's packer (:func:`~gelly_streaming_tpu.core.window.iter_superbatches`
+    — zero per-window device assembly on the windower fast path),
+    prefetch ahead, and delegate each group to the workload's declared
+    fold. Shared by every :class:`GroupFoldable` so the drive semantics
+    (group boundaries, prefetch coupling, fallback routing) cannot drift
+    between implementations."""
+    from ..core.pipeline import prefetch
+    from ..core.window import iter_superbatches
+
+    for group in prefetch(iter_superbatches(stream, k), prefetch_groups):
+        if workload.group_supported(group):
+            yield from workload.fold_group(group)
+        else:
+            yield from workload.fold_group_fallback(group)
+
+
+def verify_group_fold(
+    make_workload: Callable[[int], Any],
+    make_stream: Callable[[], Any],
+    k: int,
+    *,
+    normalize: Callable[[Any], Any] = str,
+    run: Optional[Callable[[Any, Any], Iterator[Any]]] = None,
+) -> list:
+    """Reusable protocol-conformance check: the grouped run must be
+    emission-for-emission value-identical to the per-window run.
+
+    ``make_workload(superbatch)`` builds a fresh workload;
+    ``make_stream()`` a fresh stream over the same source;
+    ``normalize(emission)`` maps an emission to a comparable value
+    (default ``str`` — materializes lazy emissions); ``run(workload,
+    stream)`` overrides how the workload is driven (default
+    ``workload.run(stream)``). Raises ``AssertionError`` naming the
+    first diverging window; returns the normalized per-window sequence
+    so callers can chain further checks."""
+    drive = run if run is not None else (lambda w, s: w.run(s))
+    base = [normalize(e) for e in drive(make_workload(1), make_stream())]
+    got = [normalize(e) for e in drive(make_workload(k), make_stream())]
+    if len(got) != len(base):
+        raise AssertionError(
+            f"group fold (k={k}) yielded {len(got)} emissions, "
+            f"per-window yielded {len(base)}"
+        )
+    for i, (a, b) in enumerate(zip(base, got)):
+        if not _values_equal(a, b):
+            raise AssertionError(
+                f"group fold (k={k}) diverges at window {i}: "
+                f"per-window {a!r} != grouped {b!r}"
+            )
+    return base
+
+
+def _values_equal(a, b) -> bool:
+    import numpy as np
+
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+    return a == b

@@ -75,6 +75,22 @@ def test_watched_metrics_exist_in_the_committed_artifact():
         assert isinstance(dig(committed, metric), (int, float)), metric
 
 
+def test_latency_watch_list_matches_the_latency_artifact():
+    # the ISSUE 14 satellite: the CI group-fold step watches the fused
+    # superbatch eps cells (CC points + per-algorithm algos) from the
+    # committed latency-curve artifact — every watched path must
+    # resolve behind its min: throughput-direction prefix
+    from tools.benchguard import WATCHED_LATENCY
+
+    path = os.path.join(REPO, "BENCH_LATENCY_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_LATENCY:
+        assert metric.startswith("min:")
+        value = dig(committed, metric[4:])
+        assert isinstance(value, (int, float)), metric
+
+
 def test_chaos_watch_list_matches_the_chaos_artifact():
     # the ISSUE 10 satellite: the CI chaos step watches recovery p50
     # from the committed chaos artifact — the watch list must resolve

@@ -54,6 +54,18 @@ WATCHED_CHAOS = ("recovery_s.p50",)
 #: bound direction (fresh must stay above committed / ratio)
 WATCHED_INGEST = ("min:cells.c4_binary.eps",)
 
+#: the latency-curve artifact's guarded cells (BENCH_LATENCY_CPU.json):
+#: the fused group-fold throughput at the 1024-edge cliff window, per
+#: algorithm that declares a group fold (ISSUE 14) — all throughput,
+#: so ``min:`` direction (regression is downward). The per-window
+#: columns are NOT guarded: they exist as the cliff baseline the fused
+#: cells are measured against, not as a trajectory anyone defends.
+WATCHED_LATENCY = (
+    "min:points.1024.superbatch.eps",
+    "min:algos.pagerank.1024.superbatch.eps",
+    "min:algos.bipartiteness.1024.superbatch.eps",
+)
+
 #: the sharded-serving artifact's guarded metrics
 #: (BENCH_SERVING_SHARDED_CPU.json): the cached routing tier's
 #: aggregate Zipfian QPS is throughput (``min:`` — regression is

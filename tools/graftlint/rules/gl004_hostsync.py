@@ -14,8 +14,11 @@ Two scopes:
    non-literal (everything in a scan body is traced).
 2. **per-window loops of the named hot modules**
    (``aggregate/summary.py``, ``core/window.py``,
-   ``summaries/forest.py``): ``for``/``while`` bodies may not call
-   ``.item()`` / ``.block_until_ready()`` / ``jax.device_get`` —
+   ``summaries/forest.py``, plus the group-fold surfaces —
+   ``summaries/groupfold.py``, ``summaries/candidates.py``,
+   ``library/pagerank.py``, the modules whose scan bodies/drive loops
+   the ISSUE 14 generalization added): ``for``/``while`` bodies may not
+   call ``.item()`` / ``.block_until_ready()`` / ``jax.device_get`` —
    these are unconditional device syncs. ``np.asarray``/``float`` are
    NOT flagged there: the host packing path uses them on host data by
    design, and the rule cannot see types.
@@ -34,6 +37,9 @@ HOT_MODULES = (
     "aggregate/summary.py",
     "core/window.py",
     "summaries/forest.py",
+    "summaries/groupfold.py",
+    "summaries/candidates.py",
+    "library/pagerank.py",
 )
 
 _SYNC_ATTRS = {"item", "block_until_ready"}
