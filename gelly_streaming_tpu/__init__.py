@@ -23,6 +23,7 @@ from .core.window import (
     CountWindow,
     EventTimeWindow,
     ProcessingTimeWindow,
+    ScheduledCountWindow,
     Windower,
     blocks_from_edges,
 )
@@ -46,6 +47,7 @@ __all__ = [
     "CountWindow",
     "EventTimeWindow",
     "ProcessingTimeWindow",
+    "ScheduledCountWindow",
     "Windower",
     "blocks_from_edges",
     "GraphStream",

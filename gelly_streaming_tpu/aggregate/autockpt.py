@@ -104,6 +104,35 @@ class _SkipStream:
             prefetch(self.blocks(), superbatch_prefetch_depth(k)), k
         )
 
+    def superbatches_dynamic(self, k_fn, skip: int = 0):
+        """Adaptive-K replay skip (the ``superbatch="auto"`` resume
+        path): the inner dynamic packer fast-forwards ``skip`` windows
+        THROUGH the group encode (the vertex-dictionary replay, tiled
+        at its own replay group size) without surfacing them. No tiling
+        agreement is needed — unlike the fixed-K skip, the resumed
+        controller is free to re-tile from the barrier onward, because
+        value identity holds for ANY tiling (the group-fold contract)
+        and barriers only ever landed on group boundaries. Defined
+        explicitly so ``__getattr__`` can never hand the caller the
+        INNER stream's packer with the skip silently dropped."""
+        inner = getattr(self._stream, "superbatches_dynamic", None)
+        if callable(inner):
+            yield from inner(k_fn, skip=self._skip + skip)
+            return
+        from ..core.pipeline import prefetch, superbatch_prefetch_depth
+        from ..core.window import superbatches_from_blocks_dynamic
+
+        # self.blocks() consumes self._skip; an ADDITIONAL skip from a
+        # nested wrapper must also be honored here, not dropped
+        blocks = self.blocks()
+        for _ in range(skip):
+            if next(blocks, None) is None:
+                break
+        yield from superbatches_from_blocks_dynamic(
+            prefetch(blocks, superbatch_prefetch_depth(int(k_fn()))),
+            k_fn,
+        )
+
 
 class AutoCheckpoint:
     """Snapshot ``work`` every ``every`` windows; resume transparently.
@@ -151,9 +180,15 @@ class AutoCheckpoint:
             self.AUTO_TARGET_OVERHEAD if target_overhead is None
             else target_overhead
         )
-        #: last measured costs (seconds), exposed for tests / telemetry
-        self.measured_barrier_s: Optional[float] = None
-        self.measured_window_s: Optional[float] = None
+        #: the ONE retune-signal implementation (ISSUE 15): barrier and
+        #: window costs are direct taps on the shared SignalReader —
+        #: the same reader the control-plane tuners consume — instead
+        #: of private fields, so every closed loop in the repo measures
+        #: through one code path (and tuning keeps working with obs
+        #: disabled, which the direct-tap half guarantees)
+        from ..control.signals import SignalReader
+
+        self.signals = SignalReader()
         self.keep = max(1, int(keep))
         #: artifacts already rejected, keyed by (path, mtime_ns, size):
         #: repeated _load scans (every windows_done() while all barriers
@@ -174,6 +209,21 @@ class AutoCheckpoint:
         #: decode restored state when the resumed stream yields nothing
         #: (barrier already covers the whole source)
         self.restored_vdict = None
+
+    # ------------------------------------------------------------------ #
+    @property
+    def measured_barrier_s(self) -> Optional[float]:
+        """Last measured barrier cost in seconds (state capture +
+        serialize + commit; None before the first barrier) — the
+        ``checkpoint.barrier_s`` direct tap on :attr:`signals`."""
+        return self.signals.last("checkpoint.barrier_s")
+
+    @property
+    def measured_window_s(self) -> Optional[float]:
+        """Last measured mean per-window wall seconds of the segment
+        before a barrier (None before the first) — the
+        ``checkpoint.window_s`` direct tap on :attr:`signals`."""
+        return self.signals.last("checkpoint.window_s")
 
     # ------------------------------------------------------------------ #
     def invalidate(self) -> None:
@@ -238,6 +288,19 @@ class AutoCheckpoint:
         k = int(gran()) if callable(gran) else 1
         if self.auto and self.every % k:
             self.every = self.every + (k - self.every % k)
+        # alignment: group-folded workloads report their EXACT group
+        # boundaries (checkpoint_aligned over windows-since-resume —
+        # required under superbatch="auto", where the controller
+        # re-tiles mid-run and no static modulo can know the
+        # boundaries); everything else keeps the historical modulo rule
+        aligned = getattr(work, "checkpoint_aligned", None)
+        use_pred = callable(aligned)
+        # a dynamically-tiled workload (superbatch="auto") has no static
+        # group stride for the modulo cadence to coincide with — its
+        # barriers land on the FIRST group boundary at least `every`
+        # windows past the previous barrier (the same counting rule the
+        # auto cadence tuner uses)
+        dynamic = bool(getattr(work, "superbatch_auto", False))
         w = done
         last_barrier = done
         seg_t0 = time.perf_counter()  # start of the inter-barrier segment
@@ -249,10 +312,11 @@ class AutoCheckpoint:
             # auto tuner counts windows SINCE the last barrier instead,
             # because `every` itself moves between barriers
             due = (
-                w - last_barrier >= self.every if self.auto
+                w - last_barrier >= self.every if self.auto or dynamic
                 else w % self.every == 0
             )
-            if due and w % k == 0:
+            ok = aligned(w - done) if use_pred else w % k == 0
+            if due and ok:
                 window_s = (time.perf_counter() - seg_t0) / max(
                     1, w - last_barrier
                 )
@@ -270,8 +334,8 @@ class AutoCheckpoint:
         fraction of wall time spent in barriers at or under the target,
         rounded UP to a superbatch-group multiple and clamped to
         [AUTO_MIN_EVERY, AUTO_MAX_EVERY]."""
+        self.signals.observe("checkpoint.window_s", window_s)
         barrier_s = self.measured_barrier_s
-        self.measured_window_s = window_s
         if not barrier_s or window_s <= 0:
             return
         want = math.ceil(barrier_s / (self.target_overhead * window_s))
@@ -335,8 +399,17 @@ class AutoCheckpoint:
         self._cache_valid = False
         # the measured barrier cost feeds the auto cadence tuner — the
         # same barrier_wait + serialize regions the obs spans time, but
-        # measured directly so tuning works with obs disabled
-        self.measured_barrier_s = time.perf_counter() - t0
+        # tapped DIRECTLY on the shared SignalReader so tuning works
+        # with obs disabled
+        barrier_s = time.perf_counter() - t0
+        self.signals.observe("checkpoint.barrier_s", barrier_s)
+        # and credited as FOREIGN time to this thread's throughput
+        # taps: a barrier lands between two of a group's yields, so
+        # without the credit the group controller (auto-K) would read
+        # it as a throughput collapse at the current K
+        from ..control.signals import add_excluded_s
+
+        add_excluded_s(barrier_s)
         if _faults.active():  # chaos hook: corrupt-the-barrier-just-written
             _faults.fire(
                 "checkpoint.committed", index=windows_done, path=committed

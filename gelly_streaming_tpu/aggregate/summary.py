@@ -111,12 +111,31 @@ class SummaryAggregation(GroupFoldable, abc.ABC):
     config_fields: tuple = ()
 
     def __init__(self, transient_state: bool = False, mesh=None,
-                 superbatch: int = 1):
+                 superbatch=1):
         self.transient_state = transient_state
         self.mesh = mesh
-        if superbatch < 1:
+        #: ``superbatch="auto"``: the run loop drives the fused-group
+        #: path under an :class:`~gelly_streaming_tpu.control.AutoK`
+        #: controller — K starts at 1 and is re-tuned at group
+        #: boundaries from measured group throughput (+ span ratios
+        #: when obs is on), with hysteresis and bounded steps;
+        #: ``self.superbatch`` then tracks the LIVE operating point.
+        self.superbatch_auto = superbatch == "auto"
+        if self.superbatch_auto:
+            superbatch = 1
+        elif isinstance(superbatch, str):
+            # a mistyped mode must fail with the accepted values, not
+            # with an unrelated str-vs-int comparison TypeError below
+            raise ValueError(
+                f'superbatch must be an int >= 1 or "auto", '
+                f"got {superbatch!r}"
+            )
+        elif superbatch < 1:
             raise ValueError(f"superbatch must be >= 1, got {superbatch}")
         self.superbatch = int(superbatch)
+        #: the live ControlPlane of an auto run (None otherwise); tests
+        #: and the bench read its AutoK history as retune evidence
+        self.control = None
         self._summary = None
         self._vcap = 0
         self._sync_ref = None  # last dispatched window state (sync target)
@@ -319,8 +338,13 @@ class SummaryAggregation(GroupFoldable, abc.ABC):
         mid-group snapshot can never pair an end-of-group summary with
         a mid-group window count; subclasses whose run loop opts out of
         superbatching under extra conditions override it (the CC mixin
-        does for ``transient_state``)."""
-        return self.superbatch if (self.device and self.superbatch > 1) else 1
+        does for ``transient_state``). Under ``superbatch="auto"`` this
+        reports the LIVE operating K — barrier drivers align exactly
+        through :meth:`~gelly_streaming_tpu.summaries.groupfold.GroupFoldable.checkpoint_aligned`,
+        which tracks the variable group boundaries themselves."""
+        if self.device and (self.superbatch > 1 or self.superbatch_auto):
+            return max(1, self.superbatch)
+        return 1
 
     def _device_block(self, block: EdgeBlock, mesh) -> None:
         """Grow + fold one block into the carried summary (the device
@@ -360,7 +384,7 @@ class SummaryAggregation(GroupFoldable, abc.ABC):
         """
         mesh = self._resolve_mesh(stream) if self.device else None
         vdict = stream.vertex_dict
-        if self.device and self.superbatch > 1:
+        if self.device and (self.superbatch > 1 or self.superbatch_auto):
             yield from self._run_superbatched(stream, mesh, vdict)
             return
         for block in stream.blocks():
@@ -389,10 +413,33 @@ class SummaryAggregation(GroupFoldable, abc.ABC):
         declaration driven by the shared
         :func:`~gelly_streaming_tpu.summaries.groupfold.drive_group_folded`
         loop (groups from the stream's packer, prefetched one ahead so
-        the host assembles superbatch N+1 while the device scans N)."""
+        the host assembles superbatch N+1 while the device scans N).
+        ``superbatch="auto"`` attaches a fresh
+        :class:`~gelly_streaming_tpu.control.ControlPlane` (AutoK +
+        adaptive group prefetch over one SignalReader) and lets the
+        drive loop re-tile at group boundaries."""
         self._gf_mesh = mesh
         self._gf_vdict = vdict
-        yield from drive_group_folded(self, stream, self.superbatch)
+        yield from drive_group_folded(
+            self, stream, self.superbatch,
+            controller=self._attach_control(self.superbatch),
+        )
+
+    def _attach_control(self, k: int):
+        """The ONE ``superbatch="auto"`` controller-attach rule for
+        every group-folded run loop (engine, CC, bipartiteness): None
+        unless auto; a pre-set plane is honored (the injection seam —
+        pin the knob via ``AutoK(k0=K, k_max=K)``, or share one
+        SignalReader across loops); otherwise the stock
+        :func:`~gelly_streaming_tpu.control.default_plane` is built
+        and kept on ``self.control``."""
+        if not self.superbatch_auto:
+            return None
+        if self.control is None:
+            from ..control import default_plane
+
+            self.control = default_plane(k)
+        return self.control
 
     def fold_group(self, group) -> Iterator[Any]:
         """The engine's declared group fold (see

@@ -1,0 +1,33 @@
+"""Self-tuning control plane (ISSUE 15).
+
+One adaptive controller layer that consumes live registry streams (and
+obs-independent direct taps) and retunes the knobs that were hand-picked
+until now: superbatch K, prefetch depth, serving admission/shed
+watermarks — with hysteresis, bounded step sizes, and every decision
+logged as a ``control.retune`` registry event the timeline renders.
+
+- :mod:`signals` — :class:`SignalReader`, THE retune-signal
+  implementation (windowed registry deltas + direct stopwatch taps).
+- :mod:`controller` — :class:`AutoK`, :class:`PrefetchTuner`,
+  :class:`AdmissionTuner`, bundled by :class:`ControlPlane`.
+"""
+
+from .controller import (
+    AdmissionTuner,
+    AutoK,
+    ControlPlane,
+    PrefetchTuner,
+    default_plane,
+    log_retune,
+)
+from .signals import SignalReader
+
+__all__ = [
+    "AdmissionTuner",
+    "AutoK",
+    "ControlPlane",
+    "PrefetchTuner",
+    "SignalReader",
+    "default_plane",
+    "log_retune",
+]

@@ -87,7 +87,8 @@ def bounded_put(q: "queue.Queue", item: Any, stop: threading.Event, *,
 def prefetch(iterator: Iterator[T], depth: int = 2,
              name: str = "pipeline", *,
              stall_timeout_s: Optional[float] = None,
-             join_timeout_s: float = 10.0) -> Iterator[T]:
+             join_timeout_s: float = 10.0,
+             tuner=None) -> Iterator[T]:
     """Iterate ``iterator`` on a background thread, ``depth`` items ahead.
 
     If the consumer abandons the generator early (break / exception /
@@ -123,8 +124,25 @@ def prefetch(iterator: Iterator[T], depth: int = 2,
 
     Disabled, none of the extra clock reads happen (checked once per
     item against the trace flag).
+
+    ``tuner`` (a :class:`~gelly_streaming_tpu.control.PrefetchTuner`)
+    makes the depth ADAPTIVE: the queue is allocated at the tuner's
+    ``depth_max`` and the producer honors the tuner's live ``depth`` as
+    a soft cap, while both sides tap their blocked/idle seconds into
+    the tuner — which moves the depth with hysteresis and bounded steps
+    (ISSUE 15). Opting into a tuner opts into one clock read per item
+    on each side, measured regardless of the obs flag (the tuner IS the
+    consumer of the measurement); ``depth`` is then ignored.
     """
-    q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, depth))
+    soft_cap = None if tuner is None else tuner
+    maxsize = max(1, depth) if tuner is None else max(1, tuner.depth_max)
+    q: "queue.Queue[Any]" = queue.Queue(maxsize=maxsize)
+    # the soft cap's wake-up channel: the consumer notifies after every
+    # pull, so a producer waiting at the cap blocks on a condition (no
+    # CPU) exactly like the hard queue's put — a qsize() poll loop here
+    # measured up to ~20% off the 2-core steady throughput, the wakeups
+    # contending with the two busy pipeline threads
+    space = threading.Condition() if soft_cap is not None else None
     error: list = []
     stop = threading.Event()
     # instruments resolve lazily on first enabled item so a prefetch
@@ -144,12 +162,29 @@ def prefetch(iterator: Iterator[T], depth: int = 2,
     def _put(item) -> bool:
         """Bounded put that gives up once the consumer is gone."""
         obs = _trace.on()
-        t0 = time.perf_counter() if obs else 0.0
+        measured = obs or soft_cap is not None
+        t0 = time.perf_counter() if measured else 0.0
+        if soft_cap is not None:
+            # soft depth cap: the tuner's live depth bounds how far the
+            # producer runs ahead even though the queue is allocated at
+            # depth_max (so raising the knob needs no re-allocation).
+            # Condition-wait, not a semaphore: the cap MOVES between
+            # puts (a token count would need reconciliation on every
+            # retune); the consumer's per-pull notify wakes us the
+            # moment space opens, and the timeout slice only covers
+            # stop/retune races
+            with space:
+                while q.qsize() >= soft_cap.depth:
+                    if stop.is_set():
+                        return False
+                    space.wait(0.05)
 
         def done(_waited):
-            if obs:
+            if measured:
                 dt = time.perf_counter() - t0
-                if dt > 1e-4:  # count real blocking, not put cost
+                if soft_cap is not None:
+                    soft_cap.tap_put(dt if dt > 1e-4 else 0.0)
+                if obs and dt > 1e-4:  # count real blocking, not put cost
                     _instruments()[1].inc(dt)
 
         return bounded_put(q, item, stop, on_done=done)
@@ -201,13 +236,21 @@ def prefetch(iterator: Iterator[T], depth: int = 2,
     n = 0
     try:
         while True:
-            if _trace.on():
-                depth_g, _pw, cw = _instruments()
-                depth_g.set(q.qsize())
+            obs = _trace.on()
+            if obs or soft_cap is not None:
+                if obs:
+                    depth_g, _pw, cw = _instruments()
+                    depth_g.set(q.qsize())
                 t0 = time.perf_counter()
                 item = _blocking_get()
                 dt = time.perf_counter() - t0
-                if dt > 1e-4:  # real starvation, not get cost
+                if soft_cap is not None:
+                    # wake a producer waiting at the soft cap: a slot
+                    # just opened
+                    with space:
+                        space.notify()
+                    soft_cap.tap_get(dt if dt > 1e-4 else 0.0)
+                if obs and dt > 1e-4:  # real starvation, not get cost
                     cw.inc(dt)
             else:
                 item = _blocking_get()

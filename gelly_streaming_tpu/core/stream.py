@@ -220,6 +220,29 @@ class SimpleEdgeStream(GraphStream):
             return self._windower.superbatches(self._edges, k)
         return superbatches_from_blocks(self.blocks(), k)
 
+    def superbatches_dynamic(self, k_fn, skip: int = 0):
+        """Adaptive-K superbatch ingest (``superbatch="auto"``): like
+        :meth:`superbatches` but the group size is re-read from
+        ``k_fn()`` at every group boundary, so a controller
+        (:class:`~gelly_streaming_tpu.control.AutoK`) re-tiles the
+        stream mid-run. ``skip`` fast-forwards the first ``skip``
+        windows through the packer without surfacing them (checkpoint
+        resume). Single-use like :meth:`blocks`."""
+        from .window import superbatches_from_blocks_dynamic
+
+        if self._windower is not None and self._edges is not None:
+            return self._windower.superbatches_dynamic(
+                self._edges, k_fn, skip=skip
+            )
+        blocks = self.blocks()
+        # drain the skip upfront (the shared consume-n idiom): the
+        # remaining stream must not pay a per-block wrapper for a skip
+        # that ended at item n
+        for _ in range(skip):
+            if next(blocks, None) is None:
+                break
+        return superbatches_from_blocks_dynamic(blocks, k_fn)
+
     def _derive(self, block_fn: Callable[[Iterator[EdgeBlock]], Iterator[EdgeBlock]]) -> "SimpleEdgeStream":
         parent_source = self._block_source
         return SimpleEdgeStream(

@@ -92,6 +92,54 @@ class CountWindow(WindowPolicy):
     size: int
 
 
+class ScheduledCountWindow(CountWindow):
+    """Count windows whose SIZE follows a window-indexed schedule — the
+    mid-stream window-size-shift harness for the adaptive packer
+    (``bench.py --autotune``'s shift cell and the controller tests).
+
+    ``schedule`` is ``((start_index, size), ...)`` with ascending start
+    indices, the first at 0: window ``i`` has the size of the last
+    segment whose start is ``<= i``. Only the DYNAMIC packer
+    (:meth:`Windower.superbatches_dynamic`) honors the schedule — it
+    re-reads the size per group and caps each group at the next
+    boundary so a group never spans two sizes; the static paths read
+    ``.size`` (the first segment) like any ``CountWindow``."""
+
+    def __init__(self, schedule):
+        sched = tuple((int(a), int(b)) for a, b in schedule)
+        if not sched or sched[0][0] != 0:
+            raise ValueError(
+                "schedule must be non-empty with its first segment at "
+                f"window 0, got {schedule!r}"
+            )
+        for (a, sa), (b, sb) in zip(sched, sched[1:]):
+            if b <= a:
+                raise ValueError(
+                    f"schedule starts must ascend, got {a} then {b}"
+                )
+        if any(s < 1 for _a, s in sched):
+            raise ValueError("every scheduled size must be >= 1")
+        super().__init__(size=sched[0][1])
+        self.schedule = sched
+
+    def size_at(self, index: int) -> int:
+        """The window size at window ``index``."""
+        size = self.schedule[0][1]
+        for start, s in self.schedule:
+            if start > index:
+                break
+            size = s
+        return size
+
+    def run_length(self, index: int) -> Optional[int]:
+        """Windows from ``index`` (inclusive) until the next size
+        boundary; None inside the final segment (no boundary ahead)."""
+        for start, _s in self.schedule:
+            if start > index:
+                return start - index
+        return None
+
+
 @dataclasses.dataclass
 class ProcessingTimeWindow(WindowPolicy):
     """Tumbling wall-clock window: close when ``seconds`` have elapsed
@@ -424,6 +472,141 @@ class Windower:
             win_rows.append(rows)
         if win_rows:
             yield flush()
+
+    #: windows per group while the dynamic packer replays a resume skip
+    #: (packed for the vertex-dictionary replay, never surfaced — the
+    #: tiling of unsurfaced groups is free to be whatever amortizes the
+    #: encode best)
+    SKIP_GROUP_WINDOWS = 256
+
+    def superbatches_dynamic(
+        self, edges: Iterable[Tuple], k_fn, skip: int = 0
+    ) -> Iterator["SuperbatchGroup"]:
+        """Adaptive-K superbatch packing: like :meth:`superbatches`, but
+        the group size is re-read from ``k_fn()`` at EVERY group
+        boundary — the ingest half of ``superbatch="auto"`` (the
+        controller moves K between groups; window boundaries, packing,
+        and emission semantics are exactly the fixed-K path's, group
+        TILING is the only degree of freedom). Count windows re-read
+        ``policy.size`` per window too, so a
+        :class:`ScheduledCountWindow` shifts window size mid-stream
+        with groups capped at each size boundary (a group never spans
+        two sizes). ``skip`` consumes (packs, for the vertex-dictionary
+        replay) the first ``skip`` windows without surfacing them — the
+        checkpoint-resume fast-forward
+        (``autockpt._SkipStream.superbatches_dynamic``)."""
+        if skip < 0:
+            raise ValueError(f"skip must be >= 0, got {skip}")
+        policy = self.policy
+        if isinstance(policy, CountWindow) and is_column_input(edges):
+            yield from self._dynamic_array_superbatches(edges, k_fn, skip)
+            return
+        if isinstance(policy, CountWindow) and not callable(
+            getattr(edges, "iter_chunks", None)
+        ):
+            yield from self._dynamic_record_superbatches(
+                iter(edges), k_fn, skip
+            )
+            return
+        blocks = self.blocks_with_info(edges)
+        for _ in range(skip):
+            if next(blocks, None) is None:
+                break
+        yield from superbatches_from_blocks_dynamic(
+            blocks, k_fn, with_info=True, val_dtype=self.val_dtype,
+        )
+
+    def _group_k(self, index: int, k_fn, skip: int) -> Tuple[int, int]:
+        """(window size, group window count) for the group starting at
+        window ``index`` — the one tiling rule of the dynamic packer:
+        the scheduled size at the index, the controller's K (or the
+        skip-replay tile), capped so a group never crosses a size
+        boundary or the skip watermark."""
+        policy = self.policy
+        size_at = getattr(policy, "size_at", None)
+        size = int(size_at(index)) if callable(size_at) \
+            else int(policy.size)
+        if index < skip:
+            k = min(self.SKIP_GROUP_WINDOWS, skip - index)
+        else:
+            k = max(1, int(k_fn()))
+        run_length = getattr(policy, "run_length", None)
+        if callable(run_length):
+            rl = run_length(index)
+            if rl is not None:
+                k = min(k, max(1, rl))
+        return size, k
+
+    def _dynamic_array_superbatches(
+        self, edges, k_fn, skip: int
+    ) -> Iterator["SuperbatchGroup"]:
+        """Count-window column fast path with per-group tiling — same
+        slicing + :meth:`pack_window_cols` shape as
+        :meth:`_array_superbatches`, group size decided per group."""
+        if isinstance(edges, np.ndarray):
+            if edges.ndim != 2 or not 2 <= edges.shape[1] <= 3:
+                raise ValueError("edge array must be [N, 2] or [N, 3]")
+            cols = [edges[:, i] for i in range(edges.shape[1])]
+        else:
+            cols = [np.asarray(c) for c in edges]
+        src = cols[0].astype(np.int64)
+        dst = cols[1].astype(np.int64)
+        val = cols[2].astype(self.val_dtype) if len(cols) > 2 else None
+        n = src.shape[0]
+        index = 0
+        g0 = 0
+        while g0 < n:
+            size, k = self._group_k(index, k_fn, skip)
+            g1 = min(g0 + size * k, n)
+            win_cols = [
+                (src[w0:min(w0 + size, g1)], dst[w0:min(w0 + size, g1)],
+                 None if val is None else val[w0:min(w0 + size, g1)])
+                for w0 in range(g0, g1, size)
+            ]
+            group = self.pack_window_cols(win_cols, first_index=index)
+            index += len(win_cols)
+            g0 = g1
+            if index > skip:  # groups never straddle skip (capped above)
+                yield group
+
+    def _dynamic_record_superbatches(
+        self, edges: Iterator[Tuple], k_fn, skip: int
+    ) -> Iterator["SuperbatchGroup"]:
+        """Count-window RECORD path with per-group tiling (the dynamic
+        analog of :meth:`_record_superbatches`); live-source ``None``
+        ticks are ignored, as everywhere count windows consume them."""
+        index = 0
+        win_rows: list = []
+        rows: list = []
+        size, k_target = self._group_k(index, k_fn, skip)
+
+        def flush():
+            nonlocal win_rows, index, size, k_target
+            cols = [self._rows_to_cols(rws) for rws in win_rows]
+            group = self.pack_window_cols(cols, first_index=index)
+            start = index
+            index += len(cols)
+            win_rows = []
+            size, k_target = self._group_k(index, k_fn, skip)
+            return group if start >= skip else None
+
+        for e in edges:
+            if e is None:
+                continue
+            rows.append(e)
+            if len(rows) >= size:
+                win_rows.append(rows)
+                rows = []
+                if len(win_rows) >= k_target:
+                    group = flush()
+                    if group is not None:
+                        yield group
+        if rows:
+            win_rows.append(rows)
+        if win_rows:
+            group = flush()
+            if group is not None:
+                yield group
 
     def pack_window_cols(
         self, win_cols: Sequence[Tuple], first_index: int = 0
@@ -839,6 +1022,29 @@ class SuperbatchGroup:
         return self._stacked
 
 
+def _group_from_blocks(group: list, infos: list,
+                       val_dtype) -> SuperbatchGroup:
+    """One pre-built-block group as a :class:`SuperbatchGroup` — the
+    shared emit of the fixed and dynamic block packers."""
+    cols = None
+    # same honesty guard as stack_blocks: prefix-aligned caches with
+    # plain ndarray vals only — pytree vals (tuple-valued map_edges)
+    # cannot fill a single [K, cap] val plane and take the device
+    # stacking fallback instead
+    if all(
+        getattr(b, "_host_cache", None) is not None
+        and getattr(b, "_host_cache_pos", None) is None
+        and (b._host_cache[2] is None
+             or isinstance(b._host_cache[2], np.ndarray))
+        for b in group
+    ):
+        cols = [b._host_cache for b in group]
+    return SuperbatchGroup(
+        infos, cols, max(b.n_vertices for b in group),
+        val_dtype=val_dtype, blocks=group,
+    )
+
+
 def superbatches_from_blocks(
     blocks: Iterable, k: int, with_info: bool = False,
     val_dtype=np.float32,
@@ -849,26 +1055,6 @@ def superbatches_from_blocks(
     Host column views come from the blocks' prefix-aligned host caches
     when every member has one; otherwise ``cols`` is None and consumers
     use the device stack."""
-
-    def emit(group, infos):
-        cols = None
-        # same honesty guard as stack_blocks: prefix-aligned caches with
-        # plain ndarray vals only — pytree vals (tuple-valued map_edges)
-        # cannot fill a single [K, cap] val plane and take the device
-        # stacking fallback instead
-        if all(
-            getattr(b, "_host_cache", None) is not None
-            and getattr(b, "_host_cache_pos", None) is None
-            and (b._host_cache[2] is None
-                 or isinstance(b._host_cache[2], np.ndarray))
-            for b in group
-        ):
-            cols = [b._host_cache for b in group]
-        return SuperbatchGroup(
-            infos, cols, max(b.n_vertices for b in group),
-            val_dtype=val_dtype, blocks=group,
-        )
-
     group: list = []
     infos: list = []
     for item in blocks:
@@ -876,10 +1062,34 @@ def superbatches_from_blocks(
         group.append(block)
         infos.append(info)
         if len(group) >= k:
-            yield emit(group, infos)
+            yield _group_from_blocks(group, infos, val_dtype)
             group, infos = [], []
     if group:
-        yield emit(group, infos)
+        yield _group_from_blocks(group, infos, val_dtype)
+
+
+def superbatches_from_blocks_dynamic(
+    blocks: Iterable, k_fn, with_info: bool = False,
+    val_dtype=np.float32,
+) -> Iterator[SuperbatchGroup]:
+    """The adaptive-K analog of :func:`superbatches_from_blocks`: the
+    group size is re-read from ``k_fn()`` at every group boundary, so a
+    controller moves the tiling between groups on streams that only
+    offer pre-built blocks (derived/prefetched streams — dispatch
+    fusion only, like the fixed generic path)."""
+    group: list = []
+    infos: list = []
+    want = max(1, int(k_fn()))
+    for item in blocks:
+        info, block = item if with_info else (None, item)
+        group.append(block)
+        infos.append(info)
+        if len(group) >= want:
+            yield _group_from_blocks(group, infos, val_dtype)
+            group, infos = [], []
+            want = max(1, int(k_fn()))
+    if group:
+        yield _group_from_blocks(group, infos, val_dtype)
 
 
 def iter_superbatches(stream, k: int) -> Iterator[SuperbatchGroup]:
@@ -904,6 +1114,29 @@ def iter_superbatches(stream, k: int) -> Iterator[SuperbatchGroup]:
 
     yield from superbatches_from_blocks(
         prefetch(stream.blocks(), superbatch_prefetch_depth(k)), k
+    )
+
+
+def iter_superbatches_dynamic(stream, k_fn) -> Iterator[SuperbatchGroup]:
+    """Adaptive-K superbatch groups for any stream — the
+    ``superbatch="auto"`` analog of :func:`iter_superbatches`: the
+    stream's own dynamic packer when it offers one
+    (``SimpleEdgeStream.superbatches_dynamic`` routes to the Windower's
+    zero-per-window-device-work fast path;
+    ``autockpt._SkipStream.superbatches_dynamic`` adds the resume
+    skip), else generic dynamic packing of its block iterator."""
+    fast = getattr(stream, "superbatches_dynamic", None)
+    if callable(fast):
+        yield from fast(k_fn)
+        return
+    from .pipeline import prefetch, superbatch_prefetch_depth
+
+    yield from superbatches_from_blocks_dynamic(
+        prefetch(
+            stream.blocks(),
+            superbatch_prefetch_depth(max(1, int(k_fn()))),
+        ),
+        k_fn,
     )
 
 

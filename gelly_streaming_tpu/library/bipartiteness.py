@@ -150,13 +150,15 @@ class BipartitenessCheck(SummaryBulkAggregation):
         mesh = self._resolve_mesh(stream)
         vdict = stream.vertex_dict
         k = int(getattr(self, "superbatch", 1) or 1)
-        if k > 1 and not self.transient_state:
+        if (k > 1 or self.superbatch_auto) and not self.transient_state:
             # the fused K-window drive loop (the GroupFoldable
             # declaration); transient_state keeps the per-window loop —
             # its per-yield carry reset is window-granular by definition
             self._gf_mesh = mesh
             self._gf_vdict = vdict
-            yield from drive_group_folded(self, stream, k)
+            yield from drive_group_folded(
+                self, stream, k, controller=self._attach_control(k)
+            )
             return
         for block in stream.blocks():
             cache = getattr(block, "_host_cache", None)

@@ -176,7 +176,7 @@ class _CCMixin:
             )
         vdict = stream.vertex_dict
         k = int(getattr(self, "superbatch", 1) or 1)
-        if k > 1 and not self.transient_state:
+        if (k > 1 or self.superbatch_auto) and not self.transient_state:
             # the superbatched drive loop (fused K-window groups); the
             # transient_state edge case keeps the per-window loop — its
             # per-yield carry reset is inherently window-granular here
@@ -201,7 +201,9 @@ class _CCMixin:
         self._gf_mesh = mesh
         self._gf_vdict = vdict
         self._gf_degree = eff_degree
-        yield from drive_group_folded(self, stream, k)
+        yield from drive_group_folded(
+            self, stream, k, controller=self._attach_control(k)
+        )
 
     def fold_group(self, group) -> Iterator[Components]:
         """The CC carries' declared group fold: host union-find group

@@ -254,6 +254,18 @@ class IncrementalPageRank(GroupFoldable):
         #: optional device mesh: the per-window fixpoint shards the edge
         #: columns over the ``"edges"`` axis with per-iteration psum
         self.mesh = mesh
+        if isinstance(superbatch, str):
+            # "auto" (and any other string) is explicitly unsupported
+            # here: PageRank's fused cell is honest parity on CPU (its
+            # per-window cost is the fixpoint, which fusion cannot
+            # remove), so a controller would only add ramp cost —
+            # fail with the reason, not a str-vs-int TypeError
+            raise ValueError(
+                "IncrementalPageRank takes a fixed int superbatch "
+                f'(got {superbatch!r}); superbatch="auto" is not '
+                "supported — its per-window cost is fixpoint-bound, "
+                "not dispatch-bound"
+            )
         if superbatch < 1:
             raise ValueError(f"superbatch must be >= 1, got {superbatch}")
         self.superbatch = int(superbatch)

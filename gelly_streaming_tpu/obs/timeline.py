@@ -95,6 +95,11 @@ STORY = {
     "router.pull_errors": "PULL-ERROR",
     "router.shard_errors": "SHARD-ERROR",
     "router.cache_invalidations": "CACHE-INVAL",
+    # the self-tuning story (ISSUE 15): every control-plane decision —
+    # superbatch K, prefetch depth, admission limit — logs one
+    # control.retune{knob,from,to,signal} event, so a knob move renders
+    # in causal order next to the COMMIT/PROMOTE lines it reacted to
+    "control.retune": "RETUNE",
     "flight": "BLACKBOX",
 }
 

@@ -276,6 +276,23 @@ class CoordinatedCheckpoint(AutoCheckpoint):
             every=every, keep=keep,
         )
 
+    def run(self, make_stream, work):
+        """Same rejection as ``every="auto"``, one layer down: a
+        ``superbatch="auto"`` workload re-tiles its groups from each
+        host's OWN timing noise, so barrier-eligible window ordinals
+        would diverge across processes and no epoch would ever complete
+        — pin a fixed superbatch for coordinated runs (tune it
+        single-host first and configure the learned K everywhere)."""
+        if getattr(work, "superbatch_auto", False):
+            raise ValueError(
+                'superbatch="auto" cannot run under coordinated '
+                "barriers: each process would learn its own K and the "
+                "group-aligned barrier ordinals would never agree. Run "
+                "the controller single-host, read the tuned K, and "
+                "configure that fixed superbatch on every process."
+            )
+        return super().run(make_stream, work)
+
     # -- commit side ---------------------------------------------------- #
     def _commit(self, payload: dict) -> str:
         """Commit this shard's barrier for epoch ``windows_done``: the

@@ -212,6 +212,8 @@ class ShardRouter:
         cache_ttl_s: Optional[float] = None,
         client_factory=None,
         seed: int = 0,
+        autotune: bool = False,
+        target_wait_s: Optional[float] = None,
     ):
         if not shard_addrs:
             raise ValueError("at least one shard address is required")
@@ -227,6 +229,20 @@ class ShardRouter:
         ]
         self.nshards = len(self._clients)
         self.max_pending = int(max_pending)
+        #: load-aware admission (ISSUE 15): same contract as
+        #: ``StreamServer(autotune=True)`` — the router's drain sweep
+        #: taps its oldest queue wait vs the tightest deadline budget,
+        #: and the tuner moves ``max_pending`` inside the configured
+        #: ceiling with hysteresis + bounded steps (the router has no
+        #: class shedding, so only the admission limit moves)
+        self.admission = None
+        if autotune:
+            from ..control import AdmissionTuner
+
+            self.admission = AdmissionTuner(
+                max_pending=self.max_pending,
+                target_wait_s=target_wait_s,
+            )
         self.cache_enabled = bool(cache)
         self.cache_cap = int(cache_cap)
         self.cache_ttl_s = cache_ttl_s
@@ -443,6 +459,15 @@ class ShardRouter:
             live.append(e)
         if not live:
             return
+        if self.admission is not None:
+            # admission tap (once per sweep): oldest queue wait — the
+            # batch drains in submission order — vs the sweep's
+            # tightest deadline budget
+            if self.admission.tap_entries(
+                t_sweep - live[0].t0, ((e.t0, e.dl) for e in live)
+            ):
+                with self._lock:
+                    self.max_pending = self.admission.max_pending
         # ---- cache pass (counters aggregated per sweep: a hot sweep
         # must cost probes, not one event emission per query) --------- #
         misses: List[_Entry] = []

@@ -117,6 +117,19 @@ class StreamServer:
         counts ``serving.worker_stalls``) whenever queries are pending
         but the worker loop has not completed a sweep within this many
         seconds — the serving analog of the prefetch stall watchdog.
+    autotune:
+        Load-aware admission (ISSUE 15): an
+        :class:`~gelly_streaming_tpu.control.AdmissionTuner` re-tunes
+        ``max_pending`` and the shed watermark from MEASURED queue wait
+        vs the deadline budgets queries actually carry — queue wait is
+        the leading signal, so shedding tightens while protected
+        classes still have headroom, and recovers toward the configured
+        ceiling when load clears (bounded steps, hysteresis, every move
+        a ``control.retune`` event). The configured ``max_pending`` /
+        ``shed_watermark`` stay the CEILING — the tuner only moves
+        inside them. With no deadlines in the traffic, set
+        ``target_wait_s`` or the tuner holds (nothing to compare
+        against).
     """
 
     def __init__(
@@ -133,6 +146,8 @@ class StreamServer:
         shed_watermark: float = 0.8,
         shed_after_s: float = 0.05,
         watchdog_s: Optional[float] = None,
+        autotune: bool = False,
+        target_wait_s: Optional[float] = None,
     ):
         self._servable = servable
         self._source = source
@@ -146,6 +161,15 @@ class StreamServer:
         )
         self._shed_level = max(1, int(shed_watermark * self.max_pending))
         self.shed_after_s = float(shed_after_s)
+        self.admission = None
+        if autotune:
+            from ..control import AdmissionTuner
+
+            self.admission = AdmissionTuner(
+                max_pending=self.max_pending,
+                shed_watermark=shed_watermark,
+                target_wait_s=target_wait_s,
+            )
         self._pressure_t0: Optional[float] = None  # sustained-load start
         self.watchdog_s = watchdog_s
         self._worker_beat = time.monotonic()
@@ -559,6 +583,18 @@ class StreamServer:
             return
         now = time.perf_counter()
         self.stats.record_batch()
+        if self.admission is not None:
+            # load-aware admission tap (one per sweep, never per query):
+            # the sweep's OLDEST queue wait — entries drain in FIFO
+            # order, so the batch head waited longest — against the
+            # tightest deadline budget the sweep carried
+            if self.admission.tap_entries(
+                t_dispatch - batch[0][2],
+                ((t0_, dl_) for _q, _f, t0_, dl_, _c in batch),
+            ):
+                with self._lock:
+                    self.max_pending = self.admission.max_pending
+                    self._shed_level = self.admission.shed_level()
         # per-trace attribution (ISSUE 9): entries from one wire batch
         # share a TraceContext; group on it so each traced batch gets
         # ONE serving.query span carrying the stage breakdown (per-query

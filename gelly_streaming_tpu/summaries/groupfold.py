@@ -96,25 +96,114 @@ class GroupFoldable(abc.ABC):
         (transient CC/bipartiteness) override this."""
         return int(getattr(self, "superbatch", 1) or 1)
 
+    #: cumulative windows of every group whose fold has STARTED in the
+    #: current :func:`drive_group_folded` run (None outside one) — the
+    #: carried state transitions to end-of-group at the group's FIRST
+    #: emission, so a barrier is safe exactly when the consumer's yield
+    #: count equals this watermark
+    _gf_folded: Optional[int] = None
+
+    def checkpoint_aligned(self, done_windows: int) -> bool:
+        """Whether a checkpoint barrier may land after ``done_windows``
+        emissions of the CURRENT run (counted from the run's start —
+        the resume offset is the caller's). Inside a group-folded run
+        the answer is exact per group boundary — including variable
+        tiling under ``superbatch="auto"`` and the final partial group
+        — because the drive loop maintains :attr:`_gf_folded`; outside
+        one it falls back to the static ``checkpoint_granularity``
+        modulo rule. :class:`~gelly_streaming_tpu.aggregate.autockpt.AutoCheckpoint`
+        consults this instead of the modulo rule when the work offers
+        it."""
+        folded = self._gf_folded
+        if folded is not None:
+            return done_windows == folded
+        return done_windows % max(1, self.checkpoint_granularity()) == 0
+
 
 def drive_group_folded(workload: GroupFoldable, stream, k: int,
-                       prefetch_groups: int = GROUP_PREFETCH_DEPTH
-                       ) -> Iterator[Any]:
+                       prefetch_groups: int = GROUP_PREFETCH_DEPTH,
+                       controller=None) -> Iterator[Any]:
     """THE superbatch drive loop: pack K windows per group through the
     stream's packer (:func:`~gelly_streaming_tpu.core.window.iter_superbatches`
     — zero per-window device assembly on the windower fast path),
     prefetch ahead, and delegate each group to the workload's declared
     fold. Shared by every :class:`GroupFoldable` so the drive semantics
     (group boundaries, prefetch coupling, fallback routing) cannot drift
-    between implementations."""
-    from ..core.pipeline import prefetch
-    from ..core.window import iter_superbatches
+    between implementations.
 
-    for group in prefetch(iter_superbatches(stream, k), prefetch_groups):
-        if workload.group_supported(group):
-            yield from workload.fold_group(group)
-        else:
-            yield from workload.fold_group_fallback(group)
+    ``controller`` (a :class:`~gelly_streaming_tpu.control.ControlPlane`
+    or bare :class:`~gelly_streaming_tpu.control.AutoK`) switches the
+    loop adaptive: groups come from the DYNAMIC packer with the
+    controller's ``current_k`` consulted at every group boundary, each
+    folded group's wall seconds are tapped back
+    (:meth:`~gelly_streaming_tpu.control.AutoK.tap_group` — includes
+    the consumer's emission handling, i.e. the true pipeline
+    throughput), and the group prefetch runs under the controller's
+    :class:`~gelly_streaming_tpu.control.PrefetchTuner` when it carries
+    one. Retunes land a prefetch-depth of groups late (the packer runs
+    ahead); the tuner attributes measurements by each group's actual
+    window count, so the lag costs convergence time, never correctness.
+    """
+    import time as _time
+
+    from ..core.pipeline import prefetch
+    from ..core.window import iter_superbatches, iter_superbatches_dynamic
+
+    autok = getattr(controller, "autok", controller)
+    tuner = getattr(controller, "prefetch", None)
+    if autok is None:
+        groups = iter_superbatches(stream, k)
+    else:
+        groups = iter_superbatches_dynamic(stream, autok.current_k)
+    if tuner is None:
+        prefetched = prefetch(groups, prefetch_groups)
+    else:
+        prefetched = prefetch(groups, tuner.depth_max, tuner=tuner)
+    if autok is not None:
+        # drain any foreign-time credit a previous run on this thread
+        # accrued but never consumed (e.g. an oracle run without a
+        # controller) so it cannot deflate this run's first tap
+        from ..control.signals import take_excluded_s
+
+        take_excluded_s()
+    workload._gf_folded = 0
+    try:
+        for group in prefetched:
+            workload._gf_folded += len(group)
+            t0 = _time.perf_counter() if autok is not None else 0.0
+            if workload.group_supported(group):
+                yield from workload.fold_group(group)
+            else:
+                yield from workload.fold_group_fallback(group)
+            if autok is not None:
+                k_next = autok.tap_group(
+                    len(group), group_edge_count(group),
+                    _time.perf_counter() - t0,
+                )
+                # mirror the live K onto the workload: consumers that
+                # read `superbatch` (checkpoint drivers rounding their
+                # cadence, bench evidence) see the operating point,
+                # while barrier alignment itself rides the exact
+                # _gf_folded watermark
+                if getattr(workload, "superbatch", None) is not None:
+                    workload.superbatch = k_next
+    finally:
+        # the watermark is only meaningful INSIDE this run: a later
+        # run of the same object down a per-window path must fall back
+        # to the static modulo rule, not compare against a stale total
+        workload._gf_folded = None
+
+
+def group_edge_count(group) -> int:
+    """Total edges of a packed group: exact from the host column views,
+    the padded block capacities (an upper bound, consistent across
+    groups) for device-stacked ones."""
+    if group.cols is not None:
+        return int(sum(len(c[0]) for c in group.cols))
+    blocks = getattr(group, "_blocks", None)
+    if blocks:
+        return int(sum(int(b.capacity) for b in blocks))
+    return 0
 
 
 def verify_group_fold(

@@ -91,6 +91,22 @@ def test_latency_watch_list_matches_the_latency_artifact():
         assert isinstance(value, (int, float)), metric
 
 
+def test_autotune_watch_list_matches_the_autotune_artifact():
+    # the ISSUE 15 satellite: the CI autotune step watches the
+    # controller's cliff-cell eps and its auto/hand ratio from the
+    # committed artifact — both throughput-direction (min:), both must
+    # resolve
+    from tools.benchguard import WATCHED_AUTOTUNE
+
+    path = os.path.join(REPO, "BENCH_AUTOTUNE_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_AUTOTUNE:
+        assert metric.startswith("min:")
+        value = dig(committed, metric[4:])
+        assert isinstance(value, (int, float)), metric
+
+
 def test_chaos_watch_list_matches_the_chaos_artifact():
     # the ISSUE 10 satellite: the CI chaos step watches recovery p50
     # from the committed chaos artifact — the watch list must resolve

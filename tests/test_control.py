@@ -1,0 +1,782 @@
+"""Self-tuning control plane (ISSUE 15): controller dynamics pinned on
+synthetic signal streams — convergence, hysteresis (no oscillation
+between adjacent K under noisy measurements), bounded step sizes — plus
+the end-to-end ``superbatch="auto"`` contracts: per-window value
+identity including mid-group retunes, the mid-stream window-size shift,
+and kill/resume through AutoCheckpoint."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import obs
+from gelly_streaming_tpu.control import (
+    AdmissionTuner,
+    AutoK,
+    ControlPlane,
+    PrefetchTuner,
+    SignalReader,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# --------------------------------------------------------------------- #
+# SignalReader — THE retune-signal implementation
+# --------------------------------------------------------------------- #
+def test_signal_reader_direct_taps_work_without_obs():
+    r = SignalReader()
+    assert r.last("x") is None
+    r.observe("x", 0.5)
+    r.observe("x", 0.25)
+    assert r.last("x") == 0.25
+    assert r.total("x") == (2, 0.75)
+
+
+def test_signal_reader_registry_deltas_window():
+    r = SignalReader()
+    obs.enable()
+    reg = obs.get_registry()
+    reg.counter("pipeline.consumer_idle_s").inc(2.0)
+    assert r.counter_delta("pipeline.consumer_idle_s") == pytest.approx(2.0)
+    # windowed: a second read without new mutations is zero
+    assert r.counter_delta("pipeline.consumer_idle_s") == 0.0
+    reg.counter("pipeline.consumer_idle_s").inc(0.5)
+    assert r.counter_delta("pipeline.consumer_idle_s") == pytest.approx(0.5)
+    with obs.span("window.pack"):
+        pass
+    n, s = r.span_delta("window.pack")
+    assert n == 1 and s >= 0.0
+    assert r.span_delta("window.pack") == (0.0, 0.0)
+
+
+def test_signal_reader_registry_reads_are_zero_when_disabled():
+    r = SignalReader()
+    reg = obs.get_registry()
+    reg.counter("pipeline.consumer_idle_s").inc(3.0)
+    # obs off: the reader must not scan the registry at all
+    assert r.counter_delta("pipeline.consumer_idle_s") == 0.0
+    assert r.span_delta("window.pack") == (0.0, 0.0)
+
+
+def test_autockpt_measures_through_signal_reader(tmp_path):
+    """The ISSUE 15 satellite: AutoCheckpoint's auto-every cost
+    measurement is the SHARED SignalReader, not private fields — the
+    measured_* surface the pinned auto-every tests read delegates."""
+    from gelly_streaming_tpu.aggregate.autockpt import AutoCheckpoint
+
+    ac = AutoCheckpoint(str(tmp_path / "c.ckpt"), every="auto")
+    assert isinstance(ac.signals, SignalReader)
+    assert ac.measured_barrier_s is None
+
+    class W:
+        def state_dict(self):
+            return {"x": 1}
+
+    ac._snapshot(W(), None, windows_done=2)
+    assert ac.measured_barrier_s == ac.signals.last("checkpoint.barrier_s")
+    assert ac.measured_barrier_s > 0
+    ac._retune(0.01, 1)
+    assert ac.measured_window_s == ac.signals.last("checkpoint.window_s")
+    assert ac.measured_window_s == pytest.approx(0.01)
+
+
+# --------------------------------------------------------------------- #
+# AutoK dynamics on synthetic throughput landscapes
+# --------------------------------------------------------------------- #
+def _drive(ak: AutoK, eps_of_k, *, taps: int, window: int = 1024,
+           noise=None, seed: int = 0):
+    """Feed the tuner ``taps`` synthetic group measurements from an
+    eps(k) landscape; returns the list of (old, new, signal) moves."""
+    rng = np.random.default_rng(seed)
+    for _ in range(taps):
+        k = ak.current_k()
+        eps = eps_of_k(k)
+        if noise:
+            eps *= 1.0 + rng.uniform(-noise, noise)
+        edges = k * window
+        ak.tap_group(k, edges, edges / eps)
+    return list(ak.history)
+
+
+def test_autok_converges_to_the_knee_and_holds():
+    # plateau past k=64: climbing to 256 buys < improve, so the tuner
+    # must settle at 64 (the knee) and hold
+    landscape = {1: 1.0, 4: 3.6, 16: 9.0, 64: 11.0, 256: 11.2}
+    ak = AutoK(decide_groups=2)
+    _drive(ak, lambda k: landscape[k], taps=40)
+    assert ak.k == 64, ak.history
+    before = len(ak.history)
+    _drive(ak, lambda k: landscape[k], taps=60)
+    assert len(ak.history) == before, "held K must not move on a flat landscape"
+
+
+def test_autok_no_oscillation_under_noise():
+    # adjacent rungs within noise of each other: after convergence the
+    # knob must NOT flip between them (the hysteresis contract)
+    landscape = {1: 1.0, 4: 3.9, 16: 8.0, 64: 8.3, 256: 8.1}
+    ak = AutoK(decide_groups=2)
+    _drive(ak, lambda k: landscape[k], taps=60, noise=0.05, seed=7)
+    settled = ak.k
+    n_before = len(ak.history)
+    _drive(ak, lambda k: landscape[k], taps=300, noise=0.05, seed=8)
+    assert ak.k == settled
+    assert len(ak.history) == n_before, (
+        f"retuned {len(ak.history) - n_before} times after convergence "
+        f"under +/-5% noise: {ak.history[n_before:]}"
+    )
+
+
+def test_autok_steps_are_bounded():
+    landscape = {1: 1.0, 4: 4.0, 16: 16.0, 64: 60.0, 256: 200.0}
+    ak = AutoK(decide_groups=1)
+    moves = _drive(ak, lambda k: landscape[k], taps=30)
+    assert moves, "a steep landscape must move the knob"
+    for old, new, _sig in moves:
+        hi, lo = max(old, new), min(old, new)
+        assert hi <= lo * ak.step, f"unbounded step {old} -> {new}"
+    assert ak.k == 256  # and the climb does reach the top
+
+
+def test_autok_adapts_down_on_window_size_shift():
+    # same landscape shape, but the knee depends on the window size:
+    # small windows want k=64+, big windows plateau from k=4
+    def eps(k, window):
+        fixed_ms, per_edge = 1.0, 1e-3  # per-dispatch fixed + linear
+        edges = k * window
+        return edges / (fixed_ms + per_edge * edges)
+
+    ak = AutoK(decide_groups=2)
+    rng_w = 1024
+    for _ in range(40):
+        k = ak.current_k()
+        e = k * rng_w
+        ak.tap_group(k, e, e / eps(k, rng_w))
+    k_small = ak.k
+    assert k_small >= 16, ak.history
+    n_before = len(ak.history)
+    rng_w = 16384  # mid-stream shift: windows grew 16x
+    for _ in range(40):
+        k = ak.current_k()
+        e = k * rng_w
+        ak.tap_group(k, e, e / eps(k, rng_w))
+    assert ak.k < k_small, (ak.k, ak.history[n_before:])
+    assert any(sig == "window-shift" for _o, _n, sig in
+               ak.history[n_before:])
+
+
+def test_autok_excludes_foreign_time_from_group_taps():
+    """A checkpoint barrier landing inside a group's yields credits its
+    seconds as foreign (signals.add_excluded_s); the tap must subtract
+    them, or one barrier would read as a throughput collapse at the
+    current K and revert a good probe (review finding)."""
+    from gelly_streaming_tpu.control.signals import (
+        add_excluded_s,
+        take_excluded_s,
+    )
+
+    take_excluded_s()  # clean slate on this thread
+    landscape = {1: 1.0, 4: 4.0, 16: 16.0, 64: 64.0, 256: 256.0}
+    ak = AutoK(decide_groups=1)
+    window = 1024
+    for i in range(8):
+        k = ak.current_k()
+        eps = landscape[k]
+        edges = k * window
+        if i == 2:
+            # a "barrier" 20x the group's honest wall lands mid-group
+            add_excluded_s(20.0 * edges / eps)
+        ak.tap_group(k, edges, edges / eps + (
+            20.0 * edges / eps if i == 2 else 0.0
+        ))
+    # with the exclusion subtracted, the climb never reverts
+    assert all(sig != "probe-reverted" for _o, _n, sig in ak.history), \
+        ak.history
+    assert ak.k == 256
+    assert take_excluded_s() == 0.0  # fully drained by the taps
+
+
+def test_superbatch_string_typos_fail_with_the_accepted_values():
+    from gelly_streaming_tpu.library import (
+        ConnectedComponents,
+        IncrementalPageRank,
+    )
+
+    with pytest.raises(ValueError, match='"auto"'):
+        ConnectedComponents(superbatch="Auto")
+    with pytest.raises(ValueError, match="auto"):
+        IncrementalPageRank(superbatch="auto")
+
+
+def test_gf_folded_watermark_resets_after_a_group_folded_run():
+    """checkpoint_aligned must fall back to the modulo rule once a
+    group-folded run ends — a stale watermark from a finished run would
+    otherwise suppress every barrier of a later per-window run of the
+    same object (review finding)."""
+    from gelly_streaming_tpu.library import ConnectedComponents
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+
+    rng = np.random.default_rng(23)
+    src = rng.integers(0, 256, 2048)
+    dst = rng.integers(0, 256, 2048)
+    agg = ConnectedComponents(superbatch=4)
+    stream = SimpleEdgeStream((src, dst), window=CountWindow(128),
+                              vertex_dict=IdentityDict(256))
+    list(agg.run(stream))
+    assert agg._gf_folded is None
+    # the static rule is live again: granularity-4 alignment
+    assert agg.checkpoint_aligned(8) and not agg.checkpoint_aligned(3)
+
+
+def test_autok_pinned_when_k0_equals_k_max():
+    """The manual-pin escape hatch the README documents: AutoK(k0=K,
+    k_max=K) keeps the dynamic drive loop but never moves the knob."""
+    ak = AutoK(k0=8, k_max=8, decide_groups=1)
+    _drive(ak, lambda k: float(k), taps=50)
+    assert ak.k == 8 and ak.history == []
+
+
+def test_autok_span_hint_breaks_a_hold():
+    """With obs on, a dispatch/pack span ratio past the threshold
+    re-probes upward from a hold even though throughput has not moved
+    — the ISSUE's span-ratio signal. (It never overrides the
+    failed-probe memory: a rung that already lost at this landscape
+    stays refused, or the persistent hint would re-drive the very
+    oscillation the hysteresis exists to prevent.)"""
+    obs.enable()
+    reg = obs.get_registry()
+    ak = AutoK(decide_groups=1)
+    flat = {1: 5.0, 4: 5.0, 16: 5.0, 64: 5.0, 256: 5.0}
+    # a hold with the up-rung never probed (e.g. reached via a
+    # window-shift descent)
+    ak._base = (1, 5.0)
+    ak._enter_hold(5.0)
+    _drive(ak, lambda k: flat[k], taps=1)
+    assert ak.k == 1 and ak.history == []  # no hint: flat hold holds
+    # dispatch seconds per window >> pack seconds per window
+    reg.histogram("trace.span_seconds", span="engine.dispatch").observe(0.5)
+    reg.histogram("trace.span_seconds", span="window.pack").observe(0.001)
+    _drive(ak, lambda k: flat[k], taps=1)
+    assert any(sig == "dispatch-share" for _o, _n, sig in ak.history)
+    # the failed-band memory beats the hint: revert, then hint again
+    _drive(ak, lambda k: 0.1, taps=1)  # the probe loses badly
+    assert ak.history[-1][2] == "probe-reverted"
+    n = len(ak.history)
+    reg.histogram("trace.span_seconds", span="engine.dispatch").observe(0.5)
+    reg.histogram("trace.span_seconds", span="window.pack").observe(0.001)
+    for _ in range(ak.cooldown + 2):
+        _drive(ak, lambda k: flat[k], taps=1)
+    assert all(s != "dispatch-share" for _o, _n2, s in ak.history[n:])
+
+
+def test_retune_decisions_are_logged_when_obs_on():
+    obs.enable()
+    ak = AutoK(decide_groups=1)
+    _drive(ak, lambda k: float(k), taps=6)
+    assert ak.history
+    hits = obs.get_registry().find("control.retune")
+    assert hits, "retunes must surface as control.retune events"
+    labels = [l for l, _i in hits]
+    assert all(l["knob"] == "superbatch_k" for l in labels)
+    assert all({"from", "to", "signal"} <= set(l) for l in labels)
+
+
+# --------------------------------------------------------------------- #
+# PrefetchTuner
+# --------------------------------------------------------------------- #
+def _drive_prefetch(pt: PrefetchTuner, *, idle_s: float, blocked_s: float,
+                    items: int):
+    per = max(1, pt.decide_items)
+    for i in range(items):
+        pt.tap_put(blocked_s / per)
+        pt.tap_get(idle_s / per)
+
+
+def test_prefetch_tuner_deepens_on_consumer_idle(monkeypatch):
+    pt = PrefetchTuner(depth=2, decide_items=8)
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.01  # 0.01s wall per item
+        return t[0]
+
+    monkeypatch.setattr(pt, "_clock", clock)
+    # idle ~50% of wall, producer never blocked -> deepen
+    _drive_prefetch(pt, idle_s=0.04 * 8, blocked_s=0.0, items=40)
+    assert pt.depth > 2
+    assert all(sig == "consumer-idle" for _o, _n, sig in pt.history)
+    assert pt.depth <= pt.depth_max
+
+
+def test_prefetch_tuner_shrinks_on_producer_blocked(monkeypatch):
+    pt = PrefetchTuner(depth=8, decide_items=8)
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.01
+        return t[0]
+
+    monkeypatch.setattr(pt, "_clock", clock)
+    _drive_prefetch(pt, idle_s=0.0, blocked_s=0.04 * 8, items=40)
+    assert pt.depth < 8
+    assert pt.depth >= pt.depth_min
+    assert all(sig == "producer-blocked" for _o, _n, sig in pt.history)
+
+
+def test_prefetch_tuner_holds_inside_the_deadband(monkeypatch):
+    pt = PrefetchTuner(depth=4, decide_items=8)
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.01
+        return t[0]
+
+    monkeypatch.setattr(pt, "_clock", clock)
+    # both shares tiny: hysteresis holds the knob
+    _drive_prefetch(pt, idle_s=0.001, blocked_s=0.001, items=100)
+    assert pt.depth == 4 and pt.history == []
+
+
+def test_prefetch_with_tuner_preserves_order_and_bounds_depth():
+    from gelly_streaming_tpu.core.pipeline import prefetch
+
+    pt = PrefetchTuner(depth=2, depth_max=4, decide_items=4)
+    seen_depths = []
+
+    def src():
+        for i in range(200):
+            yield i
+
+    out = []
+    for x in prefetch(src(), tuner=pt):
+        out.append(x)
+        seen_depths.append(pt.depth)
+    assert out == list(range(200))
+    assert all(pt.depth_min <= d <= pt.depth_max for d in seen_depths)
+
+
+# --------------------------------------------------------------------- #
+# AdmissionTuner
+# --------------------------------------------------------------------- #
+def test_admission_tuner_sheds_earlier_under_queue_wait():
+    at = AdmissionTuner(max_pending=1000, decide_sweeps=2)
+    moved = False
+    for _ in range(4):
+        moved |= at.tap_sweep(0.9, 1.0)  # wait at 90% of the budget
+    assert moved
+    assert at.max_pending < 1000
+    assert at.max_pending >= at.floor
+    assert at.shed_level() < 800
+    assert all(sig == "queue-wait" for _o, _n, sig in at.history)
+
+
+def test_admission_tuner_recovers_toward_the_ceiling():
+    at = AdmissionTuner(max_pending=1000, decide_sweeps=1, cooldown=0)
+    at.tap_sweep(0.9, 1.0)
+    shrunk = at.max_pending
+    assert shrunk < 1000
+    for _ in range(40):
+        at.tap_sweep(0.01, 1.0)  # wait far under the budget
+    assert at.max_pending == 1000, "recovery must re-reach the ceiling"
+    assert at.shed_watermark == pytest.approx(at.shed_watermark_ceiling)
+    assert at.max_pending <= at.ceiling
+
+
+def test_admission_tuner_holds_between_bands_and_without_budgets():
+    at = AdmissionTuner(max_pending=512, decide_sweeps=1, cooldown=0)
+    for _ in range(20):
+        at.tap_sweep(0.35, 1.0)  # between lo=0.2 and hi=0.5
+    assert at.max_pending == 512 and at.history == []
+    # no deadlines anywhere and no target: nothing to compare against
+    for _ in range(20):
+        at.tap_sweep(5.0, None)
+    assert at.max_pending == 512 and at.history == []
+
+
+def test_admission_tuner_respects_the_floor():
+    at = AdmissionTuner(max_pending=100, decide_sweeps=1, cooldown=0,
+                        floor_frac=0.2)
+    for _ in range(50):
+        at.tap_sweep(10.0, 1.0)
+    assert at.max_pending == at.floor == 20
+
+
+def test_stream_server_autotune_applies_the_tuner():
+    """Integration: a server built with autotune=True re-applies the
+    tuner's knobs after a sweep that breached the wait band."""
+    from gelly_streaming_tpu.serving import DegreeQuery
+    from gelly_streaming_tpu.serving.server import StreamServer
+    from gelly_streaming_tpu.datasets import IdentityDict
+
+    vd = IdentityDict(8)
+    vd.observe(7)
+    deg = np.arange(8, dtype=np.int64)
+
+    def payloads():
+        yield {"deg": deg, "vdict": vd}, 1
+
+    srv = StreamServer(payloads(), source=None, max_pending=64,
+                       autotune=True, target_wait_s=1.0)
+    # force determinism: any positive wait breaches the band
+    srv.admission.decide_sweeps = 1
+    srv.admission.hi = 0.0
+    srv.admission.lo = -1.0
+    with srv:
+        srv.join(10.0)
+        for _ in range(4):
+            ans = srv.submit(DegreeQuery(3), deadline_s=5.0).result(10.0)
+            assert ans.value == 3
+    assert srv.admission.history, "the breach must have moved the knob"
+    assert srv.max_pending == srv.admission.max_pending < 64
+    assert srv._shed_level == srv.admission.shed_level()
+
+
+def test_router_autotune_surface():
+    """The router grows the same admission seam (applied in its sweep;
+    full fan-out integration is exercised by the existing router tests
+    — here the knob plumbing is pinned without sockets)."""
+    from gelly_streaming_tpu.serving.router import ShardRouter
+
+    class _Client:
+        def __init__(self, addrs, i):
+            pass
+
+        def close(self):
+            pass
+
+    r = ShardRouter([["a"]], client_factory=_Client, autotune=True,
+                    max_pending=128, target_wait_s=0.5)
+    try:
+        assert r.admission is not None
+        assert r.admission.ceiling == 128
+        assert r.admission.target_wait_s == 0.5
+    finally:
+        r.close(timeout=2.0)
+
+
+# --------------------------------------------------------------------- #
+# Dynamic packing + checkpoint alignment
+# --------------------------------------------------------------------- #
+def test_superbatches_dynamic_matches_fixed_k_tiling():
+    from gelly_streaming_tpu.core.window import CountWindow, Windower
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 500, 4096)
+    dst = rng.integers(0, 500, 4096)
+
+    def groups(dynamic):
+        w = Windower(CountWindow(128), VertexDict())
+        if dynamic:
+            return list(w.superbatches_dynamic((src, dst), lambda: 4))
+        return list(w.superbatches((src, dst), 4))
+
+    fixed, dyn = groups(False), groups(True)
+    assert [len(g) for g in fixed] == [len(g) for g in dyn]
+    for gf, gd in zip(fixed, dyn):
+        assert gf.n_seen_before == gd.n_seen_before
+        for (s1, d1, _v1), (s2, d2, _v2) in zip(gf.cols, gd.cols):
+            assert np.array_equal(s1, s2) and np.array_equal(d1, d2)
+
+
+def test_superbatches_dynamic_record_path_matches_column_path():
+    from gelly_streaming_tpu.core.window import CountWindow, Windower
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+
+    rng = np.random.default_rng(2)
+    src = rng.integers(0, 100, 1000)
+    dst = rng.integers(0, 100, 1000)
+    records = [(int(a), int(b)) for a, b in zip(src, dst)]
+    ks = iter([1, 2, 4, 8, 1, 2, 4, 8, 1, 2, 4, 8])
+
+    def k_fn_factory():
+        seq = [1, 2, 4, 8] * 16
+        it = iter(seq)
+        return lambda: next(it)
+
+    w1 = Windower(CountWindow(64), VertexDict())
+    cols_groups = list(
+        w1.superbatches_dynamic((src, dst), k_fn_factory())
+    )
+    w2 = Windower(CountWindow(64), VertexDict())
+    rec_groups = list(
+        w2.superbatches_dynamic(iter(records), k_fn_factory())
+    )
+    assert [len(g) for g in cols_groups] == [len(g) for g in rec_groups]
+    for gc, gr in zip(cols_groups, rec_groups):
+        for (s1, d1, _), (s2, d2, _) in zip(gc.cols, gr.cols):
+            assert np.array_equal(s1, s2) and np.array_equal(d1, d2)
+
+
+def test_superbatches_dynamic_skip_replays_the_vertex_dict():
+    from gelly_streaming_tpu.core.window import CountWindow, Windower
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, 300, 2048)
+    dst = rng.integers(0, 300, 2048)
+
+    w_full = Windower(CountWindow(128), VertexDict())
+    full = list(w_full.superbatches_dynamic((src, dst), lambda: 2))
+    w_skip = Windower(CountWindow(128), VertexDict())
+    skipped = list(
+        w_skip.superbatches_dynamic((src, dst), lambda: 2, skip=8)
+    )
+    # 16 windows total, skip 8 -> the 4 tail groups, identically packed
+    assert sum(len(g) for g in skipped) == 8
+    tail = [c for g in full[4:] for c in g.cols]
+    got = [c for g in skipped for c in g.cols]
+    for (s1, d1, _), (s2, d2, _) in zip(tail, got):
+        assert np.array_equal(s1, s2) and np.array_equal(d1, d2)
+    # compact-id continuity: the skipped prefix replayed the encode
+    assert len(w_skip.vertex_dict) == len(w_full.vertex_dict)
+
+
+def test_scheduled_count_window_boundaries_cap_groups():
+    from gelly_streaming_tpu.core.window import (
+        ScheduledCountWindow,
+        Windower,
+    )
+    from gelly_streaming_tpu.core.vertexdict import VertexDict
+
+    policy = ScheduledCountWindow([(0, 4), (6, 8)])
+    assert policy.size_at(0) == 4 and policy.size_at(5) == 4
+    assert policy.size_at(6) == 8 and policy.run_length(4) == 2
+    src = np.arange(4 * 6 + 8 * 3, dtype=np.int64)
+    dst = src.copy()
+    w = Windower(policy, VertexDict())
+    groups = list(w.superbatches_dynamic((src, dst), lambda: 4))
+    sizes = [[len(c[0]) for c in g.cols] for g in groups]
+    # 6 size-4 windows then 3 size-8: k=4 capped at the boundary
+    assert sizes == [[4, 4, 4, 4], [4, 4], [8, 8, 8]]
+
+
+def test_checkpoint_aligned_tracks_group_boundaries():
+    from gelly_streaming_tpu.summaries.groupfold import GroupFoldable
+
+    class W(GroupFoldable):
+        superbatch = 4
+
+        def fold_group(self, group):  # pragma: no cover - unused
+            yield from ()
+
+    w = W()
+    # outside a drive-loop run: the static modulo rule
+    assert w.checkpoint_aligned(4) and not w.checkpoint_aligned(3)
+    # inside one: exactly the drive loop's watermark, whatever tiling
+    w._gf_folded = 7
+    assert w.checkpoint_aligned(7)
+    assert not w.checkpoint_aligned(4) and not w.checkpoint_aligned(8)
+
+
+def test_coordinated_rejects_superbatch_auto(tmp_path):
+    from gelly_streaming_tpu.resilience.coordinated import (
+        CoordinatedCheckpoint,
+    )
+
+    cc = CoordinatedCheckpoint(
+        str(tmp_path), process_id=0, num_processes=1, every=4
+    )
+
+    class W:
+        superbatch_auto = True
+
+    with pytest.raises(ValueError, match="superbatch"):
+        list(cc.run(lambda vd: None, W()))
+
+
+# --------------------------------------------------------------------- #
+# End-to-end superbatch="auto"
+# --------------------------------------------------------------------- #
+def _cc_stream(src, dst, window, bound):
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+
+    return SimpleEdgeStream(
+        (src, dst), window=CountWindow(window),
+        vertex_dict=IdentityDict(bound),
+    )
+
+
+def test_superbatch_auto_value_identity_with_mid_stream_retunes():
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    rng = np.random.default_rng(11)
+    n = 1 << 15
+    src = rng.integers(0, 4096, n)
+    dst = rng.integers(0, 4096, n)
+    base = [
+        str(c) for c in ConnectedComponents(superbatch=1).run(
+            _cc_stream(src, dst, 256, 4096)
+        )
+    ]
+    agg = ConnectedComponents(superbatch="auto")
+    auto = [str(c) for c in agg.run(_cc_stream(src, dst, 256, 4096))]
+    assert auto == base
+    assert agg.control.autok.history, (
+        "the run must have re-tuned K mid-stream (otherwise this test "
+        "pinned nothing)"
+    )
+    assert agg.superbatch == agg.control.autok.k
+
+
+def test_superbatch_auto_bipartiteness_value_identity():
+    from gelly_streaming_tpu.library import BipartitenessCheck
+
+    rng = np.random.default_rng(13)
+    n = 1 << 13
+    src = rng.integers(0, 1024, n)
+    dst = rng.integers(0, 1024, n)
+    base = [
+        str(c) for c in BipartitenessCheck(superbatch=1).run(
+            _cc_stream(src, dst, 128, 1024)
+        )
+    ]
+    agg = BipartitenessCheck(superbatch="auto")
+    auto = [
+        str(c) for c in agg.run(_cc_stream(src, dst, 128, 1024))
+    ]
+    assert auto == base
+
+
+def test_superbatch_auto_kill_resume_through_autockpt(tmp_path):
+    from gelly_streaming_tpu.aggregate.autockpt import AutoCheckpoint
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    rng = np.random.default_rng(5)
+    n = 1 << 14
+    src = rng.integers(0, 2048, n)
+    dst = rng.integers(0, 2048, n)
+
+    def make_stream(vd):
+        from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+        from gelly_streaming_tpu.core.window import CountWindow
+        from gelly_streaming_tpu.datasets import IdentityDict
+
+        return SimpleEdgeStream(
+            (src, dst), window=CountWindow(128),
+            vertex_dict=vd if vd is not None else IdentityDict(2048),
+        )
+
+    path = str(tmp_path / "auto.ckpt")
+    ref = [
+        str(c) for c in ConnectedComponents(superbatch=1).run(
+            make_stream(None)
+        )
+    ]
+    agg = ConnectedComponents(superbatch="auto")
+    ac = AutoCheckpoint(path, every=8)
+    got = []
+    for i, c in enumerate(ac.run(make_stream, agg)):
+        got.append(str(c))
+        if i >= 70:
+            break  # the kill
+    done = AutoCheckpoint(path).windows_done()
+    assert done > 0, "barriers must land on dynamic group boundaries"
+    # the barrier the resume will restore was group-aligned: the
+    # pre-kill emissions up to it are a prefix of the reference
+    assert got[:done] == ref[:done]
+    agg2 = ConnectedComponents(superbatch="auto")
+    ac2 = AutoCheckpoint(path, every=8)
+    tail = [str(c) for c in ac2.run(make_stream, agg2)]
+    assert got[:done] + tail == ref, (
+        "resumed auto-K emissions diverge from the uninterrupted run"
+    )
+
+
+def test_superbatch_auto_window_size_shift_matches_pinned_k1_oracle():
+    """The mid-stream window-size-shift contract: under a
+    ScheduledCountWindow the auto run re-tunes K across the shift and
+    stays emission-identical to the pinned-K=1 oracle (same dynamic
+    machinery, knob pinned via the AutoK(k0=K, k_max=K) seam)."""
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import ScheduledCountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    rng = np.random.default_rng(17)
+    # the post-shift phase carries 96 windows (not a bare handful): on
+    # a loaded box the climb can churn through probe/revert cycles and
+    # strand a few in-flight groups, so the phase must hold enough
+    # decisions that the w_mean shift detector ALWAYS gets one
+    n = 1 << 16
+    src = rng.integers(0, 4096, n)
+    dst = rng.integers(0, 4096, n)
+    schedule = [(0, 64), (256, 512)]  # 256 small windows, then 8x
+
+    def run(agg):
+        stream = SimpleEdgeStream(
+            (src, dst), window=ScheduledCountWindow(schedule),
+            vertex_dict=IdentityDict(4096),
+        )
+        return [str(c) for c in agg.run(stream)]
+
+    from gelly_streaming_tpu.control import AutoK, ControlPlane
+
+    oracle = ConnectedComponents(superbatch="auto")
+    oracle.control = ControlPlane(autok=AutoK(k0=1, k_max=1))
+    base = run(oracle)
+    assert oracle.control.autok.history == []
+
+    agg = ConnectedComponents(superbatch="auto")
+    # retune fast, with the ladder bounded so post-shift groups are
+    # small enough to DECIDE on within the short post-shift phase (the
+    # bench shift cell bounds its ladder for the same reason)
+    agg.control = ControlPlane(autok=AutoK(k_max=16, decide_groups=1))
+    auto = run(agg)
+    assert auto == base, "auto-K diverged from the pinned-K oracle"
+    hist = agg.control.autok.history
+    assert any(sig == "window-shift" for _o, _n, sig in hist), hist
+
+
+# --------------------------------------------------------------------- #
+# Timeline: RETUNE story lines
+# --------------------------------------------------------------------- #
+def test_timeline_renders_retunes_in_causal_order():
+    from gelly_streaming_tpu.obs import timeline
+
+    events = [
+        {"kind": "counter", "name": "resilience.coord_commits", "v": 1,
+         "ts": 10.0, "shard": "p0"},
+        {"kind": "counter", "name": "control.retune", "v": 1, "ts": 11.0,
+         "shard": "p0",
+         "labels": {"knob": "superbatch_k", "from": "16", "to": "64",
+                    "signal": "eps-improved"}},
+        {"kind": "counter", "name": "serving.failover", "v": 1,
+         "ts": 12.0, "shard": "p1"},
+    ]
+    lines = timeline.render(events)
+    assert len(lines) == 3
+    assert "COMMIT" in lines[0]
+    assert "RETUNE" in lines[1]
+    assert "knob=superbatch_k" in lines[1]
+    assert "from=16" in lines[1] and "to=64" in lines[1]
+    assert "signal=eps-improved" in lines[1]
+    assert "PROMOTE" in lines[2]
+
+
+def test_retune_events_flow_into_a_shard_sink(tmp_path):
+    """Live path: a controller decision under obs lands in the shard
+    event stream the timeline merges."""
+    from gelly_streaming_tpu.control.controller import log_retune
+    from gelly_streaming_tpu.obs import timeline
+    from gelly_streaming_tpu.obs.cluster import ShardSink
+
+    sink = ShardSink(str(tmp_path / "events.p0.jsonl"), shard=0)
+    obs.get_registry().add_sink(sink)
+    obs.enable()
+    try:
+        log_retune("prefetch_depth", 2, 4, "consumer-idle")
+    finally:
+        obs.get_registry().remove_sink(sink)
+        sink.close()
+    lines = timeline.render(timeline.load_run(str(tmp_path)))
+    assert len(lines) == 1 and "RETUNE" in lines[0]
+    assert "knob=prefetch_depth" in lines[0]

@@ -657,6 +657,59 @@ def test_gl005_gated_trace_context_and_near_misses_are_clean(tmp_path):
     assert "GL005" not in rule_ids(res)
 
 
+# The ISSUE 15 extension: the control plane (control/) runs inside the
+# hot loops it tunes, so its registry work — decision logging, signal
+# reads that mutate — must gate on obs.enable(); direct perf-counter
+# taps (plain field arithmetic) are the blessed obs-off path and stay
+# clean.
+GL005_CONTROL_TP = {
+    # an ungated retune log: every decision in a disabled run would
+    # pay the registry chain + label-dict allocation
+    "control/controller.py": """
+    def log_retune(knob, old, new, signal):
+        get_registry().counter(
+            "control.retune", knob=knob, signal=signal
+        ).inc()
+    """,
+}
+
+GL005_CONTROL_NEG = {
+    # the shipped shape: logging behind the gate; direct taps are
+    # plain field arithmetic, not registry work
+    "control/controller.py": """
+    def log_retune(knob, old, new, signal):
+        if _trace.on():
+            get_registry().counter(
+                "control.retune", knob=knob, signal=signal
+            ).inc()
+    """,
+    "control/signals.py": """
+    class SignalReader:
+        def observe(self, name, value):
+            cell = self._direct.setdefault(name, [0, 0.0, 0.0])
+            cell[0] += 1
+            cell[1] += value
+            cell[2] = value
+    """,
+    # the same ungated log outside control/ is out of scope
+    "library/anything.py": """
+    def log_retune(knob, old, new, signal):
+        get_registry().counter("control.retune", knob=knob).inc()
+    """,
+}
+
+
+def test_gl005_ungated_control_plane_logging_fires(tmp_path):
+    res = lint_files(tmp_path, GL005_CONTROL_TP)
+    msgs = [f.message for f in res.findings if f.rule == "GL005"]
+    assert len(msgs) == 1 and "control.retune" in msgs[0]
+
+
+def test_gl005_gated_control_plane_and_direct_taps_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL005_CONTROL_NEG)
+    assert "GL005" not in rule_ids(res)
+
+
 # --------------------------------------------------------------------- #
 # GL006 atomic-commit discipline
 # --------------------------------------------------------------------- #

@@ -66,6 +66,17 @@ WATCHED_LATENCY = (
     "min:algos.bipartiteness.1024.superbatch.eps",
 )
 
+#: the autotune artifact's guarded cells (BENCH_AUTOTUNE_CPU.json):
+#: the controller's throughput on the cliff cell (``min:`` — a
+#: regression means the controller started LOSING to the hand-tuned
+#: constant) and its ratio against the hand cell measured in the same
+#: run (also ``min:``: the ratio is the artifact's own honesty check,
+#: so the watch survives the box getting faster or slower overall).
+WATCHED_AUTOTUNE = (
+    "min:cells.cc_1024.auto.eps",
+    "min:cells.cc_1024.ratio_vs_hand",
+)
+
 #: the sharded-serving artifact's guarded metrics
 #: (BENCH_SERVING_SHARDED_CPU.json): the cached routing tier's
 #: aggregate Zipfian QPS is throughput (``min:`` — regression is

@@ -60,6 +60,14 @@ HOT_MODULES = (
     # their disabled-mode cost is part of the ≈0 overhead bound
     "obs/cluster.py",
     "obs/flight.py",
+    # the control plane (ISSUE 15) runs INSIDE the hot loops it tunes
+    # (the group drive loop, the prefetch put/get paths, the serving
+    # sweep): its signal taps live on direct perf_counter fields by
+    # design, so any registry work it does — decision logging, span
+    # reads — must gate on obs.enable() or every disabled run pays a
+    # per-decision allocation the ≈0 bound promised away
+    "control/signals.py",
+    "control/controller.py",
 )
 
 #: modules where only the trace-context check applies (the wire loops:
