@@ -905,12 +905,76 @@ def bench_autotune_shift(binp: str, n_edges: int = 1 << 22,
     }
 
 
+def bench_autotune_pagerank_hold(binp: str, n_edges: int = 1 << 15,
+                                 id_fold: int = 1 << 14,
+                                 window: int = 1024,
+                                 reps: int = 3) -> dict:
+    """The NEGATIVE-control cell (ROADMAP 5b): PageRank at the
+    latency-curve cell's exact configuration (32k corpus edges folded
+    into a 16k-vertex space, 1024-edge windows) is documented honest
+    ~parity on CPU — its per-window cost is the warm-start fixpoint,
+    which fusion cannot remove. ``superbatch="auto"`` here must
+    therefore learn to HOLD K=1: probe up, measure no win, revert, and
+    end the stream at K=1 with throughput at parity with the pinned
+    K=1 run (alternating pinned/auto passes, medians — the same
+    drift discipline as the cc_1024 cell). A controller that ends
+    anywhere else has started paying group quantization for fusion
+    that buys nothing, which is exactly the regression the benchguard
+    watch on ``auto.k_final`` exists to catch."""
+    from gelly_streaming_tpu import datasets
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.library import IncrementalPageRank
+
+    src, dst = _corpus_cols(binp, n_edges)
+    src = src % id_fold
+    dst = dst % id_fold
+
+    def one_pass(mode):
+        stream = SimpleEdgeStream(
+            (src, dst), window=CountWindow(window),
+            vertex_dict=datasets.IdentityDict(id_fold),
+        )
+        agg = IncrementalPageRank(
+            superbatch=1 if mode == "pinned" else "auto"
+        )
+        t0 = time.perf_counter()
+        for _ in agg.run(stream):
+            pass
+        agg.sync()
+        return len(src) / (time.perf_counter() - t0), agg
+
+    one_pass("pinned")
+    one_pass("auto")  # warm both shapes
+    pinned_eps, auto_eps = [], []
+    last_auto = None
+    for _ in range(reps):
+        pinned_eps.append(one_pass("pinned")[0])
+        eps, last_auto = one_pass("auto")
+        auto_eps.append(eps)
+    pinned_med = sorted(pinned_eps)[reps // 2]
+    auto_med = sorted(auto_eps)[reps // 2]
+    ak = last_auto.control.autok
+    return {
+        "window": window,
+        "n_edges": int(len(src)),
+        "id_fold": id_fold,
+        "pinned": {"eps": pinned_med,
+                   "eps_all": [round(e, 1) for e in pinned_eps]},
+        "auto": {"eps": auto_med, "k_final": int(ak.k),
+                 "held": int(ak.k) == 1,
+                 "k_path": [[o, n, s] for o, n, s in ak.history],
+                 "eps_all": [round(e, 1) for e in auto_eps]},
+        "ratio_vs_pinned": round(auto_med / pinned_med, 3),
+    }
+
+
 #: acceptance floor: auto-K (incl. its convergence ramp) must reach at
 #: least this fraction of the hand-tuned cell's throughput
 AUTOTUNE_MIN_RATIO = 0.9
 
 
-def run_autotune(artifact: str) -> dict:
+def run_autotune(artifact: str, pagerank_only: bool = False) -> dict:
     """The self-tuning proof harness (ISSUE 15 acceptance): commit
     ``BENCH_AUTOTUNE_CPU.json`` + ``_OBS.jsonl`` with (a) the cliff-cell
     auto-vs-hand eps ratio (>= :data:`AUTOTUNE_MIN_RATIO` required — the
@@ -921,7 +985,15 @@ def run_autotune(artifact: str) -> dict:
     drifts ~10% over minutes — separate subprocesses would compare
     different machines; the obs_overhead discipline); the shift cell
     runs in-process under the driver's obs sink so its RETUNE events
-    are committed evidence."""
+    are committed evidence.
+
+    The ``pagerank_hold`` cell is the NEGATIVE control (ROADMAP 5b,
+    ISSUE 16 satellite): auto-K on the fixpoint-bound PageRank parity
+    workload must end the stream holding K=1 at throughput parity with
+    pinned K=1 (see :func:`bench_autotune_pagerank_hold`).
+    ``pagerank_only=True`` (``--autotune --pagerank``) refreshes ONLY
+    that cell, merging into the committed artifact — the
+    ``--latency-curve --algos`` idiom."""
     import subprocess
 
     from gelly_streaming_tpu import datasets, obs
@@ -929,6 +1001,38 @@ def run_autotune(artifact: str) -> dict:
     path, _is_real = _corpus_path()
     bound = _id_bound(path, _is_real)
     binp = datasets.binary_cache(path)
+
+    def run_pr_cell():
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; "
+             "jax.config.update('jax_platforms','cpu'); "
+             "import bench, json; "
+             "print(json.dumps(bench.bench_autotune_pagerank_hold("
+             f"{binp!r})))"],
+            capture_output=True, text=True, timeout=1800,
+        )
+        if out.returncode != 0:
+            log(out.stderr[-500:])
+            return None
+        return _parse_sub(out.stdout)
+
+    if pagerank_only:
+        with open(artifact) as f:
+            doc = json.load(f)
+        log("autotune: pagerank negative-control cell (hold at K=1)...")
+        cell = run_pr_cell()
+        doc["cells"]["pagerank_hold"] = cell or {}
+        head = doc.setdefault("headline", {})
+        held = bool(cell and cell["auto"]["held"])
+        head["pagerank_held"] = held
+        head["pagerank_ratio_vs_pinned"] = (cell or {}).get(
+            "ratio_vs_pinned")
+        head["ok"] = bool(head.get("ok")) and held
+        with open(artifact, "w") as f:
+            json.dump(doc, f, indent=2)
+        log(f"autotune: {json.dumps(head)}")
+        return doc
     doc = {
         "note": (
             "self-tuning control plane (ISSUE 15): superbatch='auto' "
@@ -1008,8 +1112,15 @@ def run_autotune(artifact: str) -> dict:
         with obs.span("bench.autotune_shift"):
             doc["cells"]["shift"] = bench_autotune_shift(binp)
         flush()
+        log("autotune: pagerank negative-control cell (hold at K=1)...")
+        with obs.span("bench.autotune_pagerank_hold"):
+            pr = run_pr_cell()
+        failures += pr is None
+        doc["cells"]["pagerank_hold"] = pr or {}
+        flush()
         ratio = (doc["cells"]["cc_1024"] or {}).get("ratio_vs_hand")
         shift = doc["cells"]["shift"]
+        held = bool(pr and pr["auto"]["held"])
         doc["headline"] = {
             "auto_eps": (cell.get("auto") or {}).get("eps"),
             "hand_eps": (cell.get("hand") or {}).get("eps"),
@@ -1017,12 +1128,16 @@ def run_autotune(artifact: str) -> dict:
             "min_ratio": AUTOTUNE_MIN_RATIO,
             "shift_retuned": shift["shift_retuned"],
             "shift_oracle_mismatches": shift["oracle_mismatches"],
+            "pagerank_held": held,
+            "pagerank_ratio_vs_pinned": (pr or {}).get(
+                "ratio_vs_pinned"),
             "ok": bool(
                 not failures
                 and ratio is not None
                 and ratio >= AUTOTUNE_MIN_RATIO
                 and shift["shift_retuned"]
                 and shift["oracle_mismatches"] == 0
+                and held
             ),
         }
         if not failures:
@@ -2497,6 +2612,190 @@ def _headline_guarded():
             pass
 
 
+def run_transport_bench(artifact: str, obs_log: str,
+                        smoke: bool = False) -> dict:
+    """ISSUE 16: per-backend exchange latency + recovery numbers for the
+    locally-runnable cluster-fabric backends (shared-dir, socket).
+
+    Four legs per backend, all through the ONE ``Transport`` interface:
+    (1) tag-store round trips (put+get of a 4 KiB payload — the
+    rendezvous-record shape); (2) 2-rank allgathers (the dict-exchange
+    primitive, measured on rank 0 including the wait for the peer's
+    publication); (3) elections (the cadence-agreement primitive,
+    CRC-framed winner read-back); (4) the serving lease (CRC-framed
+    heartbeat write + read). Then the 2-process sharded-ingest +
+    coordinated-barrier kill/recovery scenario (a reduced
+    ``run_mp_sweep``: every kill point must replay oracle-identical)
+    rides the same backend for its dict exchange.
+
+    Honest annotation: CPU-core-bound, loopback/localfs only — these
+    numbers bound the HARNESS (frame codec, store round trip, polling
+    cadence), not a datacenter fabric. The obs artifact carries the
+    driver's labeled fabric.exchange/fabric.elect counters plus every
+    sweep worker's shard-labeled event stream."""
+    import tempfile
+    import threading
+
+    from gelly_streaming_tpu import obs
+    from gelly_streaming_tpu.fabric import (
+        ExchangeDaemon,
+        SharedDirTransport,
+        SocketTransport,
+    )
+    from gelly_streaming_tpu.obs.cluster import ShardSink
+    from gelly_streaming_tpu.obs.registry import nearest_rank
+    from gelly_streaming_tpu.resilience import chaos
+    from gelly_streaming_tpu.serving.rpc import HeartbeatLease
+
+    def pcts(ms):
+        xs = sorted(ms)
+        return {
+            "p50_ms": round(nearest_rank(xs, 50), 4),
+            "p99_ms": round(nearest_rank(xs, 99), 4),
+        }
+
+    payload = b"x" * 4096
+    rounds = 50 if smoke else 200
+    ag_rounds = 10 if smoke else 30
+    elections = 10 if smoke else 40
+    backends = {}
+    sweep_obs = []
+    with tempfile.TemporaryDirectory(prefix="bench_transport_") as root:
+        sink_path = os.path.join(root, "events.driver.jsonl")
+        sink = ShardSink(sink_path)  # driver stream (shard-less)
+        obs.get_registry().add_sink(sink)
+        obs.enable()
+        try:
+            for backend in ("shared_dir", "socket"):
+                daemon = None
+                if backend == "socket":
+                    daemon = ExchangeDaemon().start()
+
+                    def make(pid=0, n=1, _d=daemon):
+                        return SocketTransport(
+                            _d.address, pid, n, timeout_s=60)
+                else:
+                    bdir = os.path.join(root, "shared_store")
+
+                    def make(pid=0, n=1, _d=None):
+                        return SharedDirTransport(
+                            bdir, pid, n, timeout_s=60)
+
+                log(f"transport[{backend}]: store round trips...")
+                tr = make()
+                lat = []
+                t_all = time.perf_counter()
+                for i in range(rounds):
+                    t0 = time.perf_counter()
+                    tr.put(f"pg.{i}", payload, overwrite=True)
+                    got = tr.get(f"pg.{i}")
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                    assert got == payload
+                wall = time.perf_counter() - t_all
+                store = {
+                    "ops_per_s": round(2 * rounds / wall, 1),
+                    "payload_bytes": len(payload),
+                    "bytes_per_s": round(
+                        2 * rounds * len(payload) / wall, 1),
+                    **pcts(lat),
+                }
+
+                log(f"transport[{backend}]: 2-rank allgathers...")
+                a, b = make(0, 2), make(1, 2)
+                arr = np.arange(1024, dtype=np.int64)
+                ag = []
+
+                def peer():
+                    for r in range(ag_rounds):
+                        b.allgather(f"ag.{r}", arr * 10)
+
+                t = threading.Thread(target=peer)
+                t.start()
+                try:
+                    for r in range(ag_rounds):
+                        t0 = time.perf_counter()
+                        out = a.allgather(f"ag.{r}", arr)
+                        ag.append((time.perf_counter() - t0) * 1e3)
+                        assert len(out) == 2
+                finally:
+                    t.join(120)
+                exchange = {"ranks": 2, "array_int64": 1024, **pcts(ag)}
+
+                log(f"transport[{backend}]: elections + lease...")
+                el = []
+                for r in range(elections):
+                    t0 = time.perf_counter()
+                    won = make(0, 2).elect(f"lead.{r}", r)
+                    el.append((time.perf_counter() - t0) * 1e3)
+                    assert won == r
+                lease_tr = make()
+                lease = HeartbeatLease(lease_tr, lease_s=0.5)
+                ls = []
+                for r in range(rounds // 2):
+                    t0 = time.perf_counter()
+                    lease.write()
+                    doc = HeartbeatLease.read(lease_tr)
+                    ls.append((time.perf_counter() - t0) * 1e3)
+                    assert doc is not None
+
+                log(f"transport[{backend}]: kill/recovery scenario...")
+                obs_tmp = os.path.join(root, f"mp_obs.{backend}.jsonl")
+                sweep = chaos.run_mp_sweep(
+                    processes=2, windows=3, window_edges=8,
+                    superbatch=2, every=2, seed=11,
+                    transport=backend, corrupt=False, failover=False,
+                    rpc=False,
+                    workdir=os.path.join(root, f"mp_{backend}"),
+                    obs_log=obs_tmp, log=log,
+                )
+                sweep_obs.append(obs_tmp)
+                if daemon is not None:
+                    daemon.stop()
+                backends[backend] = {
+                    "store": store,
+                    "exchange": exchange,
+                    "elect": pcts(el),
+                    "lease": pcts(ls),
+                    "recovery": {
+                        "ok": sweep["ok"],
+                        "kill_points": sweep["kill_points"],
+                        "recovery_s_p50": sweep["recovery_s"]["p50"],
+                        "recovery_s_max": sweep["recovery_s"]["max"],
+                        "cluster_restarts": sweep[
+                            "cluster_restarts_total"],
+                    },
+                }
+        finally:
+            obs.disable()
+            obs.get_registry().remove_sink(sink)
+            sink.close()
+        with open(obs_log, "w") as out:
+            for p in [sink_path] + sweep_obs:
+                if os.path.exists(p):
+                    with open(p) as f:
+                        out.writelines(f)
+    doc = {
+        "platform": "cpu-xla",
+        "ok": all(b["recovery"]["ok"] for b in backends.values()),
+        "backends": backends,
+        "obs_log": os.path.basename(obs_log),
+        "note": (
+            "core-bound harness numbers: loopback sockets + local "
+            "filesystem, CPU workers — they bound the transport "
+            "machinery (frame codec, store round trip, CRC framing, "
+            "polling cadence), not a datacenter fabric. allgather "
+            "latency is rank 0's full exchange including the wait for "
+            "the peer's publication; recovery is the reduced 2-process "
+            "kill sweep (every point oracle-identical) with the dict "
+            "exchange on THIS backend (epoch barriers stay shared-dir "
+            "in both modes — the daemon store is in-memory)"
+        ),
+    }
+    with open(artifact, "w") as f:
+        json.dump(doc, f, indent=2)
+    return doc
+
+
 def main():
     if "--headline-worker" in sys.argv:
         out_path = sys.argv[sys.argv.index("--headline-worker") + 1]
@@ -2534,9 +2833,27 @@ def main():
         from gelly_streaming_tpu.resilience import chaos
 
         if "--multiprocess" in sys.argv:
-            artifact = "BENCH_CHAOS_MP_CPU.json"
-            obs_log = "BENCH_CHAOS_MP_CPU_OBS.jsonl"
-            doc = chaos.run_mp_sweep(log=log, obs_log=obs_log)
+            # --transport socket reruns the same sweep with the workers'
+            # dict exchange riding GSRP frames against the driver's
+            # per-point ExchangeDaemon instead of the shared directory
+            # (epoch barriers stay shared-dir in both modes); artifacts
+            # get a _SOCKET suffix so both backends' evidence can sit
+            # side by side.
+            transport = "shared_dir"
+            if "--transport" in sys.argv:
+                transport = sys.argv[sys.argv.index("--transport") + 1]
+            suffix = "" if transport == "shared_dir" else (
+                "_" + transport.upper())
+            artifact = f"BENCH_CHAOS_MP{suffix}_CPU.json"
+            obs_log = f"BENCH_CHAOS_MP{suffix}_CPU_OBS.jsonl"
+            # the rpc failover scenario exercises the SERVING sockets,
+            # which are identical under every exchange transport — the
+            # shared-dir artifact carries it once; reruns on other
+            # transports measure kill/recovery + failover through the
+            # transport under test without repeating it
+            doc = chaos.run_mp_sweep(log=log, obs_log=obs_log,
+                                     transport=transport,
+                                     rpc=(transport == "shared_dir"))
             doc["platform"] = "cpu-xla"
             with open(artifact, "w") as f:
                 json.dump(doc, f, indent=2)
@@ -2579,6 +2896,38 @@ def main():
             "kill_points": doc["kill_points"],
             "restarts_total": doc["restarts_total"],
             "flight_dumps_total": doc["flight_dumps_total"],
+            "ok": doc["ok"],
+            "artifact": artifact,
+            "obs_log": obs_log,
+        }))
+        if not doc["ok"]:
+            sys.exit(1)
+        return
+
+    if "--transport" in sys.argv:
+        # ISSUE 16 acceptance: per-backend exchange latency + recovery
+        # evidence for the cluster-fabric backends. CPU-pinned by
+        # construction (loopback sockets / local fs; sweep workers pin
+        # their own JAX_PLATFORMS=cpu) — harness numbers, not fabric
+        # numbers; the artifact says so.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        artifact = "BENCH_TRANSPORT_CPU.json"
+        obs_log = "BENCH_TRANSPORT_CPU_OBS.jsonl"
+        doc = run_transport_bench(
+            artifact, obs_log, smoke="--smoke" in sys.argv)
+        b = doc["backends"]
+        print(json.dumps({
+            "metric": "transport_put_get_ops_per_s",
+            "value": {k: v["store"]["ops_per_s"] for k, v in b.items()},
+            "unit": "ops/sec",
+            "exchange_p50_ms": {
+                k: v["exchange"]["p50_ms"] for k, v in b.items()},
+            "elect_p50_ms": {
+                k: v["elect"]["p50_ms"] for k, v in b.items()},
+            "recovery_ok": {
+                k: v["recovery"]["ok"] for k, v in b.items()},
             "ok": doc["ok"],
             "artifact": artifact,
             "obs_log": obs_log,
@@ -2635,7 +2984,11 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
         artifact = "BENCH_AUTOTUNE_CPU.json"
-        doc = run_autotune(artifact)
+        # --pagerank refreshes ONLY the negative-control cell (ROADMAP
+        # 5b: auto-K must HOLD K=1 on the fixpoint-bound parity
+        # workload), merging into the committed artifact
+        doc = run_autotune(artifact,
+                           pagerank_only="--pagerank" in sys.argv)
         head = doc.get("headline") or {}
         print(json.dumps({
             "metric": "autotune_cc_1024_eps",
@@ -2646,6 +2999,7 @@ def main():
             "shift_oracle_mismatches": head.get(
                 "shift_oracle_mismatches"
             ),
+            "pagerank_held": head.get("pagerank_held"),
             "ok": head.get("ok"),
             "artifact": artifact,
             "obs_log": doc.get("obs_log"),

@@ -513,8 +513,25 @@ class AutoCheckpoint:
         try:
             with open(path, "rb") as f:
                 data = f.read()
+        except Exception as e:
+            self._rejected_seen.add(key)
+            _integrity.record_rejection(path, repr(e))
+            return None
+        return self._barrier_payload(data, path, key)
+
+    def _barrier_payload(self, data: bytes, origin: str,
+                         key) -> Optional[dict]:
+        """Validate one barrier's BYTES — unwrap + checksum + unpickle
+        + shape-check — independent of where they were read from; the
+        coordinated layer reuses this for shards read through a
+        cluster :class:`~gelly_streaming_tpu.fabric.Transport`.
+        Returns None (after recording the rejection once per ``key``)
+        on any damage."""
+        if key in self._rejected_seen:
+            return None
+        try:
             payload = pickle.loads(
-                _integrity.unwrap_checksummed(data, origin=path)
+                _integrity.unwrap_checksummed(data, origin=origin)
             )
             if (
                 not isinstance(payload, dict)
@@ -524,7 +541,7 @@ class AutoCheckpoint:
             return payload
         except Exception as e:
             self._rejected_seen.add(key)
-            _integrity.record_rejection(path, repr(e))
+            _integrity.record_rejection(origin, repr(e))
             return None
 
     def _restore_work(self, work, payload: dict) -> None:

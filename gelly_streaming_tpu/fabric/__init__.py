@@ -1,0 +1,56 @@
+"""Cluster transport fabric: one exchange interface, three backends.
+
+See :mod:`~gelly_streaming_tpu.fabric.base` for the contract. The
+public surface:
+
+- :class:`Transport` / :class:`TagStat` / :class:`TransportUnsupported`
+  — the interface;
+- :class:`SharedDirTransport` — tag = file under a shared directory
+  (today's semantics, byte-identical layouts);
+- :class:`SocketTransport` / :class:`ExchangeDaemon` — GSRP frames
+  against a tiny stdlib exchange daemon;
+- :class:`CollectiveTransport` — XLA collectives over a live
+  ``jax.distributed`` runtime (group primitives only);
+- :class:`ElectedK` — the cadence-agreement adapter riding
+  ``Transport.elect``;
+- :func:`as_transport` — the string-coercion seam: every consumer that
+  historically took a directory path keeps its signature, a bare
+  string becoming a shared-dir transport.
+
+``python -m gelly_streaming_tpu.fabric --smoke`` runs the 2-process
+smoke over the locally-runnable backends; ``--daemon`` runs the
+exchange daemon in the foreground.
+"""
+
+from __future__ import annotations
+
+from .agreement import ElectedK
+from .base import TagStat, Transport, TransportUnsupported
+from .collective import CollectiveTransport
+from .exchange import ExchangeDaemon, SocketTransport
+from .shared_dir import SharedDirTransport
+
+__all__ = [
+    "CollectiveTransport",
+    "ElectedK",
+    "ExchangeDaemon",
+    "SharedDirTransport",
+    "SocketTransport",
+    "TagStat",
+    "Transport",
+    "TransportUnsupported",
+    "as_transport",
+]
+
+
+def as_transport(obj, **kwargs) -> Transport:
+    """Coerce a consumer's ``transport`` argument: a
+    :class:`Transport` passes through; a string is a shared directory
+    (the historical signature of every seam this fabric replaced)."""
+    if isinstance(obj, Transport):
+        return obj
+    if isinstance(obj, (str, bytes)) or hasattr(obj, "__fspath__"):
+        return SharedDirTransport(str(obj), **kwargs)
+    raise TypeError(
+        f"expected a Transport or a shared-directory path, "
+        f"got {type(obj).__name__}")

@@ -1,0 +1,150 @@
+"""Collective-backed transport: XLA collectives over the live runtime.
+
+When ``jax.distributed`` is initialized (a TPU pod, or multi-process
+CPU where the backend implements cross-process collectives), the
+runtime's own allgather IS the exchange layer — ICI within a slice,
+DCN across slices, no daemon and no shared directory. This backend
+generalizes the old ``JaxAllgatherTransport``: the group primitives
+(:meth:`~CollectiveTransport.allgather`, barrier, broadcast, elect)
+ride ``multihost_utils.process_allgather``; the tag STORE does not
+exist (``persistent = False`` — there is nothing to replay from), so
+:meth:`~CollectiveTransport.put`/``get`` raise
+:class:`~gelly_streaming_tpu.fabric.base.TransportUnsupported` and
+store-shaped consumers (snapshot mirrors, rendezvous records) must
+pick a store-backed transport.
+
+Elections still hold their determinism contract WITHIN a process:
+every ranks' proposals are gathered, the lowest rank's proposal wins
+(a pure function of the gathered set), and the winner is cached per
+tag so a replayed ``elect`` on this process returns the same value
+without re-entering the collective — the property the cadence
+agreement layer needs when a drive loop replays windows after an
+in-process restore.
+
+Capability is an ENVIRONMENT property (the CPU backend may implement
+no cross-process collectives at all); tests probe it the way
+``tests/test_multiprocess.py`` does and skip when absent.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs import trace as _trace
+from ..obs.registry import get_registry
+from .base import TagStat, Transport, TransportUnsupported
+
+
+class CollectiveTransport(Transport):
+    """Group primitives over ``jax.distributed``; no tag store. Rank
+    and group size come from the live runtime, read lazily so the
+    transport can be constructed before ``initialize``."""
+
+    backend = "collective"
+    persistent = False
+
+    def __init__(self, *, timeout_s: float = 60.0):
+        self.timeout_s = float(timeout_s)
+        self._elected = {}  # tag -> winning value (replay cache)
+
+    @property
+    def process_id(self) -> int:  # type: ignore[override]
+        import jax
+
+        return int(jax.process_index())
+
+    @property
+    def num_processes(self) -> int:  # type: ignore[override]
+        import jax
+
+        return int(jax.process_count())
+
+    # ---------------------------------------------------------------- #
+    # Group primitives (native)
+    # ---------------------------------------------------------------- #
+    def allgather(self, tag: str, arr: np.ndarray) -> list:
+        """``multihost_utils.process_allgather`` — tags are ignored;
+        the runtime's collective ordering IS the alignment."""
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray(arr)
+        if _trace.on():
+            get_registry().counter(
+                "fabric.exchange", backend=self.backend, tag=tag,
+            ).inc()
+        out = np.asarray(multihost_utils.process_allgather(arr))
+        return list(out.reshape((-1,) + arr.shape))
+
+    def barrier(self, tag: str) -> None:
+        self.allgather(tag, np.zeros(1, np.int8))
+
+    def broadcast(self, tag: str, payload: Optional[bytes] = None, *,
+                  root: int = 0) -> bytes:
+        gathered = self._gather_blobs(
+            tag, payload if payload is not None else b"")
+        return gathered[int(root)]
+
+    def elect(self, tag: str, value):
+        """Lowest-rank proposal wins; cached per tag so an in-process
+        replay re-reads this process's recorded winner instead of
+        re-entering the collective (peers are not replaying with us)."""
+        if tag in self._elected:
+            return self._elected[tag]
+        blobs = self._gather_blobs(tag, pickle.dumps(value, protocol=4))
+        winner = pickle.loads(blobs[0])
+        if _trace.on():
+            get_registry().counter(
+                "fabric.elect", backend=self.backend, tag=tag,
+                won=str(self.process_id == 0).lower(),
+            ).inc()
+        self._elected[tag] = winner
+        return winner
+
+    def _gather_blobs(self, tag: str, blob: bytes) -> List[bytes]:
+        """Allgather variable-length byte strings: lengths first, then
+        one shared-capacity uint8 plane per rank."""
+        lengths = np.concatenate([
+            np.asarray(n).reshape(-1)
+            for n in self.allgather(
+                tag + ".len", np.array([len(blob)], np.int32))
+        ])
+        cap = max(1, int(lengths.max()))
+        padded = np.zeros(cap, np.uint8)
+        padded[: len(blob)] = np.frombuffer(blob, np.uint8)
+        planes = self.allgather(tag + ".bytes", padded)
+        return [
+            np.asarray(p)[: int(lengths[i])].tobytes()
+            for i, p in enumerate(planes)
+        ]
+
+    # ---------------------------------------------------------------- #
+    # No tag store
+    # ---------------------------------------------------------------- #
+    def put(self, tag: str, payload: bytes, *,
+            overwrite: bool = False) -> bool:
+        raise TransportUnsupported(
+            "collective transport has no tag store: put() needs a "
+            "shared-dir or socket transport")
+
+    def _get_once(self, tag: str) -> Optional[bytes]:
+        raise TransportUnsupported(
+            "collective transport has no tag store: get() needs a "
+            "shared-dir or socket transport")
+
+    def stat(self, tag: str) -> Optional[TagStat]:
+        raise TransportUnsupported(
+            "collective transport has no tag store: stat() needs a "
+            "shared-dir or socket transport")
+
+    def list(self, prefix: str = "") -> List[str]:
+        raise TransportUnsupported(
+            "collective transport has no tag store: list() needs a "
+            "shared-dir or socket transport")
+
+    def delete(self, tag: str) -> bool:
+        raise TransportUnsupported(
+            "collective transport has no tag store: delete() needs a "
+            "shared-dir or socket transport")

@@ -254,21 +254,28 @@ class IncrementalPageRank(GroupFoldable):
         #: optional device mesh: the per-window fixpoint shards the edge
         #: columns over the ``"edges"`` axis with per-iteration psum
         self.mesh = mesh
-        if isinstance(superbatch, str):
-            # "auto" (and any other string) is explicitly unsupported
-            # here: PageRank's fused cell is honest parity on CPU (its
-            # per-window cost is the fixpoint, which fusion cannot
-            # remove), so a controller would only add ramp cost —
-            # fail with the reason, not a str-vs-int TypeError
+        #: ``superbatch="auto"``: the controller drives the fused path
+        #: exactly like CC/bipartiteness — and because this carry's
+        #: per-window cost is the fixpoint (which fusion cannot
+        #: remove), the controller's JOB here is to hold K=1. That
+        #: negative control is committed bench evidence
+        #: (``BENCH_AUTOTUNE_CPU.json`` ``pagerank_hold`` cell): a
+        #: controller that starts paying for fusion that buys nothing
+        #: regresses a benchguard-watched cell.
+        self.superbatch_auto = superbatch == "auto"
+        if self.superbatch_auto:
+            superbatch = 1
+        elif isinstance(superbatch, str):
             raise ValueError(
-                "IncrementalPageRank takes a fixed int superbatch "
-                f'(got {superbatch!r}); superbatch="auto" is not '
-                "supported — its per-window cost is fixpoint-bound, "
-                "not dispatch-bound"
+                f'superbatch must be an int >= 1 or "auto", '
+                f"got {superbatch!r}"
             )
-        if superbatch < 1:
+        elif superbatch < 1:
             raise ValueError(f"superbatch must be >= 1, got {superbatch}")
         self.superbatch = int(superbatch)
+        #: the live ControlPlane of an auto run (None otherwise) — same
+        #: seam as ``SummaryAggregation.control``
+        self.control = None
         self._step = _build_pr_step(mesh, self.chunk, self.max_chunks)
         self._group_step = None  # built on first group fold
         self._carry = None  # (src, dst, ranks) device arrays
@@ -317,13 +324,31 @@ class IncrementalPageRank(GroupFoldable):
     def run(self, stream) -> Iterator[PageRankEmission]:
         self._vdict = stream.vertex_dict
         self._w = 0
-        if self.superbatch > 1:
+        if self.superbatch > 1 or self.superbatch_auto:
             from ..summaries.groupfold import drive_group_folded
 
-            yield from drive_group_folded(self, stream, self.superbatch)
+            yield from drive_group_folded(
+                self, stream, self.superbatch,
+                controller=self._attach_control(self.superbatch),
+            )
             return
         for block in stream.blocks():
             yield self._one_window(block)
+
+    def _attach_control(self, k: int):
+        """The shared controller-attach rule (mirrors
+        ``SummaryAggregation._attach_control`` — this class declares
+        :class:`GroupFoldable` directly rather than through the
+        aggregation base): None unless auto; a pre-set plane is
+        honored; otherwise the stock default plane is built and kept
+        on ``self.control``."""
+        if not self.superbatch_auto:
+            return None
+        if self.control is None:
+            from ..control import default_plane
+
+            self.control = default_plane(k)
+        return self.control
 
     def _one_window(self, block) -> PageRankEmission:
         """The per-window fold (shared by the plain run loop and the

@@ -100,6 +100,15 @@ STORY = {
     # control.retune{knob,from,to,signal} event, so a knob move renders
     # in causal order next to the COMMIT/PROMOTE lines it reacted to
     "control.retune": "RETUNE",
+    # the transport-fabric story (ISSUE 16): every cross-process
+    # exchange (fabric.exchange{backend,tag}), every election proposal
+    # (fabric.elect{backend,tag,won}) and every cadence agreement the
+    # coordinated layer acts on (fabric.agree{backend,epoch,k}) renders
+    # labeled with its backend + tag, in causal order next to the
+    # COMMIT/SELECT/RETUNE lines it synchronizes
+    "fabric.exchange": "EXCHANGE",
+    "fabric.elect": "ELECT",
+    "fabric.agree": "AGREE",
     "flight": "BLACKBOX",
 }
 

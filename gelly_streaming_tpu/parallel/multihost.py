@@ -30,12 +30,12 @@ is host-count agnostic.
 
 from __future__ import annotations
 
-import os
-import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
+
+from ..fabric import CollectiveTransport, SharedDirTransport
 
 
 def initialize(
@@ -125,84 +125,14 @@ def globalize_stream(stream, mesh):
     )
 
 
-class JaxAllgatherTransport:
-    """The default exchange transport: ``multihost_utils.process_allgather``
-    over the live ``jax.distributed`` runtime. Tags are ignored — the
-    runtime's collective ordering IS the alignment."""
-
-    def allgather(self, tag: str, arr: np.ndarray) -> list:
-        from jax.experimental import multihost_utils
-
-        arr = np.asarray(arr)
-        out = np.asarray(multihost_utils.process_allgather(arr))
-        return list(out.reshape((-1,) + arr.shape))
-
-
-class FileExchangeTransport:
-    """Allgather over a shared directory — the exchange transport for
-    multi-process runs WITHOUT a ``jax.distributed`` runtime (the CPU
-    backend implements no cross-process collectives; the distributed
-    chaos sweep runs on exactly that).
-
-    Every rank atomically publishes its array under
-    ``<root>/<tag>.p<rank>.npy`` (temp + ``os.replace``) and polls for
-    the peers', returning the arrays in rank order. Two properties make
-    this the RECOVERY-SAFE transport the coordinated-barrier layer
-    needs:
-
-    - **Persistence**: exchange files are never deleted, so a process
-      replaying windows after a restore re-reads the proposals its
-      peers published BEFORE the failure — replay is deterministic and
-      the dictionaries stay byte-identical without peers re-running
-      their side of old exchanges.
-    - **Idempotent publication**: a rank whose file already exists
-      skips the write. Proposals are pure functions of the raw window
-      (first-occurrence raw ids), so a replayed publication would be
-      byte-identical anyway; skipping just keeps mtimes stable.
-
-    A peer that never publishes (killed worker) fails the exchange with
-    :class:`~gelly_streaming_tpu.resilience.errors.TransientSourceError`
-    after ``timeout_s`` — the supervisor classifies it transient and the
-    cluster layer restarts everyone from the agreed epoch.
-    """
-
-    def __init__(self, root: str, process_id: int, num_processes: int,
-                 *, timeout_s: float = 60.0, poll_s: float = 0.002):
-        os.makedirs(root, exist_ok=True)
-        self.root = root
-        self.process_id = int(process_id)
-        self.num_processes = int(num_processes)
-        self.timeout_s = float(timeout_s)
-        self.poll_s = float(poll_s)
-
-    def _path(self, tag: str, rank: int) -> str:
-        return os.path.join(self.root, f"{tag}.p{rank}.npy")
-
-    def allgather(self, tag: str, arr: np.ndarray) -> list:
-        own = self._path(tag, self.process_id)
-        if not os.path.exists(own):
-            tmp = own + f".tmp{os.getpid()}"
-            with open(tmp, "wb") as f:
-                np.save(f, np.asarray(arr))
-            os.replace(tmp, own)
-        from ..resilience.errors import TransientSourceError
-
-        deadline = time.monotonic() + self.timeout_s
-        out = []
-        for rank in range(self.num_processes):
-            path = self._path(tag, rank)
-            while True:
-                try:
-                    out.append(np.load(path))
-                    break
-                except (OSError, ValueError):
-                    if time.monotonic() >= deadline:
-                        raise TransientSourceError(
-                            f"exchange {tag!r}: rank {rank} never "
-                            f"published within {self.timeout_s}s"
-                        )
-                    time.sleep(self.poll_s)
-        return out
+# The exchange transports moved into the cluster fabric (ISSUE 16):
+# the collective allgather generalized into CollectiveTransport, the
+# shared-directory exchange into SharedDirTransport — both now full
+# Transport implementations (put/get/barrier/elect on top of the same
+# allgather this module always used, byte-identical file layout). The
+# historical names stay importable here as the ingest-facing aliases.
+JaxAllgatherTransport = CollectiveTransport
+FileExchangeTransport = SharedDirTransport
 
 
 def dict_exchange_encode(
@@ -223,17 +153,18 @@ def dict_exchange_encode(
     accepted for call-site symmetry with the pre-partition helpers; the
     exchange itself spans the global process set.
 
-    ``transport`` selects how the allgather runs:
-    :class:`JaxAllgatherTransport` (default — the live multi-controller
-    runtime) or :class:`FileExchangeTransport` (a shared directory; the
-    coordinated-recovery path, replay-deterministic). ``window`` is the
-    window ordinal used to tag file-transport exchanges; required there,
-    ignored by the jax transport.
+    ``transport`` selects how the allgather runs: any
+    :class:`~gelly_streaming_tpu.fabric.Transport` — the collective
+    backend by default (the live multi-controller runtime), a
+    shared-dir or socket transport for the coordinated-recovery path
+    (replay-deterministic). ``window`` is the window ordinal used to
+    tag persistent-transport exchanges; required there, ignored by the
+    collective transport.
     """
     from ..core.edgeblock import bucket_capacity
 
-    tr = transport if transport is not None else JaxAllgatherTransport()
-    if window is None and not isinstance(tr, JaxAllgatherTransport):
+    tr = transport if transport is not None else CollectiveTransport()
+    if window is None and getattr(tr, "persistent", True):
         # a persisted transport keys the exchange on the tag; with a
         # constant tag its idempotent-skip path would silently re-read
         # the FIRST window's proposals for every later window and the

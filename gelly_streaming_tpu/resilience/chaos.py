@@ -223,8 +223,18 @@ def mp_worker_main(cfg: dict) -> None:
     ``os._exit``; fires only when ``process == victim``), plus the
     sweep geometry. Each process windows its interleaved shard of the
     global corpus (edge ``i`` belongs to process ``i % N`` — the
-    pre-partition keyBy analog), agrees on raw->compact ids through the
-    persisted file exchange, and commits coordinated epoch barriers."""
+    pre-partition keyBy analog), agrees on raw->compact ids through a
+    persisted exchange transport, and commits coordinated epoch
+    barriers.
+
+    ``transport`` selects the exchange backend: ``"shared_dir"``
+    (default — files under ``root/exchange``) or ``"socket"`` (GSRP
+    frames against the driver's exchange daemon at
+    ``exchange_addr``). Epoch barriers stay on the shared directory in
+    BOTH modes: the daemon's store is in-memory, and barrier restore
+    must survive the daemon host too — the sweep exercises the socket
+    path where it is honest to (the per-window id exchange, whose
+    replay-safety window is one cluster incarnation)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -250,10 +260,18 @@ def mp_worker_main(cfg: dict) -> None:
     lw = we // nprocs  # local (per-shard) window size
     raw = corpus(cfg["seed"], windows * we)
     mine = raw[pid::nprocs]
-    fx = FileExchangeTransport(
-        os.path.join(cfg["root"], "exchange"), pid, nprocs,
-        timeout_s=float(cfg.get("exchange_timeout_s", 60.0)),
-    )
+    if cfg.get("transport") == "socket":
+        from ..fabric import SocketTransport
+
+        fx = SocketTransport(
+            str(cfg["exchange_addr"]), pid, nprocs,
+            timeout_s=float(cfg.get("exchange_timeout_s", 60.0)),
+        )
+    else:
+        fx = FileExchangeTransport(
+            os.path.join(cfg["root"], "exchange"), pid, nprocs,
+            timeout_s=float(cfg.get("exchange_timeout_s", 60.0)),
+        )
     sink = _worker_obs(cfg, shard=pid)
     seen_vd = {}  # the live stream's vertex dict (for the final CRC)
 
@@ -2049,6 +2067,7 @@ def run_mp_sweep(
     superbatch: int = MP_DEFAULTS["superbatch"],
     every: int = MP_DEFAULTS["every"],
     seed: int = MP_DEFAULTS["seed"],
+    transport: str = "shared_dir",
     corrupt: bool = True,
     failover: bool = True,
     rpc: bool = True,
@@ -2057,6 +2076,17 @@ def run_mp_sweep(
     log: Optional[Callable[[str], None]] = None,
 ) -> dict:
     """Distributed kill sweep over an N-process coordinated cluster.
+
+    ``transport`` selects the per-window dict-exchange backend the
+    workers ride: ``"shared_dir"`` (files under each point's
+    ``exchange/``) or ``"socket"`` (the driver runs one
+    :class:`~gelly_streaming_tpu.fabric.exchange.ExchangeDaemon` per
+    point; workers speak GSRP frames to it, and the daemon — owned by
+    the never-killed driver — carries exchange tags across worker kills
+    and relaunches). Epoch barriers and rendezvous stay on the shared
+    directory in both modes: the daemon's store is in-memory, so it is
+    the honest home only for state whose replay window is one cluster
+    incarnation.
 
     For every window ordinal ``k``, worker ``k % N`` dies hard after
     ``k`` windows; the :class:`ClusterSupervisor` terminates the rest
@@ -2101,17 +2131,34 @@ def run_mp_sweep(
         # probes); ship it as its own shard at the end
         drv_sink = ShardSink(os.path.join(root, "driver-events.jsonl"))
         get_registry().add_sink(drv_sink)
+    daemons = {}  # point dir -> ExchangeDaemon (socket mode only)
     try:
         geometry = dict(
             processes=processes, windows=windows, window_edges=window_edges,
             superbatch=superbatch, every=every, seed=seed,
+            transport=transport,
         )
+
+        def start_daemon(d: str) -> None:
+            if transport != "socket":
+                return
+            from ..fabric import ExchangeDaemon
+
+            daemons[d] = ExchangeDaemon().start()
+
+        def stop_daemon(d: str) -> None:
+            dm = daemons.pop(d, None)
+            if dm is not None:
+                dm.stop()
 
         def cfg_for(d: str, pid: int, kill_after: int, victim: int,
                     attempt: int = 0) -> dict:
             return dict(
                 geometry,
                 root=d,
+                exchange_addr=(
+                    daemons[d].address if d in daemons else None
+                ),
                 process=pid,
                 victim=victim,
                 kill_after=kill_after,
@@ -2175,12 +2222,16 @@ def run_mp_sweep(
         say(f"chaos-mp: oracle cluster ({processes} procs x {windows} "
             f"windows x {window_edges} edges, superbatch={superbatch}, "
             f"every={every})...")
+        start_daemon(oracle_dir)
         cs = ClusterSupervisor(
             spawner(oracle_dir, victim=-1, kill_after=-1), processes,
             restart_codes=(KILL_RC,), backoff_base_s=0.0,
             flight_dir=oracle_dir,
         )
-        cs.run()
+        try:
+            cs.run()
+        finally:
+            stop_daemon(oracle_dir)
         oracle, oracle_metas, dupes = read_point(oracle_dir)
         want_keys = {
             (pid, o) for pid in range(processes) for o in range(windows)
@@ -2235,6 +2286,7 @@ def run_mp_sweep(
                     corrupt_file(shard, "flip", seed=seed + _k)
                     _ce["epoch"] = epoch
 
+            start_daemon(d)
             cs = ClusterSupervisor(
                 spawner(d, victim=victim, kill_after=k), processes,
                 restart_codes=(KILL_RC,), backoff_base_s=0.0,
@@ -2263,6 +2315,8 @@ def run_mp_sweep(
                 say(f"chaos-mp: kill@{k} victim=p{victim} -> "
                     f"UNRECOVERED: {type(e).__name__}")
                 continue
+            finally:
+                stop_daemon(d)
             resume_s = time.perf_counter() - t0
             lines, metas, dupes = read_point(d)
             bad = [
@@ -2470,6 +2524,8 @@ def run_mp_sweep(
             drv_sink.close()
         if obs_f is not None:
             obs_f.close()
+        for dm in daemons.values():  # an abort mid-point leaves one
+            dm.stop()
 
 
 if __name__ == "__main__":

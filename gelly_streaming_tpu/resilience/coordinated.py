@@ -7,8 +7,13 @@ at the same stream position, each subtask snapshots its shard, and the
 checkpoint coordinator declares the checkpoint complete only once EVERY
 subtask has acknowledged — restore then uses exactly one complete
 checkpoint, never a mix. This module is that protocol for the repo's
-multi-controller SPMD layout (``parallel/multihost.py``), built on a
-shared directory instead of an RPC coordinator:
+multi-controller SPMD layout (``parallel/multihost.py``), built on the
+cluster transport fabric (``gelly_streaming_tpu/fabric``) instead of an
+RPC coordinator — every epoch artifact moves through a
+:class:`~gelly_streaming_tpu.fabric.Transport` (a bare directory path
+coerces to the shared-dir backend, byte-identical to the historical
+layout; a socket transport points the same protocol at the exchange
+daemon):
 
 - :class:`CoordinatedCheckpoint` (an
   :class:`~gelly_streaming_tpu.aggregate.autockpt.AutoCheckpoint`
@@ -57,54 +62,56 @@ import zlib
 from typing import Callable, List, Optional, Tuple
 
 from ..aggregate.autockpt import AutoCheckpoint
+from ..fabric import as_transport
 from ..obs.registry import get_registry
 from . import integrity as _integrity
 from .errors import RestartBudgetExceeded
 from .retry import exp_backoff, jittered
 
-#: shard barrier / rendezvous file name shapes
+#: shard barrier / rendezvous tag name shapes
 _SHARD_RE = re.compile(r"^e(\d{8})\.p(\d+)\.json$")
 
 
-def _shard_base(directory: str, epoch: int, pid: int) -> str:
-    return os.path.join(directory, f"e{epoch:08d}.p{pid}")
+def _shard_tag(epoch: int, pid: int) -> str:
+    return f"e{epoch:08d}.p{pid}"
 
 
-def list_epochs(directory: str) -> List[int]:
-    """Every epoch ordinal with at least one rendezvous record on disk,
-    ascending."""
-    try:
-        names = os.listdir(directory)
-    except FileNotFoundError:
-        return []
+def list_epochs(target) -> List[int]:
+    """Every epoch ordinal with at least one rendezvous record in the
+    store, ascending. ``target`` is a
+    :class:`~gelly_streaming_tpu.fabric.Transport` or a shared
+    directory path."""
+    names = as_transport(target).list()
     return sorted({
         int(m.group(1)) for m in map(_SHARD_RE.match, names) if m
     })
 
 
-def read_rendezvous(directory: str, epoch: int, pid: int) -> Optional[dict]:
+def read_rendezvous(target, epoch: int, pid: int) -> Optional[dict]:
     """One shard's rendezvous record for ``epoch`` (None when missing or
     unreadable — the caller treats both as an incomplete epoch)."""
+    data = as_transport(target).get(_shard_tag(epoch, pid) + ".json")
+    if data is None:
+        return None
     try:
-        with open(_shard_base(directory, epoch, pid) + ".json") as f:
-            return json.load(f)
-    except (OSError, ValueError):
+        return json.loads(data.decode())
+    except (ValueError, UnicodeDecodeError):
         return None
 
 
-def _shard_valid(directory: str, epoch: int, pid: int,
+def _shard_valid(target, epoch: int, pid: int,
                  rec: dict, num_processes: int,
                  cache: Optional[dict] = None) -> Tuple[bool, str]:
     """Validate one shard's artifact against its rendezvous record:
-    geometry (nprocs, epoch == windows_done), file presence, size, and
-    container CRC. Returns (ok, reason).
+    geometry (nprocs, epoch == windows_done), artifact presence, size,
+    and container CRC. Returns (ok, reason).
 
-    ``cache`` (keyed by path + stat identity + the record's promised
+    ``cache`` (keyed by locator + store version + the record's promised
     crc/size) memoizes the full-content CRC pass: barriers are
-    write-once, so an unchanged file version keeps its verdict and the
-    per-commit GC / per-restore selection scans do NOT re-read every
-    container on disk — the same no-re-read discipline the PR-4
-    hardening applied to the barrier span."""
+    write-once, so an unchanged artifact version keeps its verdict and
+    the per-commit GC / per-restore selection scans do NOT re-read
+    every container in the store — the same no-re-read discipline the
+    PR-4 hardening applied to the barrier span."""
     if rec.get("nprocs") != num_processes:
         return False, (
             f"rendezvous nprocs={rec.get('nprocs')} != {num_processes}"
@@ -118,28 +125,26 @@ def _shard_valid(directory: str, epoch: int, pid: int,
             f"rendezvous ordinal {rec.get('windows_done')} disagrees "
             f"with epoch {epoch}"
         )
-    path = _shard_base(directory, epoch, pid) + ".ckpt"
-    try:
-        st = os.stat(path)
-    except OSError as e:
-        return False, f"shard file unreadable: {e!r}"
-    if st.st_size != rec.get("size"):
+    tr = as_transport(target)
+    tag = _shard_tag(epoch, pid) + ".ckpt"
+    st = tr.stat(tag)
+    if st is None:
+        return False, "shard artifact unreadable: missing"
+    if st.size != rec.get("size"):
         return False, (
-            f"shard file is {st.st_size} bytes, record promised "
+            f"shard artifact is {st.size} bytes, record promised "
             f"{rec.get('size')}"
         )
-    key = (path, st.st_mtime_ns, st.st_size,
+    key = (tr.describe(tag), st.version, st.size,
            rec.get("crc"), rec.get("size"))
     if cache is not None and key in cache:
         return cache[key]
-    try:
-        with open(path, "rb") as f:
-            data = f.read()
-    except OSError as e:
-        return False, f"shard file unreadable: {e!r}"
+    data = tr.get(tag)
+    if data is None:
+        return False, "shard artifact unreadable: missing"
     if len(data) != rec.get("size"):
         return False, (
-            f"shard file is {len(data)} bytes, record promised "
+            f"shard artifact is {len(data)} bytes, record promised "
             f"{rec.get('size')}"
         )
     if (zlib.crc32(data) & 0xFFFFFFFF) != rec.get("crc"):
@@ -152,7 +157,7 @@ def _shard_valid(directory: str, epoch: int, pid: int,
 
 
 def select_epoch(
-    directory: str,
+    target,
     num_processes: int,
     *,
     max_epoch: Optional[int] = None,
@@ -171,28 +176,29 @@ def select_epoch(
     None when no complete epoch exists (restart from scratch; correct
     under the at-least-once emission contract).
 
-    The scan is a pure function of the directory contents, so every
+    The scan is a pure function of the store contents, so every
     restarting process computes the same answer with no coordinator.
     ``record=True`` mirrors each skip into the obs registry
     (``resilience.epoch_incomplete`` / ``resilience.epoch_torn``) and
     counts a ``resilience.epoch_fallbacks`` when the selected epoch is
-    not the newest on disk.
+    not the newest in the store.
     """
     reg = get_registry()
+    tr = as_transport(target)
     epochs = [
-        e for e in reversed(list_epochs(directory))
+        e for e in reversed(list_epochs(tr))
         if max_epoch is None or e <= max_epoch
     ]
     for i, epoch in enumerate(epochs):
         missing = []
         torn = []
         for pid in range(num_processes):
-            rec = read_rendezvous(directory, epoch, pid)
+            rec = read_rendezvous(tr, epoch, pid)
             if rec is None:
                 missing.append(pid)
                 continue
             ok, reason = _shard_valid(
-                directory, epoch, pid, rec, num_processes, cache=cache
+                tr, epoch, pid, rec, num_processes, cache=cache
             )
             if not ok:
                 torn.append((pid, reason))
@@ -207,7 +213,7 @@ def select_epoch(
                 reg.counter("resilience.epoch_torn").inc()
                 for pid, reason in torn:
                     _integrity.record_rejection(
-                        _shard_base(directory, epoch, pid) + ".ckpt",
+                        tr.describe(_shard_tag(epoch, pid) + ".ckpt"),
                         f"epoch {epoch}: {reason}",
                     )
             else:
@@ -229,9 +235,17 @@ class CoordinatedCheckpoint(AutoCheckpoint):
     of it.
 
     ``keep`` bounds how many of this process's own committed epochs stay
-    on disk (each process garbage-collects only its own shard files, so
-    a slow peer can never have an epoch deleted out from under it by a
-    fast one before the fast one has committed ``keep`` newer epochs).
+    in the store (each process garbage-collects only its own shard
+    artifacts, so a slow peer can never have an epoch deleted out from
+    under it by a fast one before the fast one has committed ``keep``
+    newer epochs).
+
+    ``transport`` selects the store the epoch artifacts move through —
+    any store-backed :class:`~gelly_streaming_tpu.fabric.Transport`
+    (None keeps the historical behavior: the shared-dir backend over
+    ``directory``, byte-identical layout). ``directory`` stays required
+    either way: the inherited single-process machinery keeps its local
+    scratch path there.
     """
 
     def __init__(
@@ -242,6 +256,7 @@ class CoordinatedCheckpoint(AutoCheckpoint):
         num_processes: int,
         every=8,
         keep: int = 3,
+        transport=None,
     ):
         if every == "auto":
             # the whole rendezvous protocol rests on every process
@@ -264,6 +279,15 @@ class CoordinatedCheckpoint(AutoCheckpoint):
             raise ValueError(
                 f"process_id {process_id} outside 0..{num_processes - 1}"
             )
+        #: the one cluster-exchange handle every epoch artifact moves
+        #: through — rendezvous records, shard containers, GC, and the
+        #: cadence elections (no code below this seam touches the
+        #: shared directory directly)
+        self.transport = as_transport(
+            directory if transport is None else transport,
+            process_id=self.process_id,
+            num_processes=self.num_processes,
+        )
         #: the epoch the last load selected (None before any load / when
         #: no complete epoch exists) — the number every process agrees on
         self.epoch: Optional[int] = None
@@ -277,39 +301,65 @@ class CoordinatedCheckpoint(AutoCheckpoint):
         )
 
     def run(self, make_stream, work):
-        """Same rejection as ``every="auto"``, one layer down: a
-        ``superbatch="auto"`` workload re-tiles its groups from each
-        host's OWN timing noise, so barrier-eligible window ordinals
-        would diverge across processes and no epoch would ever complete
-        — pin a fixed superbatch for coordinated runs (tune it
-        single-host first and configure the learned K everywhere)."""
+        """``superbatch="auto"`` historically raised here: each process
+        learning its own K re-tiles its groups from its host's OWN
+        timing noise, barrier-eligible window ordinals diverge, and no
+        epoch ever completes. The transport's agreement primitive
+        dissolves the conflict — the workload's controller is wrapped
+        in :class:`~gelly_streaming_tpu.fabric.ElectedK`, which elects
+        ONE process's learned K per epoch through
+        :meth:`~gelly_streaming_tpu.fabric.Transport.elect`, so every
+        process tiles with the same agreed K and the barriers align by
+        construction (see ``fabric/agreement.py`` for why the election
+        runs on the packer's call schedule, not the commit clock)."""
         if getattr(work, "superbatch_auto", False):
-            raise ValueError(
-                'superbatch="auto" cannot run under coordinated '
-                "barriers: each process would learn its own K and the "
-                "group-aligned barrier ordinals would never agree. Run "
-                "the controller single-host, read the tuned K, and "
-                "configure that fixed superbatch on every process."
-            )
+            self._wire_cadence_agreement(work)
         return super().run(make_stream, work)
+
+    def _wire_cadence_agreement(self, work) -> None:
+        """Wrap the workload's local K learner in the agreed-K adapter,
+        anchored at THIS attempt's restore epoch. Re-wiring happens on
+        every ``run()`` call: a supervisor restart restores from a new
+        epoch, and the adapter's election schedule must restart from
+        that ordinal (its tags are absolute, so it re-reads the winners
+        the pre-failure run persisted)."""
+        from ..fabric import ElectedK
+
+        plane = getattr(work, "control", None)
+        if plane is None:
+            from ..control import default_plane
+
+            plane = default_plane(1)
+            work.control = plane
+        inner = getattr(plane, "autok", None)
+        if inner is None:
+            inner = plane  # a bare controller standing in for the plane
+        if isinstance(inner, ElectedK):
+            inner = inner.inner  # re-anchor, never stack wrappers
+        elected = ElectedK(
+            inner, self.transport, every=self.every,
+            done=self.windows_done(),
+        )
+        if getattr(plane, "autok", None) is not None:
+            plane.autok = elected
+        else:
+            work.control = elected
 
     # -- commit side ---------------------------------------------------- #
     def _commit(self, payload: dict) -> str:
         """Commit this shard's barrier for epoch ``windows_done``: the
-        CRC-framed container lands first (temp + replace), then the
-        rendezvous record naming it — the record is the shard's commit
-        point, so a kill between the two writes leaves an invisible
-        container, never a record pointing at nothing. Peers are not
-        consulted: epoch completeness is decided at restore time."""
+        CRC-framed container lands first (an atomic transport put),
+        then the rendezvous record naming it — the record is the
+        shard's commit point, so a kill between the two puts leaves an
+        invisible container, never a record pointing at nothing. Peers
+        are not consulted: epoch completeness is decided at restore
+        time."""
         import pickle
 
         epoch = payload["windows_done"]
-        base = _shard_base(self.dir, epoch, self.process_id)
+        tag = _shard_tag(epoch, self.process_id)
         data = _integrity.wrap_checksummed(pickle.dumps(payload))
-        tmp = base + ".ckpt.tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, base + ".ckpt")
+        self.transport.put(tag + ".ckpt", data, overwrite=True)
         rec = {
             "epoch": epoch,
             "windows_done": epoch,
@@ -318,13 +368,12 @@ class CoordinatedCheckpoint(AutoCheckpoint):
             "crc": zlib.crc32(data) & 0xFFFFFFFF,
             "size": len(data),
         }
-        tmp = base + ".json.tmp"
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        _integrity.replace_atomic(tmp, base + ".json")  # shard commit
+        self.transport.put(  # shard commit point
+            tag + ".json", json.dumps(rec).encode(), overwrite=True
+        )
         get_registry().counter("resilience.coord_commits").inc()
         self._gc(epoch)
-        return base + ".ckpt"
+        return self.transport.describe(tag + ".ckpt")
 
     def _gc(self, committed_epoch: int) -> None:
         """Drop this process's shard files for epochs older than the
@@ -347,44 +396,40 @@ class CoordinatedCheckpoint(AutoCheckpoint):
 
         def _restorable(e: int) -> bool:
             for pid in range(self.num_processes):
-                rec = read_rendezvous(self.dir, e, pid)
+                rec = read_rendezvous(self.transport, e, pid)
                 if rec is None:
                     return False
                 ok, _ = _shard_valid(
-                    self.dir, e, pid, rec, self.num_processes,
+                    self.transport, e, pid, rec, self.num_processes,
                     cache=self._valid_cache,
                 )
                 if not ok:
                     return False
             return True
 
-        complete = [e for e in list_epochs(self.dir) if _restorable(e)]
+        complete = [
+            e for e in list_epochs(self.transport) if _restorable(e)
+        ]
         if len(complete) < self.keep:
             return
         floor = complete[-self.keep]
-        for e in list_epochs(self.dir):
+        for e in list_epochs(self.transport):
             if e >= floor:
                 continue
-            base = _shard_base(self.dir, e, self.process_id)
+            tag = _shard_tag(e, self.process_id)
             for suffix in (".json", ".ckpt"):
-                try:
-                    os.remove(base + suffix)
-                except OSError:
-                    pass
+                self.transport.delete(tag + suffix)
 
     def discard(self) -> None:
         """Fresh start for THIS PROCESS's shard: remove its epoch
         barriers and rendezvous records (plus the inherited
         single-process path artifacts) and drop the caches. Peers'
         shards are never touched — each process owns only its own
-        files, the same ownership rule :meth:`_gc` follows."""
-        for e in list_epochs(self.dir):
-            base = _shard_base(self.dir, e, self.process_id)
+        artifacts, the same ownership rule :meth:`_gc` follows."""
+        for e in list_epochs(self.transport):
+            tag = _shard_tag(e, self.process_id)
             for suffix in (".json", ".ckpt"):
-                try:
-                    os.remove(base + suffix)
-                except OSError:
-                    pass
+                self.transport.delete(tag + suffix)
         self._valid_cache.clear()
         super().discard()
 
@@ -406,7 +451,7 @@ class CoordinatedCheckpoint(AutoCheckpoint):
         ceiling: Optional[int] = None
         while True:
             epoch = select_epoch(
-                self.dir, self.num_processes, max_epoch=ceiling,
+                self.transport, self.num_processes, max_epoch=ceiling,
                 cache=self._valid_cache,
             )
             self.epoch = epoch
@@ -414,9 +459,14 @@ class CoordinatedCheckpoint(AutoCheckpoint):
                 self._cache = None
                 self._cache_valid = True
                 return None
-            payload = self._read_barrier(
-                _shard_base(self.dir, epoch, self.process_id) + ".ckpt"
-            )
+            tag = _shard_tag(epoch, self.process_id) + ".ckpt"
+            payload = None
+            data = self.transport.get(tag)
+            if data is not None:
+                st = self.transport.stat(tag)
+                origin = self.transport.describe(tag)
+                key = (origin, st.version if st else 0, len(data))
+                payload = self._barrier_payload(data, origin, key)
             if payload is not None:
                 self._cache = payload
                 self._cache_valid = True

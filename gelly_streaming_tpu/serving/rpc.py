@@ -59,7 +59,6 @@ from __future__ import annotations
 import json
 import os
 import socket as _socket
-import struct
 import threading
 import time
 from collections import OrderedDict
@@ -87,19 +86,24 @@ from .snapshot_store import (
 )
 
 # --------------------------------------------------------------------- #
-# Wire format
+# Wire format — the GSRP framing moved into the cluster fabric
+# (fabric/wire.py, ISSUE 16) so the exchange daemon speaks the same
+# frames; re-exported here because every RPC consumer (client, router,
+# ingest, the fuzz tests) imports it from this module.
 # --------------------------------------------------------------------- #
-#: frame magic (also the protocol's garbage detector)
-MAGIC = b"GSRP"
-VERSION = 1
-#: header: magic | version | frame type | payload length
-HEADER = struct.Struct("<4sBBI")
-#: reject frames past this length before reading them (an attacker's —
-#: or a corrupted peer's — length field must not allocate unboundedly)
-DEFAULT_MAX_FRAME = 8 << 20
-
-T_REQ = 1   # client -> server: one query batch
-T_RESP = 2  # server -> client: one batch's outcome
+from ..fabric.wire import (  # noqa: E402  (re-export)
+    DEFAULT_MAX_FRAME,
+    HEADER,
+    MAGIC,
+    T_REQ,
+    T_RESP,
+    VERSION,
+    Disconnect,
+    MalformedFrame,
+    pack_frame,
+    read_frame,
+    recv_exact,
+)
 
 # batch-level wire statuses
 OK = "ok"
@@ -111,69 +115,6 @@ ERROR = "error"                # terminal: server-side failure
 
 #: statuses a client may retry (everything else is terminal)
 RETRYABLE = frozenset({OVERLOADED, NOT_PRIMARY})
-
-
-class Disconnect(Exception):
-    """Peer closed at a frame boundary — the clean end of a connection."""
-
-
-class MalformedFrame(ValueError):
-    """The byte stream violated the frame contract; ``kind`` is the
-    ``rpc.malformed{kind=...}`` label (magic/version/oversized/
-    truncated/json/request)."""
-
-    def __init__(self, kind: str, msg: str):
-        super().__init__(msg)
-        self.kind = kind
-
-
-def pack_frame(ftype: int, payload: bytes) -> bytes:
-    return HEADER.pack(MAGIC, VERSION, ftype, len(payload)) + payload
-
-
-def recv_exact(sock, n: int, *, at_boundary: bool = False) -> bytes:
-    """Read exactly ``n`` bytes. EOF (or a reset) before the FIRST byte
-    of a frame is a clean :class:`Disconnect`; EOF mid-frame is a
-    :class:`MalformedFrame` (``truncated``) — the distinction the fuzz
-    tests pin."""
-    buf = b""
-    while len(buf) < n:
-        try:
-            chunk = sock.recv(n - len(buf))
-        except OSError as e:
-            if at_boundary and not buf:
-                raise Disconnect(repr(e)) from e
-            raise MalformedFrame(
-                "truncated",
-                f"connection lost after {len(buf)}/{n} bytes: {e!r}",
-            ) from e
-        if not chunk:
-            if at_boundary and not buf:
-                raise Disconnect("peer closed")
-            raise MalformedFrame(
-                "truncated", f"peer closed after {len(buf)}/{n} bytes"
-            )
-        buf += chunk
-    return buf
-
-
-def read_frame(sock, *, max_frame: int = DEFAULT_MAX_FRAME
-               ) -> Tuple[int, bytes]:
-    """One complete frame off the socket; raises :class:`Disconnect` at
-    a clean boundary, :class:`MalformedFrame` for everything the frame
-    contract rejects."""
-    head = recv_exact(sock, HEADER.size, at_boundary=True)
-    magic, version, ftype, length = HEADER.unpack(head)
-    if magic != MAGIC:
-        raise MalformedFrame("magic", f"bad magic {magic!r}")
-    if version != VERSION:
-        raise MalformedFrame("version", f"unsupported version {version}")
-    if length > max_frame:
-        raise MalformedFrame(
-            "oversized", f"frame of {length} bytes exceeds {max_frame}"
-        )
-    payload = recv_exact(sock, length) if length else b""
-    return ftype, payload
 
 
 class Wire:
@@ -716,37 +657,41 @@ class HeartbeatLease:
     serving directory.
 
     The primary commits ``{role, pid, port, ts, lease_s}`` every
-    ``beat_s`` with the checkpoint commit discipline (CRC-framed
-    container, temp-and-replace via :mod:`~gelly_streaming_tpu.resilience.integrity`)
-    so a reader NEVER sees a torn record — it sees the previous beat or
-    the new one. The standby promotes when the newest record's age
-    exceeds its own declared ``lease_s``: a dead primary stops beating,
-    a live one cannot lapse (``beat_s`` defaults to ``lease_s / 5``).
+    ``beat_s`` with the checkpoint commit discipline (the transport's
+    CRC-framed atomic put) so a reader NEVER sees a torn record — it
+    sees the previous beat or the new one. The standby promotes when
+    the newest record's age exceeds its own declared ``lease_s``: a
+    dead primary stops beating, a live one cannot lapse (``beat_s``
+    defaults to ``lease_s / 5``).
+
+    ``dirpath`` is any store-backed cluster
+    :class:`~gelly_streaming_tpu.fabric.Transport` (a bare path keeps
+    the historical shared-directory record, byte-identical).
     """
 
     def __init__(
         self,
-        dirpath: str,
+        dirpath,
         *,
         lease_s: float = 0.5,
         beat_s: Optional[float] = None,
         role: str = "primary",
         port: Optional[int] = None,
     ):
+        from ..fabric import as_transport
+
         self.dirpath = dirpath
+        self.transport = as_transport(dirpath)
         self.lease_s = float(lease_s)
         self.beat_s = float(beat_s) if beat_s is not None \
             else self.lease_s / 5.0
         self.role = role
         self.port = port
-        self.path = os.path.join(dirpath, HEARTBEAT_NAME)
+        self.path = self.transport.describe(HEARTBEAT_NAME)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        os.makedirs(dirpath, exist_ok=True)
 
     def write(self) -> None:
-        from ..resilience import integrity
-
         doc = {
             "role": self.role,
             "pid": os.getpid(),
@@ -754,11 +699,10 @@ class HeartbeatLease:
             "ts": time.time(),
             "lease_s": self.lease_s,
         }
-        data = integrity.wrap_checksummed(json.dumps(doc).encode("utf-8"))
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        integrity.replace_atomic(tmp, self.path)
+        self.transport.put_framed(
+            HEARTBEAT_NAME, json.dumps(doc).encode("utf-8"),
+            overwrite=True,
+        )
 
     def start(self) -> "HeartbeatLease":
         self.write()
@@ -788,31 +732,28 @@ class HeartbeatLease:
 
     # -- reader side ---------------------------------------------------- #
     @staticmethod
-    def read(dirpath: str) -> Optional[dict]:
+    def read(dirpath) -> Optional[dict]:
         """The newest committed heartbeat record, or None when absent
         or invalid (an invalid record is rejected VISIBLY and treated
-        as absent — rename atomicity makes it near-impossible, so it is
+        as absent — put atomicity makes it near-impossible, so it is
         evidence of external damage, not a normal state)."""
+        from ..fabric import as_transport
         from ..resilience import integrity
-        from ..resilience.errors import CheckpointCorrupt
 
-        path = os.path.join(dirpath, HEARTBEAT_NAME)
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-            return json.loads(
-                integrity.unwrap_checksummed(
-                    data, origin=f"heartbeat {path}"
-                )
-            )
-        except FileNotFoundError:
+        tr = as_transport(dirpath)
+        data = tr.get_framed(HEARTBEAT_NAME)
+        if data is None:
             return None
-        except (CheckpointCorrupt, OSError, ValueError) as e:
-            integrity.record_rejection(path, repr(e))
+        try:
+            return json.loads(data)
+        except ValueError as e:
+            integrity.record_rejection(
+                tr.describe(HEARTBEAT_NAME), repr(e)
+            )
             return None
 
     @staticmethod
-    def age_s(dirpath: str) -> Optional[Tuple[float, float]]:
+    def age_s(dirpath) -> Optional[Tuple[float, float]]:
         """(age, declared lease) of the newest heartbeat, or None when
         no valid record exists yet."""
         doc = HeartbeatLease.read(dirpath)

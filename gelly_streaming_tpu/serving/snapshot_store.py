@@ -208,33 +208,39 @@ class SnapshotStore:
 # Cross-process half: the shared snapshot directory
 # --------------------------------------------------------------------- #
 # A standby serving BINARY cannot share an in-memory store with its
-# primary; what it can share is a directory. The mirror persists each
-# published snapshot with the checkpoint commit discipline
-# (``resilience/integrity.py``: CRC-framed container, temp-and-replace —
+# primary; what it can share is a cluster store — a shared directory
+# (the historical shape) or the exchange daemon, either way reached
+# through a :class:`~gelly_streaming_tpu.fabric.Transport`. The mirror
+# persists each published snapshot with the checkpoint commit
+# discipline (the transport's atomic put of a CRC-framed container —
 # a kill at any byte leaves the previous snapshot fully loadable), and
-# the follower turns that directory back into a ``(payload, watermark)``
+# the follower turns that store back into a ``(payload, watermark)``
 # emission iterator a standby ``StreamServer`` ingests like any other
-# servable. Torn or bit-rotted files are REJECTED (counted, warned) and
-# the follower falls back to the newest older snapshot — the standby
-# never serves a half-written table.
+# servable. Torn or bit-rotted artifacts are REJECTED (counted,
+# warned) and the follower falls back to the newest older snapshot —
+# the standby never serves a half-written table.
 
-#: snapshot file name prefix in a shared serving directory
+#: snapshot tag prefix in a shared serving store
 SNAP_PREFIX = "snap.v"
 
 
+def _snap_tag(version: int) -> str:
+    return f"{SNAP_PREFIX}{version:010d}.bin"
+
+
 def _snap_path(dirpath: str, version: int) -> str:
-    return os.path.join(dirpath, f"{SNAP_PREFIX}{version:010d}.bin")
+    """The shared-dir backend's on-disk name for a snapshot version —
+    kept for the recovery tests that corrupt artifacts in place."""
+    return os.path.join(dirpath, _snap_tag(version))
 
 
-def _snap_versions(dirpath: str) -> list:
-    """Committed snapshot versions under ``dirpath``, newest first."""
-    try:
-        names = os.listdir(dirpath)
-    except OSError:
-        return []
+def _snap_versions(target) -> list:
+    """Committed snapshot versions in the store, newest first."""
+    from ..fabric import as_transport
+
     out = []
-    for n in names:
-        if n.startswith(SNAP_PREFIX) and n.endswith(".bin"):
+    for n in as_transport(target).list(SNAP_PREFIX):
+        if n.endswith(".bin"):
             try:
                 out.append(int(n[len(SNAP_PREFIX):-len(".bin")]))
             except ValueError:
@@ -258,14 +264,20 @@ class SnapshotMirror:
     numpy at write time; a payload that cannot be pickled (an exotic
     vertex dict holding native state) cannot be disk-mirrored and
     should publish a host-shaped payload instead.
+
+    ``dirpath`` is any store-backed cluster
+    :class:`~gelly_streaming_tpu.fabric.Transport`; a bare path keeps
+    the historical shared-directory layout byte-identical.
     """
 
-    def __init__(self, dirpath: str, *, keep: int = 2, every: int = 1):
+    def __init__(self, dirpath, *, keep: int = 2, every: int = 1):
+        from ..fabric import as_transport
+
         self.dirpath = dirpath
+        self.transport = as_transport(dirpath)
         self.keep = max(1, int(keep))
         self.every = max(1, int(every))
         self._written = -1  # newest version committed by THIS mirror
-        os.makedirs(dirpath, exist_ok=True)
 
     def __call__(self, snap: PublishedSnapshot) -> None:
         if snap.version % self.every == 0:
@@ -298,59 +310,57 @@ class SnapshotMirror:
             "payload": payload,
         }
         data = integrity.wrap_checksummed(pickle.dumps(doc, protocol=4))
-        path = _snap_path(self.dirpath, snap.version)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        integrity.replace_atomic(tmp, path)
+        tag = _snap_tag(snap.version)
+        self.transport.put(tag, data, overwrite=True)
         if snap.version > self._written:
             self._written = snap.version
         self._prune()
-        return path
+        return self.transport.describe(tag)
 
     def _prune(self) -> None:
-        for v in _snap_versions(self.dirpath)[self.keep:]:
-            try:
-                os.unlink(_snap_path(self.dirpath, v))
-            except OSError:
-                # a standby may have the file open mid-read; the next
-                # prune sweeps it — visible, not fatal
+        for v in _snap_versions(self.transport)[self.keep:]:
+            if not self.transport.delete(_snap_tag(v)):
+                # already gone (swept by an earlier prune's race) — the
+                # store converges either way; visible, not fatal
                 get_registry().counter(
                     "serving.swallowed", site="snapshot_prune"
                 ).inc()
 
 
 def load_newest_snapshot(
-    dirpath: str, *, newer_than: int = -1
+    dirpath, *, newer_than: int = -1
 ) -> Optional[dict]:
-    """The newest COMMITTED-AND-VALID snapshot doc in ``dirpath`` with
-    ``version > newer_than`` (or None). Torn/corrupt files are rejected
-    through :func:`~gelly_streaming_tpu.resilience.integrity.record_rejection`
+    """The newest COMMITTED-AND-VALID snapshot doc in the store with
+    ``version > newer_than`` (or None). Torn/corrupt artifacts are
+    rejected through
+    :func:`~gelly_streaming_tpu.resilience.integrity.record_rejection`
     and the scan falls back to the next older one — the same
     newest-first-with-fallback discipline as barrier restore."""
+    from ..fabric import as_transport
     from ..resilience import integrity
     from ..resilience.errors import CheckpointCorrupt
 
-    for v in _snap_versions(dirpath):
+    tr = as_transport(dirpath)
+    for v in _snap_versions(tr):
         if v <= newer_than:
             return None
-        path = _snap_path(dirpath, v)
+        tag = _snap_tag(v)
+        data = tr.get(tag)
+        if data is None:
+            continue  # pruned between list and read: benign race
+        origin = tr.describe(tag)
         try:
-            with open(path, "rb") as f:
-                data = f.read()
             doc = pickle.loads(
                 integrity.unwrap_checksummed(
-                    data, origin=f"serving snapshot {path}"
+                    data, origin=f"serving snapshot {origin}"
                 )
             )
-        except FileNotFoundError:
-            continue  # pruned between listdir and read: benign race
         except (CheckpointCorrupt, OSError, pickle.UnpicklingError,
                 EOFError, AttributeError) as e:
-            integrity.record_rejection(path, repr(e))
+            integrity.record_rejection(origin, repr(e))
             continue
         if doc.get("payload") is None:
-            integrity.record_rejection(path, "no payload in snapshot doc")
+            integrity.record_rejection(origin, "no payload in snapshot doc")
             continue
         # geometry validation (GL011 symmetry with SnapshotMirror.write:
         # every committed key is consumed here): a doc missing its
@@ -360,27 +370,30 @@ def load_newest_snapshot(
                 and isinstance(doc.get("watermark"), int)
                 and isinstance(doc.get("version"), int)):
             integrity.record_rejection(
-                path, "snapshot doc geometry keys missing or invalid")
+                origin, "snapshot doc geometry keys missing or invalid")
             continue
         return doc
     return None
 
 
 def follow_snapshots(
-    dirpath: str,
+    dirpath,
     stop: threading.Event,
     *,
     poll_s: float = 0.05,
 ) -> Iterator[Tuple[dict, int]]:
-    """Standby-side emission iterator over a shared snapshot directory:
+    """Standby-side emission iterator over a shared snapshot store:
     yields ``(payload, watermark)`` once per NEW committed snapshot
     version until ``stop`` is set. Plug it into a ``StreamServer`` as a
     bare servable (``source=None``) and the standby serves whatever the
     primary last mirrored — including after the primary dies (the
     keep-serving-from-final-state contract, now across processes)."""
+    from ..fabric import as_transport
+
+    tr = as_transport(dirpath)
     last = -1
     while not stop.is_set():
-        doc = load_newest_snapshot(dirpath, newer_than=last)
+        doc = load_newest_snapshot(tr, newer_than=last)
         if doc is None:
             stop.wait(poll_s)
             continue

@@ -92,19 +92,42 @@ def test_latency_watch_list_matches_the_latency_artifact():
 
 
 def test_autotune_watch_list_matches_the_autotune_artifact():
-    # the ISSUE 15 satellite: the CI autotune step watches the
-    # controller's cliff-cell eps and its auto/hand ratio from the
-    # committed artifact — both throughput-direction (min:), both must
-    # resolve
+    # the ISSUE 15 satellite (+ the ROADMAP 5b negative control): the
+    # CI autotune step watches the controller's cliff-cell eps and its
+    # auto/hand ratio (both throughput-direction, min:) plus the
+    # pagerank_hold cell's k_final (latency-direction: a controller
+    # that stops holding K=1 regresses UPWARD) and its auto/pinned
+    # parity ratio — every metric must resolve on the committed
+    # artifact, and the negative control must actually record the hold
     from tools.benchguard import WATCHED_AUTOTUNE
 
     path = os.path.join(REPO, "BENCH_AUTOTUNE_CPU.json")
     with open(path) as f:
         committed = json.load(f)
     for metric in WATCHED_AUTOTUNE:
-        assert metric.startswith("min:")
-        value = dig(committed, metric[4:])
+        value = dig(committed, metric[4:] if metric.startswith("min:")
+                    else metric)
         assert isinstance(value, (int, float)), metric
+    assert dig(committed, "cells.pagerank_hold.auto.k_final") == 1
+    assert committed["headline"]["pagerank_held"] is True
+
+
+def test_transport_watch_list_matches_the_transport_artifact():
+    # ISSUE 16 satellite: the CI transport step watches each backend's
+    # store round-trip throughput (min:) and 2-rank allgather p50
+    # (latency direction) from the committed fabric artifact
+    from tools.benchguard import WATCHED_TRANSPORT
+
+    path = os.path.join(REPO, "BENCH_TRANSPORT_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_TRANSPORT:
+        value = dig(committed, metric[4:] if metric.startswith("min:")
+                    else metric)
+        assert isinstance(value, (int, float)), metric
+    assert committed["ok"] is True
+    for backend in ("shared_dir", "socket"):
+        assert committed["backends"][backend]["recovery"]["ok"] is True
 
 
 def test_chaos_watch_list_matches_the_chaos_artifact():

@@ -208,8 +208,12 @@ def test_superbatch_string_typos_fail_with_the_accepted_values():
 
     with pytest.raises(ValueError, match='"auto"'):
         ConnectedComponents(superbatch="Auto")
-    with pytest.raises(ValueError, match="auto"):
-        IncrementalPageRank(superbatch="auto")
+    with pytest.raises(ValueError, match='"auto"'):
+        IncrementalPageRank(superbatch="Auto")
+    # "auto" itself is ACCEPTED since the pagerank_hold negative
+    # control landed: the controller's job on this fixpoint-bound
+    # carry is to hold K=1, which the watched bench cell proves
+    assert IncrementalPageRank(superbatch="auto").superbatch_auto
 
 
 def test_gf_folded_watermark_resets_after_a_group_folded_run():
@@ -575,20 +579,60 @@ def test_checkpoint_aligned_tracks_group_boundaries():
     assert not w.checkpoint_aligned(4) and not w.checkpoint_aligned(8)
 
 
-def test_coordinated_rejects_superbatch_auto(tmp_path):
+def test_coordinated_wires_cadence_agreement(tmp_path):
+    """The former ``superbatch="auto"`` ValueError path: coordinated
+    runs now wrap the work's AutoK in an ElectedK riding the
+    checkpoint's own transport, so every process's packer tiles by the
+    ONE elected K per cadence epoch — and the run stays value-identical
+    to the pinned-K oracle."""
+    from gelly_streaming_tpu.fabric import ElectedK
+    from gelly_streaming_tpu.library import ConnectedComponents
     from gelly_streaming_tpu.resilience.coordinated import (
         CoordinatedCheckpoint,
     )
 
+    rng = np.random.default_rng(23)
+    n = 1 << 13
+    src = rng.integers(0, 1024, n)
+    dst = rng.integers(0, 1024, n)
+    base = [
+        str(c) for c in ConnectedComponents(superbatch=1).run(
+            _cc_stream(src, dst, 128, 1024)
+        )
+    ]
     cc = CoordinatedCheckpoint(
         str(tmp_path), process_id=0, num_processes=1, every=4
     )
+    agg = ConnectedComponents(superbatch="auto")
+    got = [
+        str(c) for c in cc.run(
+            lambda vd: _cc_stream(src, dst, 128, 1024), agg
+        )
+    ]
+    assert got == base
+    # the plane's knob IS the agreement wrapper, and its elections are
+    # persisted winners in the checkpoint store (replay re-reads them)
+    assert isinstance(agg.control.autok, ElectedK)
+    assert cc.transport.list("cadence.e"), (
+        "cadence elections must be persisted through the transport"
+    )
 
-    class W:
-        superbatch_auto = True
 
-    with pytest.raises(ValueError, match="superbatch"):
-        list(cc.run(lambda vd: None, W()))
+def test_elected_k_replays_persisted_winners(tmp_path):
+    """Agreement determinism across a restart: a second ElectedK over
+    the same store (same origin) re-reads every persisted winner, so a
+    replaying process tiles EXACTLY as the first incarnation did even
+    when its own AutoK would now propose something else."""
+    from gelly_streaming_tpu.control import AutoK
+    from gelly_streaming_tpu.fabric import ElectedK, SharedDirTransport
+
+    tr = SharedDirTransport(str(tmp_path))
+    first = ElectedK(AutoK(k0=3, k_max=8), tr, every=4)
+    ks = [first.current_k() for _ in range(6)]
+    # a restarted process proposing a DIFFERENT k0 must read the same
+    # winners back tag for tag
+    second = ElectedK(AutoK(k0=1, k_max=8), tr, every=4)
+    assert [second.current_k() for _ in range(6)] == ks
 
 
 # --------------------------------------------------------------------- #

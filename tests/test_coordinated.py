@@ -296,7 +296,7 @@ def _shard_corpus(seed=99, windows=6, window_edges=32, nprocs=2):
 
 
 def _run_cluster(root, shards, *, windows, lw, crash_at=None,
-                 results=None):
+                 results=None, superbatch=2):
     """Drive both shards' supervised pipelines on two threads over one
     shared checkpoint/exchange directory. ``crash_at=(pid, ordinal)``
     raises SimulatedCrash inside that shard's stream once — the
@@ -351,7 +351,7 @@ def _run_cluster(root, shards, *, windows, lw, crash_at=None,
             o = cc.windows_done()
             for comps in sup.run(
                 make_stream,
-                lambda: ConnectedComponents(superbatch=2),
+                lambda: ConnectedComponents(superbatch=superbatch),
             ):
                 digests.append((o, digest(comps)))
                 o += 1
@@ -397,6 +397,44 @@ def test_coordinated_two_shard_recovery_oracle_identical(
     assert registry.counter(
         "resilience.restarts", kind="transient"
     ).value == 1
+
+
+def test_coordinated_superbatch_auto_kill_resume_value_identical(
+    tmp_path, registry
+):
+    """The multi-host cadence agreement, end to end: both shards run
+    ``superbatch="auto"``, their AutoKs wrapped in ElectedK by the
+    coordinated layer, so every cadence epoch tiles by ONE elected K on
+    both shards. One shard crashes mid-run, restores from the agreed
+    epoch, replays the PERSISTED election winners (never re-votes), and
+    both shards' emissions equal an uninterrupted auto cluster's — and
+    that cluster's equal the pinned-K oracle's."""
+    windows, lw = 6, 16
+    shards = _shard_corpus(windows=windows, window_edges=2 * lw)
+    pinned = _run_cluster(
+        str(tmp_path / "pinned"), shards, windows=windows, lw=lw,
+        superbatch=1,
+    )
+    oracle = _run_cluster(
+        str(tmp_path / "oracle"), shards, windows=windows, lw=lw,
+        superbatch="auto",
+    )
+    crashed = _run_cluster(
+        str(tmp_path / "crash"), shards, windows=windows, lw=lw,
+        crash_at=(1, 4), superbatch="auto",
+    )
+    for pid in range(2):
+        assert oracle[pid]["digests"] == pinned[pid]["digests"]
+        assert crashed[pid]["digests"] == oracle[pid]["digests"]
+    assert crashed[1]["restarts"] == 1
+    # the election evidence: persisted winners in the checkpoint store
+    for d in ("oracle", "crash"):
+        from gelly_streaming_tpu.fabric import SharedDirTransport
+
+        tags = SharedDirTransport(
+            str(tmp_path / d / "ckpt")
+        ).list("cadence.e")
+        assert tags, f"{d}: no persisted cadence elections"
 
 
 # --------------------------------------------------------------------- #

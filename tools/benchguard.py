@@ -72,9 +72,17 @@ WATCHED_LATENCY = (
 #: constant) and its ratio against the hand cell measured in the same
 #: run (also ``min:``: the ratio is the artifact's own honesty check,
 #: so the watch survives the box getting faster or slower overall).
+#: ...plus the negative control (ROADMAP 5b / ISSUE 16): on the
+#: fixpoint-bound PageRank parity cell, auto-K must HOLD K=1 —
+#: ``auto.k_final`` is watched in the latency direction (committed 1;
+#: a controller that converges to the next rung, 4, breaches the 3.0x
+#: bound), and the auto-vs-pinned throughput ratio in the ``min:``
+#: direction (paying for fusion that buys nothing drags it down).
 WATCHED_AUTOTUNE = (
     "min:cells.cc_1024.auto.eps",
     "min:cells.cc_1024.ratio_vs_hand",
+    "cells.pagerank_hold.auto.k_final",
+    "min:cells.pagerank_hold.ratio_vs_pinned",
 )
 
 #: the sharded-serving artifact's guarded metrics
@@ -84,6 +92,20 @@ WATCHED_AUTOTUNE = (
 #: The kill/promotion columns are NOT guarded: their latency is
 #: dominated by the configured lease timeout, a correctness parameter.
 WATCHED_SHARDED = ("min:headline.qps", "zipf.cache_on.p99_ms")
+
+#: the transport-fabric artifact's guarded cells
+#: (BENCH_TRANSPORT_CPU.json, ISSUE 16): per-backend store round-trip
+#: throughput (``min:`` — a regression means the exchange machinery
+#: itself got slower) and the 2-rank allgather p50 (latency, regression
+#: upward) on both locally-runnable backends. The recovery columns are
+#: NOT guarded: kill/relaunch wall time is dominated by interpreter
+#: boot + polling cadence, both configuration, not code.
+WATCHED_TRANSPORT = (
+    "min:backends.shared_dir.store.ops_per_s",
+    "min:backends.socket.store.ops_per_s",
+    "backends.shared_dir.exchange.p50_ms",
+    "backends.socket.exchange.p50_ms",
+)
 
 #: a fresh value may be up to this many times the committed one
 DEFAULT_RATIO = 3.0

@@ -50,11 +50,16 @@ _BROAD = {"Exception", "BaseException"}
 #: router: its worker + per-shard client callbacks are the fan-out's
 #: only witnesses — a swallowed shard error there would silently turn
 #: a partial outage into a hung future.
+#: ISSUE 16 adds the fabric exchange: the daemon's accept/handler
+#: threads and the client's reconnect loop sit on sockets under the
+#: same contract (``fabric.malformed{kind}`` / ``fabric.reconnects`` /
+#: ``fabric.swallowed{site}``).
 THREADED_SOCKET_MODULES = (
     "serving/rpc.py",
     "serving/client.py",
     "serving/router.py",
     "core/ingest.py",
+    "fabric/exchange.py",
 )
 
 #: calls that count as "left registry evidence": instrument factories

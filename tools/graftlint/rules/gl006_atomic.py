@@ -27,6 +27,9 @@ CKPT_MODULES = (
     "resilience/supervisor.py",
     "resilience/integrity.py",
     "parallel/multihost.py",
+    # ISSUE 16: the shared-dir transport is now THE module that owns
+    # the commit dance for every cross-process artifact
+    "fabric/shared_dir.py",
 )
 
 
