@@ -3056,7 +3056,11 @@ def main():
         # checked oracle-identical, a traced batch joining client ->
         # router -> both shards, and a kill-one-shard point where only
         # that shard's keyspace sees the outage (its standby promotes;
-        # the other shard's keys see zero failures).
+        # the other shard's keys see zero failures). ISSUE 17 adds the
+        # churn cell: pull-protocol-v2 (since_version delta) router vs
+        # a full-re-pull baseline over the same live-ingest stream;
+        # per-refresh pulled bytes and merge time must both sit >= 5x
+        # below the baseline with post-churn oracle identity.
         import tempfile
 
         from gelly_streaming_tpu.resilience.chaos import (
@@ -3075,7 +3079,7 @@ def main():
             obs_log = os.path.join(root, "obs_smoke.jsonl")
             kw = dict(
                 n_edges=1 << 13, measure_s=1.0, oracle_checks=128,
-                post_kill_batches=10,
+                post_kill_batches=10, churn_bumps=12,
             )
         else:
             artifact = "BENCH_SERVING_SHARDED_CPU.json"
@@ -3104,10 +3108,13 @@ def main():
             doc["obs_log"] = obs_log
             with open(artifact, "w") as f:
                 json.dump(doc, f, indent=2)
+        churn = doc.get("churn", {})
         log(f"serving-sharded: ok={doc['ok']} "
             f"scaling={ {k: v['qps'] for k, v in doc['scaling'].items()} } "
             f"headline={doc['headline']} "
-            f"kill={doc.get('shard_kill', {}).get('promoted')}")
+            f"kill={doc.get('shard_kill', {}).get('promoted')} "
+            f"churn bytes_x={churn.get('bytes_x')} "
+            f"merge_x={churn.get('merge_x')}")
         print(json.dumps({
             "metric": "serving_sharded_headline_qps",
             "value": doc["headline"]["qps"],
@@ -3118,6 +3125,9 @@ def main():
             "zipf_cache_off_p50_ms": doc["zipf"]["cache_off"]["p50_ms"],
             "oracle_mismatches": doc["oracle"]["mismatches"],
             "joined_trace": doc["trace"]["joined_trace"],
+            "churn_bytes_x": churn.get("bytes_x"),
+            "churn_merge_x": churn.get("merge_x"),
+            "churn_oracle_mismatches": churn.get("oracle_mismatches"),
             "ok": doc["ok"],
             "artifact": artifact,
             "obs_log": obs_log if artifact else None,

@@ -518,9 +518,17 @@ class BipartitenessServable:
                 # group's dispatch — published snapshots must own
                 # their buffer (the CCServable rule)
                 labels = jnp.array(labels)
+            # count-snapshotted novelty shadow, same interface as the
+            # forest carry (and CCServable): the engine's delta-pull
+            # diff keys on tids[:tcount] whichever carry published
+            log = TouchLog.from_touched_bool(
+                np.asarray(agg._summary["touched"])
+            )
             return {
                 "cover": labels,
                 "touched": agg._summary["touched"],
+                "tids": log.ids,
+                "tcount": log.count,
                 "vdict": vdict,
             }
         return None

@@ -520,7 +520,16 @@ class CCServable:
                 labels = jnp.array(labels)
         else:
             return None
-        return {"labels": labels, "vdict": vdict}
+        payload = {"labels": labels, "vdict": vdict}
+        log = getattr(agg, "_log", None)
+        if log is not None:
+            # the TouchLog novelty shadow rides every snapshot (count-
+            # snapshotted: the first tcount entries of an append-only
+            # log never change) — the delta-pull diff's candidate
+            # bound, same publish shape as the bipartiteness cover
+            payload["tids"] = log.ids
+            payload["tcount"] = log.count
+        return payload
 
     def payloads(self, stream):
         vdict = stream.vertex_dict

@@ -95,6 +95,15 @@ STORY = {
     "router.pull_errors": "PULL-ERROR",
     "router.shard_errors": "SHARD-ERROR",
     "router.cache_invalidations": "CACHE-INVAL",
+    # the delta-pull story (ISSUE 17): pull protocol v2 — each
+    # incremental refresh (DELTA-PULL, O(changed rows) over the wire),
+    # each honest degrade to a full table (FULL-FALLBACK{reason}: stale
+    # ring, restarted store, v1 peer), and each malformed pull frame
+    # the router rejected — so a churn run renders the protocol's
+    # actual full/delta cadence next to the CC-PULL lines above
+    "router.delta_pulls": "DELTA-PULL",
+    "router.full_fallbacks": "FULL-FALLBACK",
+    "router.pull_malformed": "PULL-MALFORMED",
     # the self-tuning story (ISSUE 15): every control-plane decision —
     # superbatch K, prefetch depth, admission limit — logs one
     # control.retune{knob,from,to,signal} event, so a knob move renders

@@ -965,6 +965,10 @@ def _kill_replica(p) -> None:
 SHARDED_DEFAULTS = dict(
     n_vertices=1 << 14, n_edges=1 << 15, window=2048, seed=29,
     batch=32, measure_s=4.0, zipf_a=1.5, deadline_s=30.0, lease_s=0.4,
+    # churn cell (ISSUE 17): ~1% of the keyspace touched per version
+    # bump (each edge touches <= 2 vertices), paced so the routers
+    # observe every bump as a separate refresh
+    churn_bumps=24, churn_frac=0.01, churn_pace_s=0.15,
 )
 
 #: event-shard ids for the non-replica processes of the sharded story
@@ -1164,6 +1168,9 @@ def run_sharded_scenario(
     zipf_a: float = SHARDED_DEFAULTS["zipf_a"],
     deadline_s: float = SHARDED_DEFAULTS["deadline_s"],
     lease_s: float = SHARDED_DEFAULTS["lease_s"],
+    churn_bumps: int = SHARDED_DEFAULTS["churn_bumps"],
+    churn_frac: float = SHARDED_DEFAULTS["churn_frac"],
+    churn_pace_s: float = SHARDED_DEFAULTS["churn_pace_s"],
     clients: int = 4,
     oracle_checks: int = 512,
     kill_hold_s: float = 1.0,
@@ -1189,6 +1196,12 @@ def run_sharded_scenario(
       per-owner traffic; the unaffected shard's keys must see ZERO
       failures (and no outage), shard 0's keys fail over to its
       standby with zero failures and a measured blip.
+    - **c3** — the delta-pull churn cell (ISSUE 17): the 2-shard
+      topology under LIVE INGEST (paced ~1%-touched version bumps),
+      one pull-protocol-v2 router vs one full-re-pull baseline router
+      on the same stream; the gate is per-refresh pulled bytes AND
+      router merge-refresh time both >= 5x below the baseline, with a
+      post-churn oracle identity check on both routers.
     - **c4** — four shards: the tail of the scaling curve.
 
     The box's core count is recorded (``host_cores``): on a 2-core
@@ -1216,7 +1229,7 @@ def run_sharded_scenario(
         spawn_router,
     )
     from ..serving.rpc import wait_portfile
-    from ..summaries.forest import fold_edges_host
+    from ..summaries.forest import fold_edges_host, resolve_flat_host
 
     say = log or (lambda s: print(s, file=sys.stderr, flush=True))
     os.makedirs(root, exist_ok=True)
@@ -1260,9 +1273,10 @@ def run_sharded_scenario(
     per_batch_deadline_s = float(deadline_s)
 
     def spawn_cell_router(cell_dir: str, shard_addrs, *, cache: bool,
-                          tag: str, events: bool = False):
+                          tag: str, events: bool = False,
+                          delta: bool = True):
         cfg = dict(
-            shards=shard_addrs, cache=cache,
+            shards=shard_addrs, cache=cache, delta=delta,
             portfile=os.path.join(cell_dir, f"router.{tag}.port"),
             meta=os.path.join(cell_dir, f"router.{tag}.meta.json"),
             run_s=600.0,
@@ -1598,6 +1612,224 @@ def run_sharded_scenario(
             _teardown(procs)
             _ship_events(obs_f, c2e, "c2")
 
+        # ---- cell 3: delta-pull churn (ISSUE 17) ----------------------- #
+        # the same 2-shard topology under LIVE INGEST: after the main
+        # stream, each shard folds `churn_bumps` paced version bumps of
+        # ~churn_frac touched vertices each. Two routers ride the same
+        # stream — pull protocol v2 (delta=True) vs the full-re-pull
+        # baseline (delta=False) — and the committed evidence is their
+        # per-refresh pulled bytes and merge-refresh time, plus a
+        # post-churn oracle identity check on BOTH.
+        c3 = os.path.join(root, "c3")
+        os.makedirs(c3, exist_ok=True)
+        # the churn cell rides a 4x-larger keyspace than the load
+        # cells: the claim under test is O(changed rows) vs O(forest),
+        # and a bigger forest keeps the full-rebuild baseline well
+        # clear of the box's scheduling-noise floor (~1-2ms per
+        # refresh under cell load), which would otherwise dominate
+        # BOTH sides of the ratio and wash the gate out
+        churn_nv = 4 * n_vertices
+        churn_edges = max(1, int(churn_nv * churn_frac) // 2)
+        churn_seed = seed + 40
+        # the shards hold their churn tails on this gate file until
+        # both routers are up and the drivers are issuing queries —
+        # otherwise the paced bumps race the routers' process boot and
+        # the delta path has nothing to refresh against
+        churn_gate = os.path.join(c3, "churn.go")
+        procs, shard_addrs = _spawn_shard_replicas(
+            c3, 2,
+            base_cfg=dict(
+                base_cfg, n_vertices=churn_nv,
+                churn_bumps=churn_bumps,
+                churn_edges=churn_edges, churn_seed=churn_seed,
+                churn_pace_s=churn_pace_s, churn_gate=churn_gate,
+            ),
+            lease_s=lease_s)
+        try:
+            src3, dst3 = demo_shard_edges(churn_nv, n_edges, seed)
+            parts3 = partition_edges_by_vertex(src3, dst3, None, 2)
+            wm = [len(s) for s, _d, _v in parts3]
+            for k in range(2):
+                _wait_watermark(shard_addrs[k][0], wm[k])
+            say(f"sharded: c3 up (2 shards + {churn_bumps} churn bumps "
+                f"of {churn_edges} edges)")
+            rp_d, raddr_d, meta_d = spawn_cell_router(
+                c3, shard_addrs, cache=False, tag="delta")
+            rp_f, raddr_f, meta_f = spawn_cell_router(
+                c3, shard_addrs, cache=False, tag="full", delta=False)
+
+            # driver-side post-churn oracle: the shards fold global
+            # slice [k*churn_edges, (k+1)*churn_edges) at bump k, so
+            # folding the WHOLE churn stream on top of the main fold
+            # reproduces their final state exactly
+            csrc, cdst = demo_shard_edges(
+                churn_nv, churn_bumps * churn_edges, churn_seed)
+            olab3 = fold_edges_host(
+                np.arange(churn_nv, dtype=np.int32), src3, dst3)
+            clab = resolve_flat_host(
+                fold_edges_host(olab3, csrc, cdst))
+            cparts = partition_edges_by_vertex(csrc, cdst, None, 2)
+            final_wm = [wm[k] + len(cparts[k][0]) for k in range(2)]
+            owners3 = vertex_owner(
+                np.arange(churn_nv, dtype=np.int64), 2)
+            probe = [int(np.where(owners3 == k)[0][0])
+                     for k in range(2)]
+
+            churn_errs: list = []
+
+            def churn_drive(raddr: str, ci: int) -> None:
+                # mixed load over live ingest: Connected queries hit
+                # the merged forest (each version bump triggers the
+                # next refresh), the Degree sprinkle carries fresh
+                # per-shard version observations back to the router
+                rng3 = np.random.default_rng(seed + 50 + ci)
+                cl3 = RpcClient([raddr], seed=seed + 50 + ci)
+                try:
+                    end = (time.monotonic()
+                           + churn_bumps * churn_pace_s + 4.0)
+                    while time.monotonic() < end:
+                        us3 = rng3.integers(0, churn_nv, batch - 2)
+                        vs3 = rng3.integers(0, churn_nv, batch - 2)
+                        qs3 = [ConnectedQuery(int(a), int(b))
+                               for a, b in zip(us3, vs3)]
+                        qs3 += [DegreeQuery(p) for p in probe]
+                        for f in cl3.submit_batch(
+                                qs3,
+                                deadline_s=per_batch_deadline_s):
+                            f.result(deadline_s + 30)
+                        time.sleep(0.01)
+                except BaseException as e:
+                    churn_errs.append(f"r{ci}: {e!r:.300}")
+                finally:
+                    cl3.close()
+
+            cthreads = [
+                threading.Thread(target=churn_drive, args=(a, i),
+                                 daemon=True)
+                for i, a in enumerate((raddr_d, raddr_f))
+            ]
+            for t in cthreads:
+                t.start()
+            # both routers are live and under drive: release the
+            # shards' churn tails
+            with open(churn_gate, "w") as f:
+                f.write("go")
+            for t in cthreads:
+                t.join(churn_bumps * churn_pace_s + 120)
+
+            # converge each router onto the FINAL churned state, then
+            # oracle-check its merged answers against the driver fold
+            churn_bad = 0
+            converged = []
+            orng = np.random.default_rng(seed + 60)
+            for raddr in (raddr_d, raddr_f):
+                cl3 = RpcClient([raddr], seed=seed + 61)
+                try:
+                    cdl = time.monotonic() + deadline_s
+
+                    def cremain() -> float:
+                        return max(0.5, cdl - time.monotonic())
+
+                    done = False
+                    while time.monotonic() < cdl and not done:
+                        ws = [int(cl3.ask(
+                            DegreeQuery(probe[k]), timeout=30,
+                            deadline_s=cremain()).watermark)
+                            for k in range(2)]
+                        ans = cl3.ask(
+                            ConnectedQuery(probe[0], probe[1]),
+                            timeout=30, deadline_s=cremain())
+                        done = (
+                            ws[0] >= final_wm[0]
+                            and ws[1] >= final_wm[1]
+                            and int(ans.watermark) >= sum(final_wm)
+                        )
+                        if not done:
+                            time.sleep(0.05)
+                    converged.append(done)
+                    us3 = orng.integers(0, churn_nv, oracle_checks)
+                    vs3 = orng.integers(0, churn_nv, oracle_checks)
+                    futs = cl3.submit_batch(
+                        [ConnectedQuery(int(a), int(b))
+                         for a, b in zip(us3, vs3)],
+                        deadline_s=cremain())
+                    for a, b, f in zip(us3, vs3, futs):
+                        want = bool(clab[a] == clab[b])
+                        if bool(f.result(60).value) is not want:
+                            churn_bad += 1
+                finally:
+                    cl3.close()
+            _teardown([rp_d, rp_f])
+            try:
+                with open(meta_d) as f:
+                    md = json.load(f)
+                with open(meta_f) as f:
+                    mf = json.load(f)
+            except (OSError, ValueError):
+                md = mf = None
+            if md and mf:
+                d_ref = max(1, md["merges_delta"])
+                f_ref = max(1, mf["merges_full"])
+                # per-refresh steady state: the delta router's boot
+                # refresh is a full pull by construction and stays in
+                # its *_full columns; the ratios compare what each
+                # refresh COSTS once the tier is up
+                d_bytes = md["pull_bytes_delta"] / d_ref
+                f_bytes = mf["pull_bytes_full"] / f_ref
+                d_merge = md["merge_s_delta"] / d_ref
+                f_merge = mf["merge_s_full"] / f_ref
+                bytes_x = f_bytes / max(d_bytes, 1.0)
+                merge_x = f_merge / max(d_merge, 1e-6)
+                churn_ok = (
+                    not churn_errs and churn_bad == 0
+                    and all(converged) and len(converged) == 2
+                    and md["merges_delta"] >= 3
+                    and mf["merges_full"] >= 3
+                    and md["pull_malformed"] == 0
+                    and mf["pull_malformed"] == 0
+                    and bytes_x >= 5.0 and merge_x >= 5.0
+                )
+            else:
+                d_bytes = f_bytes = d_merge = f_merge = None
+                bytes_x = merge_x = None
+                churn_ok = False
+            doc["churn"] = {
+                "config": dict(
+                    churn_nv=churn_nv, churn_bumps=churn_bumps,
+                    churn_edges=churn_edges, churn_frac=churn_frac,
+                    churn_pace_s=churn_pace_s, churn_seed=churn_seed,
+                ),
+                "oracle_checked": int(2 * oracle_checks),
+                "oracle_mismatches": int(churn_bad),
+                "converged": converged,
+                "driver_errors": list(churn_errs),
+                "delta_router": md,
+                "full_router": mf,
+                "delta_bytes_per_refresh": (
+                    round(d_bytes, 1) if d_bytes is not None else None),
+                "full_bytes_per_refresh": (
+                    round(f_bytes, 1) if f_bytes is not None else None),
+                "delta_merge_s_per_refresh": (
+                    round(d_merge, 6) if d_merge is not None else None),
+                "full_merge_s_per_refresh": (
+                    round(f_merge, 6) if f_merge is not None else None),
+                "bytes_x": (
+                    round(bytes_x, 1) if bytes_x is not None else None),
+                "merge_x": (
+                    round(merge_x, 1) if merge_x is not None else None),
+                "churn_ok": churn_ok,
+            }
+            say(f"sharded: churn — delta {doc['churn']['delta_bytes_per_refresh']}B/refresh "
+                f"vs full {doc['churn']['full_bytes_per_refresh']}B "
+                f"({doc['churn']['bytes_x']}x), merge "
+                f"{doc['churn']['delta_merge_s_per_refresh']}s vs "
+                f"{doc['churn']['full_merge_s_per_refresh']}s "
+                f"({doc['churn']['merge_x']}x), "
+                f"mismatches={churn_bad}, ok={churn_ok}")
+        finally:
+            _teardown(procs)
+            _ship_events(obs_f, c3, "c3")
+
         # ---- cell 4: scaling tail -------------------------------------- #
         c4 = os.path.join(root, "c4")
         os.makedirs(c4, exist_ok=True)
@@ -1694,6 +1926,7 @@ def run_sharded_scenario(
             and not doc["shard_kill"]["driver_errors"]
             and doc["shard_kill"]["promoted"]
             and doc["trace"]["joined_trace"] is not None
+            and doc["churn"]["churn_ok"]
         )
         doc["ok"] = ok
         doc["note"] = (
@@ -1716,7 +1949,11 @@ def run_sharded_scenario(
             "shard_kill: shard 0's primary SIGKILLed under live "
             "per-owner load; its standby promotes on lease lapse; "
             "the unaffected shard's keys see zero failures and no "
-            "outage."
+            "outage. churn: pull protocol v2 (since_version deltas) "
+            "vs the full-re-pull baseline over the same live-ingest "
+            "stream — per-refresh pulled bytes and router merge time "
+            "must both sit >= 5x below the baseline, with post-churn "
+            "oracle identity on both routers."
         )
         if not ok:
             doc["reason"] = (
@@ -1726,7 +1963,8 @@ def run_sharded_scenario(
                 f"cache_p50=({zipf_on['p50_ms']} vs "
                 f"{zipf_off['p50_ms']}), "
                 f"kill={doc['shard_kill']}, "
-                f"trace={doc['trace']}"
+                f"trace={doc['trace']}, "
+                f"churn={doc['churn']}"
             )
         say(f"sharded: ok={ok} scaling="
             f"{ {k: v['qps'] for k, v in scaling.items()} } "

@@ -45,6 +45,9 @@ size 0.
 
 from __future__ import annotations
 
+import base64
+import binascii
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -103,9 +106,17 @@ class SummaryPullQuery(Query):
     little-endian int64 columns (base64 in the JSON answer value).
     RAW-id space is the join key — per-shard compact ids never leave
     their shard. O(vcap) per snapshot version, cached by the engine, so
-    any number of pulls per version cost one canonicalization."""
+    any number of pulls per version cost one canonicalization.
 
-    __slots__ = ()
+    ``since_version`` is the pull protocol's v2 field: a puller that
+    already holds this shard's table at that version asks for only the
+    rows whose ROOT assignment changed since then (a ``kind="delta"``
+    reply, O(changed rows) on the wire). ``-1`` (the v1 shape — old
+    peers never set the field) always answers the full table; a
+    ``since_version`` older than the engine's bounded delta ring
+    degrades HONESTLY to a full reply tagged with why."""
+
+    since_version: int = -1
 
 
 @dataclass(frozen=True)
@@ -141,6 +152,117 @@ class Answer:
     watermark: int
     staleness: int
     version: int = 0
+
+
+# --------------------------------------------------------------------- #
+# Pull-doc wire codec (protocol v2: full | delta reply frames)
+# --------------------------------------------------------------------- #
+#: how many version-to-version delta segments the engine retains; a
+#: ``since_version`` older than the ring reaches degrades to a full
+#: reply (tagged ``why="stale"``) — the bounded-memory honesty rule
+DELTA_RING = 8
+
+
+class MalformedPull(ValueError):
+    """A pull doc that fails decode, carrying WHICH geometry rule broke
+    (``kind`` in {type, missing, b64, geometry, tag, base}) so the
+    router can count malformed pulls by failure class instead of
+    folding them into a generic pull error."""
+
+    def __init__(self, kind: str, msg: str):
+        super().__init__(msg)
+        self.kind = kind
+
+
+def _b64_cols(raws: np.ndarray, roots: np.ndarray) -> Tuple[str, str]:
+    return (
+        base64.b64encode(
+            np.ascontiguousarray(raws, np.int64).tobytes()).decode("ascii"),
+        base64.b64encode(
+            np.ascontiguousarray(roots, np.int64).tobytes()).decode("ascii"),
+    )
+
+
+def encode_pull_doc(
+    raws: np.ndarray,
+    roots: np.ndarray,
+    *,
+    kind: str = "full",
+    base: Optional[int] = None,
+    why: Optional[str] = None,
+) -> dict:
+    """Pack ``(vertex, root)`` RAW-id columns as a pull reply doc.
+
+    ``kind="full"`` is the whole-table frame (v1 peers decode it
+    unchanged: the tag rides an extra dict key they never read);
+    ``kind="delta"`` carries only changed rows plus ``base`` — the
+    version the rows are a diff AGAINST, which the puller must already
+    hold. ``why`` tags a full reply that a delta request degraded into
+    (stale ring, no chain yet, puller ahead). Every key written here is
+    read back in :func:`decode_pull_doc` (GL011 symmetry)."""
+    u64, r64 = _b64_cols(raws, roots)
+    doc = {"kind": kind, "n": int(len(raws)), "u64": u64, "r64": r64}
+    if kind == "delta":
+        if base is None:
+            raise ValueError("delta pull docs must carry base")
+        doc["base"] = int(base)
+    if why is not None:
+        doc["why"] = str(why)
+    return doc
+
+
+def decode_pull_doc(doc) -> dict:
+    """Decode a pull reply into host columns::
+
+        {"kind": "full"|"delta", "n": int,
+         "u": int64[n], "r": int64[n], "base": int|None, "why": str|None}
+
+    A doc with NO ``kind`` tag decodes as a full frame — that is the v1
+    wire shape, so a v2 puller interops with an old shard by treating
+    its replies as full tables and resetting its delta baseline.
+    Raises :class:`MalformedPull` (kind-tagged) on any geometry
+    mismatch; a decoded frame is safe to merge as-is."""
+    if not isinstance(doc, dict):
+        raise MalformedPull(
+            "type", f"pull answer must be a dict, got {type(doc).__name__}"
+        )
+    kind = doc.get("kind", "full")
+    if kind not in ("full", "delta"):
+        raise MalformedPull("tag", f"unknown pull frame kind {kind!r}")
+    for k in ("n", "u64", "r64"):
+        if k not in doc:
+            raise MalformedPull("missing", f"pull doc lacks {k!r}")
+    n = doc["n"]
+    if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+        raise MalformedPull("type", f"pull doc n must be an int >= 0, got {n!r}")
+    if not isinstance(doc["u64"], str) or not isinstance(doc["r64"], str):
+        raise MalformedPull("type", "pull doc u64/r64 must be base64 strings")
+    try:
+        ub = base64.b64decode(doc["u64"], validate=True)
+        rb = base64.b64decode(doc["r64"], validate=True)
+    except (binascii.Error, ValueError) as e:
+        raise MalformedPull("b64", f"pull doc columns are not base64: {e}")
+    if len(ub) != 8 * n or len(rb) != 8 * n:
+        raise MalformedPull(
+            "geometry",
+            f"pull doc geometry mismatch: n={n} but columns carry "
+            f"{len(ub)}/{len(rb)} bytes (want {8 * n})",
+        )
+    base = doc.get("base")
+    if kind == "delta":
+        if not isinstance(base, int) or isinstance(base, bool):
+            raise MalformedPull(
+                "base", f"delta pull doc must carry an int base, got {base!r}"
+            )
+    why = doc.get("why")
+    return {
+        "kind": kind,
+        "n": n,
+        "u": np.frombuffer(ub, np.int64),
+        "r": np.frombuffer(rb, np.int64),
+        "base": base if kind == "delta" else None,
+        "why": str(why) if why is not None else None,
+    }
 
 
 # --------------------------------------------------------------------- #
@@ -254,22 +376,32 @@ class QueryEngine:
         self._size_cache: Tuple[Optional[tuple], Any, Any] = (
             None, None, None,
         )
-        self._host_cache: dict = {}  # (version, payload key) -> np array
-        self._pull_cache: Tuple[Optional[int], Optional[dict]] = (
+        self._host_cache: dict = {}  # (epoch, version, payload key) -> np
+        # pull docs cache: one dict per (epoch, version), keyed by the
+        # effective since_version (-1 = full) — several routers at
+        # different baselines share one engine without thrashing
+        self._pull_key: Optional[tuple] = None
+        self._pull_docs: dict = {}
+        self._bp_cache: Tuple[Optional[tuple], Optional[dict]] = (
             None, None,
         )
-        self._bp_cache: Tuple[Optional[int], Optional[dict]] = (
-            None, None,
-        )
+        # delta chain: the canonical table at the last pulled version
+        # plus a bounded ring of version-to-version changed-row segments
+        self._chain_epoch: Optional[int] = None
+        self._chain_version: int = -1
+        self._chain_lab: Optional[np.ndarray] = None
+        self._chain_n: int = 0
+        self._ring: deque = deque(maxlen=DELTA_RING)
 
     # -- table access (per-version host cache on the host path) -------- #
     def _table(self, snap: PublishedSnapshot, key: str):
         """The payload table, as a host array (host path, cached per
-        snapshot version) or the device array as-is (device path)."""
+        snapshot (epoch, version)) or the device array as-is (device
+        path)."""
         table = snap.payload[key]
         if not self.prefer_host:
             return table
-        ck = (snap.version, key)
+        ck = (snap.epoch, snap.version, key)
         cached = self._host_cache.get(ck)
         if cached is None:
             # np.asarray waits for THIS array's producer, not the whole
@@ -319,7 +451,7 @@ class QueryEngine:
         canon = self._table(snap, "labels")
         vdict = snap.payload["vdict"]
         cv = _lookup_batch(vdict, vs)
-        key = (snap.version, id(snap.payload["labels"]))
+        key = (snap.epoch, snap.version, id(snap.payload["labels"]))
         cached_key, lab, sizes = self._size_cache
         if cached_key != key:
             if self.prefer_host:
@@ -346,46 +478,131 @@ class QueryEngine:
             )[: len(cv)]
         return np.where(valid, out, 0).astype(np.int64)
 
-    def summary_pull(self, snap: PublishedSnapshot) -> dict:
+    def summary_pull(
+        self, snap: PublishedSnapshot, since_version: int = -1
+    ) -> dict:
         """The snapshot's CC forest as a mergeable raw-id summary (the
-        :class:`SummaryPullQuery` answer value)::
+        :class:`SummaryPullQuery` answer value; wire shape in
+        :func:`encode_pull_doc`).
 
-            {"n": slots, "u64": b64(int64 raw ids),
-             "r64": b64(int64 root raw ids)}
-
-        Slot coverage is what the payload's vertex dict can decode
-        (``len(vdict)`` slots): the shard's SEEN keyspace. Deployments
-        that want untouched in-bound ids to count as singletons (the
+        ``since_version < 0`` answers the FULL table — slot coverage is
+        what the payload's vertex dict can decode (``len(vdict)``
+        slots): the shard's SEEN keyspace. Deployments that want
+        untouched in-bound ids to count as singletons (the
         ``IdentityDict`` single-host semantics) observe their bound up
-        front, like the serving demos do. Cached per snapshot version —
-        the O(vcap) canonicalize + decode runs once however many
-        routers pull."""
-        import base64
+        front, like the serving demos do.
 
-        ver, cached = self._pull_cache
-        if ver == snap.version and cached is not None:
-            return cached
+        ``since_version >= 0`` asks for only the rows whose root
+        assignment changed since that version. The engine maintains a
+        delta CHAIN: per pulled version it diffs the canonical table
+        against the previous one over the TouchLog-seen candidate set
+        (root changes only ever land on vertices some edge touched) and
+        keeps the last :data:`DELTA_RING` segments. A covered
+        ``since_version`` answers the deduped union of the covering
+        segments (newest root per raw id); an uncovered one degrades
+        honestly to a full reply tagged ``why`` (stale ring, no chain,
+        or a puller ahead of this store — the restarted-shard case).
+        Stale rows across segments stay sound to merge because the
+        stream is add-only: a ``(vertex, root)`` pair once true is a
+        connectivity fact forever. Docs are cached per
+        ``(epoch, version, since)`` — the O(vcap) canonicalize + decode
+        runs once however many routers pull."""
+        key = (snap.epoch, snap.version)
+        if self._pull_key != key:
+            self._advance_chain(snap)
+            self._pull_key = key
+            self._pull_docs = {}
+        since = int(since_version)
+        eff = since if since >= 0 else -1
+        cached = self._pull_docs.get(eff)
+        if cached is None:
+            cached = self._build_pull_doc(snap, eff)
+            self._pull_docs[eff] = cached
+        return cached
+
+    def _advance_chain(self, snap: PublishedSnapshot) -> None:
+        """Canonicalize this snapshot's forest and record the changed
+        rows since the previous pulled version as one ring segment.
+        Resets the chain (no segment) on a store swap — a new epoch or
+        a version that went BACKWARD means the diff base is gone."""
         from ..summaries.forest import resolve_flat_host
 
         canon = np.asarray(self._table(snap, "labels"))
         vdict = snap.payload["vdict"]
         lab = resolve_flat_host(canon)
         n = min(int(lab.shape[0]), len(vdict))
+        if (
+            self._chain_lab is None
+            or self._chain_epoch != snap.epoch
+            or snap.version < self._chain_version
+        ):
+            self._ring.clear()
+        else:
+            n_old = self._chain_n
+            old = self._chain_lab
+            if "tids" in snap.payload:
+                # the TouchLog novelty shadow bounds the diff: a root
+                # can only change on a vertex some edge ever touched
+                cand = np.asarray(
+                    snap.payload["tids"][: snap.payload["tcount"]],
+                    np.int64,
+                )
+                cand = cand[cand < n_old]
+            else:
+                cand = np.arange(n_old, dtype=np.int64)
+            changed = cand[lab[cand] != old[cand]]
+            if n > n_old:
+                changed = np.concatenate(
+                    [changed, np.arange(n_old, n, dtype=np.int64)]
+                )
+            changed = np.unique(changed)
+            raws = np.asarray(vdict.decode(changed), np.int64)
+            roots = np.asarray(
+                vdict.decode(lab[changed].astype(np.int64)), np.int64
+            )
+            self._ring.append(
+                {"base": self._chain_version, "to": snap.version,
+                 "u": raws, "r": roots}
+            )
+        self._chain_epoch = snap.epoch
+        self._chain_version = snap.version
+        self._chain_lab = np.array(lab, copy=True)
+        self._chain_n = n
+
+    def _build_pull_doc(self, snap: PublishedSnapshot, since: int) -> dict:
+        vdict = snap.payload["vdict"]
+        lab = self._chain_lab
+        n = self._chain_n
+        why = None
+        if since >= 0:
+            if since > snap.version:
+                why = "ahead"
+            elif since == snap.version:
+                empty = np.zeros(0, np.int64)
+                return encode_pull_doc(
+                    empty, empty, kind="delta", base=since
+                )
+            else:
+                segs = [s for s in self._ring if s["to"] > since]
+                if segs and segs[0]["base"] <= since:
+                    # newest-first concat + unique keeps the NEWEST
+                    # root per raw id (unique returns first occurrence)
+                    ru = np.concatenate(
+                        [s["u"] for s in reversed(segs)])
+                    rr = np.concatenate(
+                        [s["r"] for s in reversed(segs)])
+                    _, idx = np.unique(ru, return_index=True)
+                    return encode_pull_doc(
+                        ru[idx], rr[idx], kind="delta", base=since
+                    )
+                why = "stale" if self._ring else "no_chain"
         slots = np.arange(n, dtype=np.int64)
         raws = np.asarray(vdict.decode(slots), np.int64)
         # min-rooted invariant: lab[i] <= i, so every root of the first
         # n slots is itself within the first n slots
         roots = np.asarray(vdict.decode(lab[:n].astype(np.int64)),
                            np.int64)
-        doc = {
-            "n": int(n),
-            "u64": base64.b64encode(
-                np.ascontiguousarray(raws).tobytes()).decode("ascii"),
-            "r64": base64.b64encode(
-                np.ascontiguousarray(roots).tobytes()).decode("ascii"),
-        }
-        self._pull_cache = (snap.version, doc)
-        return doc
+        return encode_pull_doc(raws, roots, kind="full", why=why)
 
     def bipartite(self, snap: PublishedSnapshot) -> dict:
         """The :class:`BipartiteQuery` answer value (see its docstring).
@@ -398,8 +615,9 @@ class QueryEngine:
         restored-checkpoint shape). Cached per snapshot version: the
         O(vcap) canonicalize + conflict scan runs once however many
         clients ask."""
+        bkey = (snap.epoch, snap.version)
         ver, cached = self._bp_cache
-        if ver == snap.version and cached is not None:
+        if ver == bkey and cached is not None:
             return cached
         from ..summaries.forest import resolve_flat_host
 
@@ -423,7 +641,7 @@ class QueryEngine:
             doc = {"bipartite": False, "witness": witness}
         else:
             doc = {"bipartite": True, "witness": None}
-        self._bp_cache = (snap.version, doc)
+        self._bp_cache = (bkey, doc)
         return doc
 
     def degree(self, snap: PublishedSnapshot, vs: np.ndarray) -> np.ndarray:
@@ -474,13 +692,17 @@ class QueryEngine:
                     f"not serve {qcls.__name__}"
                 )
             if qcls in (SummaryPullQuery, BipartiteQuery):
-                # one cached doc answers the whole group (dict-valued,
-                # so it bypasses the ndarray tail below)
-                doc = (
-                    self.summary_pull(snap)
-                    if qcls is SummaryPullQuery else self.bipartite(snap)
-                )
+                # cached docs answer the whole group (dict-valued, so
+                # they bypass the ndarray tail below); pulls key the
+                # cache per since_version, so mixed baselines in one
+                # batch still cost one canonicalization
                 for i in idxs:
+                    doc = (
+                        self.summary_pull(
+                            snap, queries[i].since_version)
+                        if qcls is SummaryPullQuery
+                        else self.bipartite(snap)
+                    )
                     out[i] = Answer(
                         value=doc, window=snap.window,
                         watermark=snap.watermark, staleness=staleness,

@@ -57,7 +57,7 @@ front end over the fan-out), the shape the sharded bench deploys.
 
 from __future__ import annotations
 
-import base64
+import os
 import threading
 import time
 from collections import OrderedDict, deque
@@ -76,9 +76,11 @@ from .query import (
     ComponentSizeQuery,
     ConnectedQuery,
     DegreeQuery,
+    MalformedPull,
     Query,
     RankQuery,
     SummaryPullQuery,
+    decode_pull_doc,
 )
 from .server import Overloaded
 
@@ -91,27 +93,28 @@ ROUTED_CLASSES = (
     ConnectedQuery, ComponentSizeQuery, DegreeQuery, RankQuery,
 )
 
+#: wire bytes per pulled (vertex, root) row — two packed int64 columns
+PULL_ROW_BYTES = 16
 
-def _b64_i64(s: str) -> np.ndarray:
-    return np.frombuffer(base64.b64decode(s), dtype="<i8")
+#: how many delta refreshes the selective-invalidation history spans; a
+#: cache entry stamped further back than the ring reaches invalidates
+#: the old blanket way instead of revalidating
+DELTA_HIST = 64
 
 
-def decode_pull(doc: dict) -> Tuple[np.ndarray, np.ndarray]:
+def decode_pull(doc: dict) -> dict:
     """Decode a :meth:`~.query.QueryEngine.summary_pull` answer value
-    into ``(raw vertex ids, raw root ids)`` int64 columns. Raises
-    ``ValueError`` on a malformed doc (wrong length vs ``n``, missing
-    keys) — a torn summary must never silently merge as empty."""
-    if not isinstance(doc, dict):
-        raise ValueError(f"summary pull answered {type(doc).__name__}")
-    n = int(doc["n"])
-    u = _b64_i64(doc["u64"])
-    r = _b64_i64(doc["r64"])
-    if len(u) != n or len(r) != n:
-        raise ValueError(
-            f"summary pull geometry mismatch: n={n}, got "
-            f"{len(u)}/{len(r)} ids"
-        )
-    return u, r
+    (see :func:`~.query.decode_pull_doc` for the decoded shape). Raises
+    :class:`~.query.MalformedPull` (a ``ValueError``) on a malformed
+    doc — a torn summary must never silently merge as empty — and
+    counts the rejection under ``router.pull_malformed{kind}`` so a
+    misbehaving shard's failure CLASS (geometry vs base64 vs missing
+    keys...) is visible, not just a generic pull error."""
+    try:
+        return decode_pull_doc(doc)
+    except MalformedPull as e:
+        get_registry().counter("router.pull_malformed", kind=e.kind).inc()
+        raise
 
 
 class _Entry:
@@ -158,16 +161,105 @@ class _Group:
 class _CacheEntry:
     """``owner`` is the key's owning shard for owner-routed classes
     (so validity checks one version slot without re-hashing), None for
-    router-merged classes (validity checks the whole vector)."""
+    router-merged classes (validity checks the whole vector).
+    ``roots`` (merged-CC entries only) records the RAW root ids the
+    answer depended on — the selective-invalidation key: a delta
+    refresh whose touched-component set misses every root PROVES the
+    cached answer still holds at the new version vector."""
 
-    __slots__ = ("ans", "vers", "ts", "owner")
+    __slots__ = ("ans", "vers", "ts", "owner", "roots")
 
     def __init__(self, ans: Answer, vers: tuple, ts: float,
-                 owner: Optional[int]):
+                 owner: Optional[int], roots: Optional[frozenset] = None):
         self.ans = ans
         self.vers = vers
         self.ts = ts
         self.owner = owner
+        self.roots = roots
+
+
+class _MergedCC:
+    """The router's carried cross-shard merged forest.
+
+    Built by a full rebuild
+    (:func:`~gelly_streaming_tpu.summaries.forest.merge_forest_tables_host`
+    over the per-shard tables) and then kept CURRENT by
+    :func:`~gelly_streaming_tpu.summaries.forest.apply_forest_delta_host`
+    over delta-pull rows — O(changed) per refresh. Dense ids are the
+    sorted position in ``uniq`` (the raw-id union at rebuild time);
+    raw ids first seen in a later delta append PAST the base (``extra``
+    maps them, ``raw_of`` inverts) with amortized-doubling growth, so
+    between rebuilds nothing is re-sorted. ``lab`` stays min-rooted
+    (``lab[v] <= v`` — sorted raw order preserves the invariant) but
+    not necessarily flat between rebuilds: readers chase roots.
+    All access is under the router's ``_mlock``."""
+
+    __slots__ = ("uniq", "extra", "lab", "sizes", "raw_of", "n",
+                 "meta", "stamp")
+
+    def __init__(self, uniq: np.ndarray, lab: np.ndarray,
+                 sizes: np.ndarray, meta: tuple, stamp: tuple):
+        self.uniq = uniq
+        self.extra: dict = {}
+        self.lab = np.asarray(lab, np.int64)
+        self.sizes = np.asarray(sizes, np.int64)
+        self.raw_of = np.asarray(uniq, np.int64).copy()
+        self.n = len(uniq)
+        self.meta = meta
+        self.stamp = stamp
+
+    def lookup(self, raw: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(dense index, found mask); consults the post-rebuild extras
+        for ids the sorted base predates."""
+        i, f = ShardRouter._lookup(self.uniq, raw)
+        if self.extra:
+            for j in np.nonzero(~f)[0].tolist():
+                d = self.extra.get(int(raw[j]))
+                if d is not None:
+                    i[j] = d
+                    f[j] = True
+        return i, f
+
+    def ensure_ids(self, raw: np.ndarray) -> np.ndarray:
+        """Dense ids for ``raw``, allocating self-rooted singleton slots
+        for ids never seen before (a delta's brand-new vertices)."""
+        i, f = self.lookup(raw)
+        for j in np.nonzero(~f)[0].tolist():
+            rid = int(raw[j])
+            d = self.extra.get(rid)
+            if d is None:
+                d = self.n
+                self._grow(d + 1)
+                self.lab[d] = d
+                self.sizes[d] = 1
+                self.raw_of[d] = rid
+                self.extra[rid] = d
+                self.n = d + 1
+            i[j] = d
+        return i
+
+    def roots(self, idx: np.ndarray) -> np.ndarray:
+        """Batch root chase (the table may be non-flat between full
+        rebuilds; chains stay short via the delta path's halving)."""
+        r = self.lab[idx]
+        while True:
+            nxt = self.lab[r]
+            if np.array_equal(nxt, r):
+                return r
+            r = nxt
+
+    def _grow(self, need: int) -> None:
+        cap = len(self.lab)
+        if need <= cap:
+            return
+        new = max(need, 2 * cap, 8)
+        lab2 = np.arange(new, dtype=np.int64)
+        lab2[:cap] = self.lab
+        sizes2 = np.ones(new, np.int64)
+        sizes2[:cap] = self.sizes
+        raw2 = np.full(new, -1, np.int64)
+        raw2[:cap] = self.raw_of
+        self.lab, self.sizes, self.raw_of = lab2, sizes2, raw2
 
 
 class ShardRouter:
@@ -214,6 +306,7 @@ class ShardRouter:
         seed: int = 0,
         autotune: bool = False,
         target_wait_s: Optional[float] = None,
+        delta: bool = True,
     ):
         if not shard_addrs:
             raise ValueError("at least one shard address is required")
@@ -252,22 +345,36 @@ class ShardRouter:
         self._inflight = 0
         self._wake = threading.Event()
         self._closing = False
+        #: pull protocol v2 (ISSUE 17): send ``since_version`` once a
+        #: baseline exists, apply delta replies incrementally, and
+        #: retain provably-untouched cache entries across refreshes;
+        #: False pins the v1 full-re-pull behavior (the bench baseline)
+        self.delta = bool(delta)
         # merged cross-shard CC state (all under _mlock)
         self._mlock = threading.Lock()
         self._vers = [0] * self.nshards       # newest observed version
         self._pulled_vers = [-1] * self.nshards
         self._pairs: list = [None] * self.nshards   # (u_raw, r_raw)
+        self._rows: list = [None] * self.nshards    # raw -> root carry
         self._pull_meta: list = [None] * self.nshards  # (win, wm, stale)
         self._pulls: dict = {}                # shard -> in-flight pull
         self._pull_err: list = [None] * self.nshards
         self._cc_waiting: list = []           # jobs parked on pulls
-        self._merged = None                   # (uniq, lab, sizes, meta)
+        self._merged: Optional[_MergedCC] = None
+        # delta rows accepted since the last merged refresh, and
+        # whether any full reply forces the next refresh to rebuild
+        self._delta_pending: list = []        # (u_raw, r_raw) batches
+        self._full_pending = False
+        # (from_stamp, to_stamp, touched raw roots) per delta refresh —
+        # the chain a stale cache entry revalidates against
+        self._delta_hist: deque = deque(maxlen=DELTA_HIST)
         # hot-path instruments resolved once (a cache hit should cost
         # a dict probe + a counter bump, not two registry lookups)
         reg = get_registry()
         self._c_hits = reg.counter("router.cache_hits")
         self._c_misses = reg.counter("router.cache_misses")
         self._c_inval = reg.counter("router.cache_invalidations")
+        self._c_retained = reg.counter("router.cache_retained")
         self._worker = threading.Thread(
             target=self._run, name="shard-router", daemon=True
         )
@@ -392,22 +499,41 @@ class ShardRouter:
 
     def stats_snapshot(self) -> dict:
         """Router counters as a plain dict (cache hit/miss/invalidation
-        evidence the bench commits)."""
+        and full-vs-delta refresh evidence the bench commits)."""
         reg = get_registry()
 
-        def _count(name: str) -> int:
-            return int(sum(i.value for _l, i in reg.find(name)))
+        def _count(name: str, **labels) -> float:
+            return float(sum(
+                i.value for l, i in reg.find(name)
+                if all(l.get(k) == v for k, v in labels.items())
+            ))
 
         return {
             "pending": self.pending(),
-            "cache_hits": _count("router.cache_hits"),
-            "cache_misses": _count("router.cache_misses"),
-            "cache_invalidations": _count("router.cache_invalidations"),
-            "fanouts": _count("router.fanouts"),
-            "pulls": _count("router.pulls"),
-            "pull_errors": _count("router.pull_errors"),
-            "stale_merges": _count("router.stale_merges"),
-            "rejected": _count("router.rejected"),
+            "cache_hits": int(_count("router.cache_hits")),
+            "cache_misses": int(_count("router.cache_misses")),
+            "cache_invalidations":
+                int(_count("router.cache_invalidations")),
+            "cache_retained": int(_count("router.cache_retained")),
+            "fanouts": int(_count("router.fanouts")),
+            "pulls": int(_count("router.pulls")),
+            "pull_errors": int(_count("router.pull_errors")),
+            "pull_malformed": int(_count("router.pull_malformed")),
+            "stale_merges": int(_count("router.stale_merges")),
+            "rejected": int(_count("router.rejected")),
+            # protocol v2 evidence: reply-frame mix, pulled volume, and
+            # the router-side merge-refresh cost split by kind
+            "delta_pulls": int(_count("router.delta_pulls")),
+            "delta_rows": int(_count("router.delta_rows")),
+            "full_fallbacks": int(_count("router.full_fallbacks")),
+            "pull_bytes_full":
+                int(_count("router.pull_bytes", kind="full")),
+            "pull_bytes_delta":
+                int(_count("router.pull_bytes", kind="delta")),
+            "merges_full": int(_count("router.merges", kind="full")),
+            "merges_delta": int(_count("router.merges", kind="delta")),
+            "merge_s_full": _count("router.merge_s", kind="full"),
+            "merge_s_delta": _count("router.merge_s", kind="delta"),
         }
 
     # ------------------------------------------------------------------ #
@@ -588,8 +714,17 @@ class ShardRouter:
                 self._cc_waiting.append(entries)
                 for s in stale:
                     if s not in self._pulls:
-                        self._pulls[s] = True
-                        to_pull.append(s)
+                        # protocol v2: once a baseline table is carried
+                        # for the shard, ask for only the rows changed
+                        # since it; -1 (v1 shape) pulls the full table
+                        since = (
+                            self._pulled_vers[s]
+                            if self.delta and self._pulled_vers[s] >= 0
+                            and self._rows[s] is not None else -1
+                        )
+                        self._pulls[s] = {"since": since, "t0": 0.0,
+                                          "grp": None}
+                        to_pull.append((s, since))
         if ready:
             self._answer_cc(entries)
             return
@@ -608,7 +743,7 @@ class ShardRouter:
         # first TRACED entry's group (a shared refresh has one causal
         # home, and an untraced head entry must not orphan the join)
         grp = next((e.grp for e in entries if e.grp is not None), None)
-        for s in to_pull:
+        for s, since in to_pull:
             get_registry().counter("router.pulls").inc()
             ctx2 = None
             if grp is not None:
@@ -616,9 +751,15 @@ class ShardRouter:
                 ctx2 = _trace.TraceContext(
                     trace_id=grp.ctx.trace_id, parent_sid=grp.sid
                 )
+            # the reply callback reads this to attribute the pull span
+            # and detect full-reply fallbacks (assignment is atomic;
+            # the placeholder above already holds the pull slot)
+            self._pulls[s] = {"since": since,
+                              "t0": time.perf_counter(), "grp": grp}
             try:
                 fut = self._clients[s].submit(
-                    SummaryPullQuery(), deadline_s=remaining, ctx=ctx2,
+                    SummaryPullQuery(since_version=since),
+                    deadline_s=remaining, ctx=ctx2,
                 )
             except BaseException as exc:
                 self._pull_done(s, _FailedFuture(exc))
@@ -627,15 +768,62 @@ class ShardRouter:
 
     def _pull_done(self, shard: int, fut) -> None:
         jobs: list = []
+        reg = get_registry()
+        span = None   # (grp, t0, kind, rows, since)
+        never: list = []
         with self._mlock:
-            self._pulls.pop(shard, None)
+            info = self._pulls.pop(shard, None) or {}
+            since = int(info.get("since", -1))
             exc = fut.exception()
             if exc is None:
                 try:
                     ans = fut.result()
-                    u, r = decode_pull(ans.value)
+                    dec = decode_pull(ans.value)
                     v = int(ans.version)
-                    self._pairs[shard] = (u, r)
+                    if dec["kind"] == "delta":
+                        if (self._rows[shard] is None
+                                or dec["base"] !=
+                                self._pulled_vers[shard]):
+                            # a delta against a baseline this router no
+                            # longer holds (restart adoption raced the
+                            # reply) cannot be applied
+                            raise MalformedPull(
+                                "base",
+                                f"delta pull base {dec['base']} does "
+                                f"not match the carried baseline "
+                                f"{self._pulled_vers[shard]}",
+                            )
+                        reg.counter("router.delta_pulls").inc()
+                        reg.counter("router.delta_rows").inc(dec["n"])
+                        reg.counter("router.pull_bytes",
+                                    kind="delta").inc(
+                            PULL_ROW_BYTES * dec["n"])
+                        # the delta lists EVERY row whose root changed,
+                        # so a plain update keeps the carried table
+                        # exact (not merely approximate)
+                        self._rows[shard].update(
+                            zip(dec["u"].tolist(), dec["r"].tolist()))
+                        self._delta_pending.append((dec["u"], dec["r"]))
+                    else:
+                        reg.counter("router.pull_bytes",
+                                    kind="full").inc(
+                            PULL_ROW_BYTES * dec["n"])
+                        if since >= 0:
+                            # we asked for a delta and got the whole
+                            # table: an honest degrade (stale ring, no
+                            # chain, restarted store) or a v1 peer
+                            # that never read the field — either way
+                            # the baseline resets to this full table
+                            reg.counter(
+                                "router.full_fallbacks",
+                                reason=dec["why"] or "peer_full",
+                            ).inc()
+                        self._pairs[shard] = (dec["u"], dec["r"])
+                        if self.delta:
+                            self._rows[shard] = dict(
+                                zip(dec["u"].tolist(),
+                                    dec["r"].tolist()))
+                        self._full_pending = True
                     self._pulled_vers[shard] = v
                     self._pull_meta[shard] = (
                         int(ans.window), int(ans.watermark),
@@ -649,31 +837,57 @@ class ShardRouter:
                         # the pull itself met a restarted sequence
                         # (promoted standby): adopt it — pulled_vers
                         # already records the new sequence's version
-                        get_registry().counter(
+                        reg.counter(
                             "router.shard_restarts", shard=str(shard)
                         ).inc()
                         self._vers[shard] = v
+                    if info.get("grp") is not None:
+                        span = (info["grp"], float(info.get("t0", 0.0)),
+                                dec["kind"], int(dec["n"]), since)
                 except (ValueError, KeyError, TypeError) as e:
                     exc = e
             if exc is not None:
-                get_registry().counter(
+                reg.counter(
                     "router.pull_errors", shard=str(shard)
                 ).inc()
                 self._pull_err[shard] = exc
-                if self._pairs[shard] is not None:
+                if self._shard_cols(shard) is not None:
                     # a previous pull exists: the merge proceeds on the
                     # stale summary (bounded-staleness availability)
-                    get_registry().counter("router.stale_merges").inc()
-            if self._pulls:
-                return  # later pulls complete the rendezvous
-            never = [
-                s for s in range(self.nshards)
-                if self._pairs[s] is None
-            ]
-            if not never:
-                self._rebuild_merged_locked()
-            jobs = self._cc_waiting
-            self._cc_waiting = []
+                    reg.counter("router.stale_merges").inc()
+            pending_more = bool(self._pulls)
+            if not pending_more:
+                never = [
+                    s for s in range(self.nshards)
+                    if self._shard_cols(s) is None
+                ]
+                if not never:
+                    t0m = time.perf_counter()
+                    if (self.delta and self._merged is not None
+                            and not self._full_pending):
+                        self._apply_deltas_locked()
+                        kind = "delta"
+                    else:
+                        self._rebuild_merged_locked()
+                        kind = "full"
+                    reg.counter("router.merges", kind=kind).inc()
+                    reg.counter("router.merge_s", kind=kind).inc(
+                        time.perf_counter() - t0m)
+                jobs = self._cc_waiting
+                self._cc_waiting = []
+        if span is not None:
+            grp, t0, kind, rows, since = span
+            _trace.record_span(
+                "serving.router.pull",
+                time.perf_counter() - t0,
+                trace_id=grp.ctx.trace_id,
+                parent=grp.sid,
+                sid=_trace.next_sid(),
+                attrs={"shard": shard, "kind": kind, "rows": rows,
+                       "since": since},
+            )
+        if pending_more:
+            return  # later pulls complete the rendezvous
         if never:
             # a shard that never delivered ANY summary cannot be merged
             # around: exactness over availability at boot — fail these
@@ -691,72 +905,144 @@ class ShardRouter:
         for entries in jobs:
             self._answer_cc(entries)
 
+    def _shard_cols(self, s: int):
+        """This shard's current (raw, root) columns — the delta-carried
+        row table when present (always current: full replies replace
+        it, delta replies patch it exactly), else the last full pull's
+        columns; None when the shard never delivered."""
+        d = self._rows[s]
+        if d is not None:
+            u = np.fromiter(d.keys(), np.int64, len(d))
+            r = np.fromiter(d.values(), np.int64, len(d))
+            return u, r
+        return self._pairs[s]
+
+    def _meta_locked(self) -> tuple:
+        """Merged answer meta from the newest per-shard pulls (caller
+        holds ``_mlock``): MIN window (conservative progress), summed
+        watermark, MAX staleness, summed versions."""
+        metas = [m for m in self._pull_meta if m is not None]
+        return (
+            min(m[0] for m in metas) if metas else -1,
+            sum(m[1] for m in metas),
+            max(m[2] for m in metas) if metas else 0,
+            sum(max(0, v) for v in self._pulled_vers),
+        )
+
     def _rebuild_merged_locked(self) -> None:
-        """Rebuild the merged forest from the newest per-shard pulls.
+        """Rebuild the merged forest from the carried per-shard tables.
         Caller holds ``_mlock``. Each shard's raw-id pairs densify into
         a forest table over the UNION id space (sorted raw order
         preserves the min-rooted invariant), and one
         :func:`~gelly_streaming_tpu.summaries.forest.merge_forest_tables_host`
-        call — THE cross-shard union step — merges them all."""
+        call — THE cross-shard union step — merges them all. Resets the
+        delta bookkeeping: pending rows are already folded into the
+        carried tables, and the selective-invalidation history cannot
+        chain across a rebuild."""
         from ..summaries.forest import merge_forest_tables_host
 
-        us = [p[0] for p in self._pairs]
+        cols = [self._shard_cols(s) for s in range(self.nshards)]
+        us = [c[0] for c in cols]
         uniq = np.unique(np.concatenate(us)) if us else \
             np.zeros(0, np.int64)
         n = len(uniq)
         tables = []
-        for u, r in self._pairs:
+        for u, r in cols:
             t = np.arange(n, dtype=np.int64)
             t[np.searchsorted(uniq, u)] = np.searchsorted(uniq, r)
             tables.append(t)
         lab = merge_forest_tables_host(tables)
         sizes = np.bincount(lab, minlength=n) if n else \
             np.zeros(0, np.int64)
-        metas = [m for m in self._pull_meta if m is not None]
-        meta = (
-            min(m[0] for m in metas) if metas else -1,   # window
-            sum(m[1] for m in metas),                     # watermark
-            max(m[2] for m in metas) if metas else 0,     # staleness
-            sum(max(0, v) for v in self._pulled_vers),    # version
+        self._merged = _MergedCC(
+            uniq, lab, sizes, self._meta_locked(),
+            tuple(self._pulled_vers),
         )
-        self._merged = (uniq, lab, sizes, meta,
-                        tuple(self._pulled_vers))
+        self._delta_pending = []
+        self._delta_hist.clear()
+        self._full_pending = False  # graftlint: disable=GL002 (caller holds _mlock — the _locked suffix is the contract, enforced by every call site sitting inside a `with self._mlock:` block)
+
+    def _apply_deltas_locked(self) -> None:
+        """Fold the delta rows accepted since the last refresh into the
+        carried merged forest — O(changed rows), the refresh cost the
+        delta protocol buys — and record which components they touched
+        so provably-untouched cache entries survive the version bump.
+        Caller holds ``_mlock``; requires ``self._merged``."""
+        from ..summaries.forest import apply_forest_delta_host
+
+        m = self._merged
+        from_stamp = m.stamp
+        touched: set = set()
+        for u, r in self._delta_pending:
+            if not len(u):
+                continue
+            iu = m.ensure_ids(u)
+            ir = m.ensure_ids(r)
+            t = apply_forest_delta_host(m.lab, m.sizes, iu, ir)
+            if len(t):
+                touched.update(m.raw_of[t].tolist())
+        self._delta_pending = []
+        m.meta = self._meta_locked()
+        stamp = tuple(self._pulled_vers)
+        if stamp != from_stamp:
+            self._delta_hist.append(
+                (from_stamp, stamp, frozenset(touched)))
+        m.stamp = stamp
 
     def _answer_cc(self, entries: List[_Entry]) -> None:
-        with self._mlock:
-            uniq, lab, sizes, meta, stamp = self._merged
-        window, watermark, staleness, version = meta
         qs = [e.q for e in entries]
         conn_idx = [i for i, q in enumerate(qs)
                     if isinstance(q, ConnectedQuery)]
         size_idx = [i for i, q in enumerate(qs)
                     if isinstance(q, ComponentSizeQuery)]
         vals: dict = {}
-        if conn_idx:
-            us = np.asarray([qs[i].u for i in conn_idx], np.int64)
-            vs = np.asarray([qs[i].v for i in conn_idx], np.int64)
-            iu, fu = self._lookup(uniq, us)
-            iv, fv = self._lookup(uniq, vs)
-            ok = fu & fv
-            same = lab[iu] == lab[iv]
-            # an unseen vertex is its own singleton — connected only to
-            # itself (the single-host engine's exact semantics)
-            got = np.where(ok, same, us == vs)
-            for i, v in zip(conn_idx, got.tolist()):
-                vals[i] = bool(v)
-        if size_idx:
-            vs = np.asarray([qs[i].v for i in size_idx], np.int64)
-            iv, fv = self._lookup(uniq, vs)
-            got = np.where(fv, sizes[lab[iv]], 0)
-            for i, v in zip(size_idx, got.tolist()):
-                vals[i] = int(v)
+        roots_of: dict = {}
+        # compute under _mlock: the carried forest mutates IN PLACE on
+        # delta refreshes (unlike the old swap-a-tuple rebuild), so
+        # reads must not interleave with an apply
+        with self._mlock:
+            m = self._merged
+            meta, stamp = m.meta, m.stamp
+            if conn_idx:
+                us = np.asarray([qs[i].u for i in conn_idx], np.int64)
+                vs = np.asarray([qs[i].v for i in conn_idx], np.int64)
+                iu, fu = m.lookup(us)
+                iv, fv = m.lookup(vs)
+                ok = fu & fv
+                ru = m.roots(np.where(fu, iu, 0))
+                rv = m.roots(np.where(fv, iv, 0))
+                # an unseen vertex is its own singleton — connected
+                # only to itself (the single-host engine's semantics)
+                got = np.where(ok, ru == rv, us == vs)
+                rud, rvd = m.raw_of[ru], m.raw_of[rv]
+                for k, i in enumerate(conn_idx):
+                    vals[i] = bool(got[k])
+                    # the RAW roots this answer depends on; an unseen
+                    # endpoint's own id stands in (if it ever appears
+                    # and merges, it shows up in a touched set)
+                    roots_of[i] = frozenset((
+                        int(rud[k]) if fu[k] else int(us[k]),
+                        int(rvd[k]) if fv[k] else int(vs[k]),
+                    ))
+            if size_idx:
+                vs = np.asarray([qs[i].v for i in size_idx], np.int64)
+                iv, fv = m.lookup(vs)
+                rv = m.roots(np.where(fv, iv, 0))
+                got = np.where(fv, m.sizes[rv], 0)
+                rvd = m.raw_of[rv]
+                for k, i in enumerate(size_idx):
+                    vals[i] = int(got[k])
+                    roots_of[i] = frozenset(
+                        (int(rvd[k]) if fv[k] else int(vs[k]),))
+        window, watermark, staleness, version = meta
         for i, e in enumerate(entries):
             ans = Answer(
                 value=vals[i], window=window, watermark=watermark,
                 staleness=staleness, version=version,
             )
             if self.cache_enabled:
-                self._cache_put(e.key, ans, stamp)
+                self._cache_put(e.key, ans, stamp,
+                                roots=roots_of.get(i))
             self._settle(e, ans=ans)
 
     @staticmethod
@@ -799,23 +1085,57 @@ class ShardRouter:
             else tuple(self._vers)
         )
         if entry.vers != expected:
-            # a reply frame observed a newer shard version than this
-            # answer was computed from: lazily invalidate (counted) —
-            # the next miss re-fans-out / re-pulls at the new version
-            with self._lock:
-                self._cache.pop(key, None)
-            self._c_inval.inc()
-            return None
+            if (entry.owner is None and entry.roots is not None
+                    and self.delta and self._revalidate(entry)):
+                # the delta history proves every component this answer
+                # depends on was untouched by the intervening refreshes
+                self._c_retained.inc()
+            else:
+                # a reply frame observed a newer shard version than
+                # this answer was computed from: lazily invalidate
+                # (counted) — the next miss re-fans-out / re-pulls at
+                # the new version
+                with self._lock:
+                    self._cache.pop(key, None)
+                self._c_inval.inc()
+                return None
         with self._lock:
             if key in self._cache:
                 self._cache.move_to_end(key)
         return entry.ans
 
+    def _revalidate(self, entry: _CacheEntry) -> bool:
+        """Selective invalidation: walk the delta-refresh history from
+        the entry's stamp to the carried forest's current stamp. If no
+        hop's touched-component set intersects the entry's roots, the
+        answer provably still holds — re-stamp it and keep it."""
+        with self._mlock:
+            m = self._merged
+            if m is None or tuple(self._vers) != m.stamp:
+                return False   # a refresh is in flight; stay lazy
+            v = entry.vers
+            hops = 0
+            while v != m.stamp:
+                nxt = None
+                for h in self._delta_hist:
+                    if h[0] == v:
+                        nxt = h
+                        break
+                if nxt is None or entry.roots & nxt[2]:
+                    return False
+                v = nxt[1]
+                hops += 1
+                if hops > len(self._delta_hist):
+                    return False   # defensive: broken chain
+            entry.vers = m.stamp
+            return True
+
     def _cache_put(self, key: tuple, ans: Answer, vers: tuple,
-                   owner: Optional[int] = None) -> None:
+                   owner: Optional[int] = None,
+                   roots: Optional[frozenset] = None) -> None:
         with self._lock:
             self._cache[key] = _CacheEntry(
-                ans, vers, time.monotonic(), owner
+                ans, vers, time.monotonic(), owner, roots
             )
             self._cache.move_to_end(key)
             while len(self._cache) > self.cache_cap:
@@ -978,13 +1298,30 @@ def shard_demo_payloads(
     shard: int = 0,
     nshards: int = 1,
     pace_s: float = 0.0,
+    churn_bumps: int = 0,
+    churn_edges: int = 0,
+    churn_seed: int = 1000,
+    churn_pace_s: float = 0.0,
+    churn_gate: Optional[str] = None,
 ):
     """One shard's servable: fold the edges this shard OWNS
     (:func:`~gelly_streaming_tpu.core.ingest.partition_edges_by_vertex`)
     into a live min-rooted CC forest + degree table, one snapshot per
     count window. ``nshards=1`` is the single-host oracle — the same
     code folding the WHOLE stream, which is what the identity tests and
-    the bench baseline serve from."""
+    the bench baseline serve from.
+
+    After the main stream, ``churn_bumps`` extra versions each fold
+    this shard's slice of ``churn_edges`` global edges drawn from
+    ``churn_seed`` — a low-rate live-ingest tail the delta-pull churn
+    cell measures against. The k-th bump folds global slice
+    ``[k*churn_edges, (k+1)*churn_edges)``, so a driver can rebuild the
+    identical stream for an oracle check. ``churn_gate`` (a path) holds
+    the churn tail until the file EXISTS: the measuring driver touches
+    it once its routers are up, so the paced bumps overlap live query
+    traffic instead of racing the routers' boot (bounded wait — a
+    driver that never touches the gate releases the tail after 120s
+    rather than wedging the shard)."""
     from ..datasets import IdentityDict
     from ..core.ingest import partition_edges_by_vertex
     from ..summaries.forest import fold_edges_host
@@ -1006,6 +1343,26 @@ def shard_demo_payloads(
         yield {"labels": lab, "deg": deg.copy(), "vdict": vd}, done
         if pace_s:
             time.sleep(pace_s)
+    if churn_bumps and churn_edges:
+        if churn_gate:
+            gate_dl = time.monotonic() + 120.0
+            while (not os.path.exists(churn_gate)
+                   and time.monotonic() < gate_dl):
+                time.sleep(0.02)
+        csrc, cdst = demo_shard_edges(
+            n_vertices, churn_bumps * churn_edges, churn_seed)
+        for k in range(churn_bumps):
+            a, b = k * churn_edges, (k + 1) * churn_edges
+            cs, cd, _cv = partition_edges_by_vertex(
+                csrc[a:b], cdst[a:b], None, nshards)[shard]
+            if len(cs):
+                lab = fold_edges_host(lab, cs, cd)
+                deg += np.bincount(cs, minlength=n_vertices)
+                deg += np.bincount(cd, minlength=n_vertices)
+                done += len(cs)
+            yield {"labels": lab, "deg": deg.copy(), "vdict": vd}, done
+            if churn_pace_s:
+                time.sleep(churn_pace_s)
 
 
 # --------------------------------------------------------------------- #
@@ -1015,7 +1372,7 @@ def router_main(cfg: dict) -> None:
     """The router as a real process. ``cfg`` keys: ``shards`` (one
     address list per shard), ``portfile``, optional ``events`` (ShardSink
     path + ``shard`` label), ``cache``/``cache_cap``/``cache_ttl_s``,
-    ``run_s``, ``meta``."""
+    ``delta`` (pull protocol v2 on/off), ``run_s``, ``meta``."""
     import json
     import signal
 
@@ -1035,6 +1392,7 @@ def router_main(cfg: dict) -> None:
         cache_cap=int(cfg.get("cache_cap", DEFAULT_CACHE_CAP)),
         cache_ttl_s=cfg.get("cache_ttl_s"),
         max_pending=int(cfg.get("max_pending", 1 << 14)),
+        delta=bool(cfg.get("delta", True)),
     )
     rpc = RpcServer(router).start()
     if cfg.get("portfile"):

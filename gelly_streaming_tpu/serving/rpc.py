@@ -206,7 +206,15 @@ def encode_queries(queries) -> List[list]:
             )
         if tag == "C":
             out.append([tag, int(q.u), int(q.v)])
-        elif tag in ("P", "B"):
+        elif tag == "P":
+            # protocol v2: the delta baseline rides as an OPTIONAL
+            # trailing field — a v1-shaped pull (since_version < 0)
+            # stays the bare ["P"] item old servers already accept
+            if q.since_version >= 0:
+                out.append([tag, int(q.since_version)])
+            else:
+                out.append([tag])
+        elif tag == "B":
             out.append([tag])
         else:
             out.append([tag, int(q.v)])
@@ -217,7 +225,14 @@ def decode_queries(items) -> List[Query]:
     out: List[Query] = []
     for it in items:
         cls, arity = _Q_KINDS.get(it[0], (None, 0))
-        if cls is None or len(it) != arity + 1:
+        if cls is None:
+            raise ValueError(f"unknown or malformed query item {it!r}")
+        if cls is SummaryPullQuery:
+            # arity 0 (v1) or 1 (v2 with since_version) both decode
+            if len(it) not in (1, 2):
+                raise ValueError(
+                    f"unknown or malformed query item {it!r}")
+        elif len(it) != arity + 1:
             raise ValueError(f"unknown or malformed query item {it!r}")
         out.append(cls(*(int(x) for x in it[1:])))
     return out

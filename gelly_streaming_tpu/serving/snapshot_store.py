@@ -24,6 +24,7 @@ on ingest — the same discipline as the producer loop's zero-D2H rule
 
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
 import threading
@@ -70,6 +71,12 @@ class PublishedSnapshot:
     ``watermark`` is a monotone progress counter — cumulative edges or
     events folded when the servable can count them cheaply, else the
     window index — so staleness is meaningful even across restores.
+    ``epoch`` is the publishing STORE's process-unique nonce: version
+    numbers restart from 1 when a store is rebuilt (a promoted standby,
+    a restarted replica), so any cache keyed on version alone can serve
+    a stale entry across a store swap at a coincidentally-equal
+    version. Caches key on ``(epoch, version)`` instead; 0 marks a
+    hand-built snapshot that never went through a store.
     """
 
     payload: Mapping[str, Any]
@@ -77,6 +84,7 @@ class PublishedSnapshot:
     watermark: int
     version: int
     published_at: float = field(default_factory=time.monotonic)
+    epoch: int = 0
 
 
 class SnapshotStore:
@@ -93,7 +101,12 @@ class SnapshotStore:
     #: latency-preferring reader can be handed is bounded by this
     READY_LOOKBACK = 3
 
+    #: process-wide epoch allocator: each store instance gets a distinct
+    #: nonce so (epoch, version) pairs never collide across store swaps
+    _epochs = itertools.count(1)
+
     def __init__(self):
+        self.epoch = next(SnapshotStore._epochs)
         self._current: Optional[PublishedSnapshot] = None
         self._recent: tuple = ()  # newest-first, immutable (atomic swap)
         self._cond = threading.Condition()
@@ -166,6 +179,7 @@ class SnapshotStore:
             window=window,
             watermark=watermark,
             version=1 if prev is None else prev.version + 1,
+            epoch=self.epoch,
         )
         # both swaps are single reference assignments (atomic under the
         # GIL); _recent is an immutable tuple rebuilt per publish

@@ -664,6 +664,56 @@ def merge_forest_tables_host(tables) -> np.ndarray:
     )
 
 
+def apply_forest_delta_host(lab: np.ndarray, sizes: np.ndarray,
+                            src: np.ndarray, dst: np.ndarray) -> np.ndarray:
+    """Incremental counterpart to :func:`merge_forest_tables_host`:
+    union a SMALL batch of delta edges into an existing canonical host
+    forest IN PLACE, O(changed rows * alpha) instead of O(forest).
+
+    ``lab`` is a min-rooted pointer table (``lab[v] <= v``; flat or the
+    output of earlier delta applications) and ``sizes`` the per-dense-id
+    member counts of its roots; both are mutated. Unions hook the LARGER
+    root under the smaller (min-label discipline), so the invariant —
+    and therefore agreement with a from-scratch
+    :func:`merge_forest_tables_host` rebuild after
+    :func:`resolve_flat_host` — is preserved exactly. Path-halving on
+    the find walks keeps amortized chains near-flat between full
+    rebuilds.
+
+    Returns the dense ids of every root that participated in an
+    EFFECTIVE union (winners and absorbed alike; empty when no edge
+    changed connectivity) — the selective cache-invalidation signal the
+    sharded router keys on: a cached answer whose roots are disjoint
+    from this set provably kept its components untouched."""
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    if len(src) != len(dst):
+        raise ValueError(
+            f"delta columns disagree on length: {len(src)} != {len(dst)}"
+        )
+    touched = set()
+    for a, b in zip(src.tolist(), dst.tolist()):
+        ra = a
+        while lab[ra] != ra:
+            lab[ra] = lab[lab[ra]]  # path halving
+            ra = int(lab[ra])
+        rb = b
+        while lab[rb] != rb:
+            lab[rb] = lab[lab[rb]]
+            rb = int(lab[rb])
+        if ra == rb:
+            continue
+        if rb < ra:
+            ra, rb = rb, ra
+        lab[rb] = ra
+        sizes[ra] += sizes[rb]
+        touched.add(ra)
+        touched.add(rb)
+    if not touched:
+        return np.zeros(0, np.int64)
+    return np.fromiter(touched, np.int64, len(touched))
+
+
 class TouchLog:
     """Append-only first-seen log of touched compact ids.
 

@@ -255,14 +255,21 @@ def test_sharded_watch_directions():
     from tools.benchguard import WATCHED_SHARDED
 
     base = {"headline": {"qps": 9000.0},
-            "zipf": {"cache_on": {"p99_ms": 40.0}}}
+            "zipf": {"cache_on": {"p99_ms": 40.0}},
+            "churn": {"bytes_x": 90.0, "merge_x": 30.0}}
     good = {"headline": {"qps": 8000.0},
-            "zipf": {"cache_on": {"p99_ms": 60.0}}}
+            "zipf": {"cache_on": {"p99_ms": 60.0}},
+            "churn": {"bytes_x": 60.0, "merge_x": 20.0}}
     verdicts = compare(base, good, ratio=3.0, watched=WATCHED_SHARDED)
-    assert [v["ok"] for v in verdicts] == [True, True]
+    assert [v["ok"] for v in verdicts] == [True, True, True, True]
+    # the churn ratios are min:-direction — a delta refresh that
+    # starts costing like a full re-pull drags them DOWN
     bad = {"headline": {"qps": 2000.0},
-           "zipf": {"cache_on": {"p99_ms": 200.0}}}
+           "zipf": {"cache_on": {"p99_ms": 200.0}},
+           "churn": {"bytes_x": 1.1, "merge_x": 1.0}}
     verdicts = compare(base, bad, ratio=3.0, watched=WATCHED_SHARDED)
     by = {v["metric"]: v for v in verdicts}
     assert by["min:headline.qps"]["ok"] is False
     assert by["zipf.cache_on.p99_ms"]["ok"] is False
+    assert by["min:churn.bytes_x"]["ok"] is False
+    assert by["min:churn.merge_x"]["ok"] is False

@@ -370,3 +370,72 @@ def test_carry_with_event_time_windows(carry):
     assert mode == carry
     assert got == dense
     assert "1=[1, 2, 3, 4, 5, 6]" in got[-1] and "7=[7, 8]" in got[-1]
+
+
+# --------------------------------------------------------------------- #
+# Incremental merged-forest delta (apply_forest_delta_host, ISSUE 17)
+# --------------------------------------------------------------------- #
+def test_apply_forest_delta_matches_scratch_fold():
+    """Repeated incremental application equals a from-scratch fold of
+    the full edge set (after resolve), and the size table stays exact
+    at every root — the router's O(changed) merge-refresh contract."""
+    from gelly_streaming_tpu.summaries.forest import (
+        apply_forest_delta_host,
+        fold_edges_host,
+        resolve_flat_host,
+    )
+
+    rng = np.random.default_rng(31)
+    n = 200
+    base_s = rng.integers(0, n, 300)
+    base_d = rng.integers(0, n, 300)
+    flat = fold_edges_host(np.arange(n, dtype=np.int32), base_s, base_d)
+    lab = flat.astype(np.int64)
+    sizes = np.bincount(flat, minlength=n).astype(np.int64)
+    all_s, all_d = base_s.tolist(), base_d.tolist()
+    for _ in range(6):
+        ds = rng.integers(0, n, 15)
+        dd = rng.integers(0, n, 15)
+        apply_forest_delta_host(lab, sizes, ds, dd)
+        all_s += ds.tolist()
+        all_d += dd.tolist()
+        want = fold_edges_host(
+            np.arange(n, dtype=np.int32),
+            np.asarray(all_s), np.asarray(all_d),
+        )
+        assert np.array_equal(resolve_flat_host(lab),
+                              want.astype(np.int64))
+        for r in np.unique(want):
+            assert sizes[r] == int(np.sum(want == r))
+    # the final state also matches the union-find oracle
+    comps = _union_find_components(zip(all_s, all_d))
+    got = resolve_flat_host(lab)
+    for comp in comps:
+        assert len({int(got[v]) for v in comp}) == 1
+
+
+def test_apply_forest_delta_reports_touched_roots():
+    from gelly_streaming_tpu.summaries.forest import (
+        apply_forest_delta_host,
+    )
+
+    lab = np.arange(8, dtype=np.int64)
+    sizes = np.ones(8, np.int64)
+    # an effective union touches BOTH sides (winner and absorbed)
+    t = apply_forest_delta_host(lab, sizes,
+                                np.asarray([3]), np.asarray([5]))
+    assert sorted(t.tolist()) == [3, 5]
+    assert lab[5] == 3 and sizes[3] == 2
+    # the same edge again is a no-op: nothing touched
+    t = apply_forest_delta_host(lab, sizes,
+                                np.asarray([3]), np.asarray([5]))
+    assert len(t) == 0
+    # a chained union reports the ROOTS involved, not the raw endpoints
+    t = apply_forest_delta_host(lab, sizes,
+                                np.asarray([5]), np.asarray([1]))
+    assert sorted(t.tolist()) == [1, 3]
+    assert sizes[1] == 3
+    # torn delta columns are rejected, never half-applied
+    with pytest.raises(ValueError):
+        apply_forest_delta_host(lab, sizes,
+                                np.asarray([1]), np.asarray([], np.int64))

@@ -917,3 +917,45 @@ def test_engine_findings_honor_reasoned_suppressions(tmp_path):
 def test_all_rules_registry_includes_engine_rules():
     ids = [r.id for r in ALL_RULES]
     assert ids[-4:] == ["GL008", "GL009", "GL010", "GL011"]
+
+
+# --------------------------------------------------------------------- #
+# GL011 coverage of the live pull-doc codec (ISSUE 17 satellite)
+# --------------------------------------------------------------------- #
+def test_gl011_pairs_the_live_pull_doc_codec():
+    # the v2 pull-doc codec must sit inside GL011's pairing universe —
+    # a future key shipped by the encoder and dropped by the decoder
+    # (or vice versa) has to surface as a finding, not a wire mystery
+    rel = "gelly_streaming_tpu/serving/query.py"
+    full = os.path.join(REPO, rel)
+    with open(full, encoding="utf-8") as f:
+        mods = {rel: LintModule(full, rel, f.read())}
+    rule = WireCodecSymmetry()
+    pairs = [
+        (w.qualname, r.qualname)
+        for w, r in rule._pairs(RepoGraph(mods))
+    ]
+    assert ("encode_pull_doc", "decode_pull_doc") in pairs
+
+
+def test_gl011_pull_doc_shaped_asymmetry_fires(tmp_path):
+    # ...and the coverage is not vacuous: the same codec shape with an
+    # orphan key fires (the decoder builds a fresh result dict, so the
+    # escape-tolerance rule must NOT silence it)
+    res = lint_files(tmp_path, {"serving/query.py": """
+    import base64
+
+    def encode_pull_doc(raws, kind="full", base=None):
+        doc = {"kind": kind, "n": len(raws)}
+        doc["u64"] = base64.b64encode(raws).decode()
+        doc["orphan"] = 1
+        return doc
+
+    def decode_pull_doc(doc):
+        kind = doc.get("kind", "full")
+        n = doc["n"]
+        u = doc["u64"]
+        return {"kind": kind, "n": n, "u": u}
+    """})
+    msgs = [f.message for f in res.findings if f.rule == "GL011"]
+    assert len(msgs) == 1 and "'orphan'" in msgs[0]

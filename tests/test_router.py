@@ -176,7 +176,9 @@ def test_summary_pull_codec_round_trips_the_forest():
         engine = QueryEngine()
         snap = srv.snapshot()
         doc = engine.summary_pull(snap)
-        u, r = decode_pull(doc)
+        dec = decode_pull(doc)
+        assert dec["kind"] == "full"
+        u, r = dec["u"], dec["r"]
         labels = np.asarray(snap.payload["labels"])
         assert len(u) == len(labels)
         assert np.array_equal(u, np.arange(len(labels)))
@@ -190,8 +192,9 @@ def test_summary_pull_codec_round_trips_the_forest():
         assert engine.summary_pull(snap) is doc
         # and it rides the ordinary answer path
         ans = srv.ask(SummaryPullQuery(), timeout=30)
-        u2, r2 = decode_pull(ans.value)
-        assert np.array_equal(u2, u) and np.array_equal(r2, r)
+        dec2 = decode_pull(ans.value)
+        assert np.array_equal(dec2["u"], u)
+        assert np.array_equal(dec2["r"], r)
         assert ans.version == snap.version
     finally:
         srv.close()
@@ -760,3 +763,409 @@ def test_fast_restart_into_own_fresh_lease_boots_as_primary(tmp_path):
         assert rep.server.snapshot() is not None
     finally:
         rep.close()
+
+
+# --------------------------------------------------------------------- #
+# Delta pulls (pull protocol v2, ISSUE 17)
+# --------------------------------------------------------------------- #
+def _snap(lab, version, *, epoch=77, tids=None):
+    """A hand-built published snapshot for engine-level delta tests:
+    full control of (epoch, version) without a server."""
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.serving.snapshot_store import (
+        PublishedSnapshot,
+    )
+
+    lab = np.asarray(lab, np.int32)
+    vd = IdentityDict(len(lab))
+    vd.observe(len(lab) - 1)
+    payload = {"labels": lab, "vdict": vd}
+    if tids is not None:
+        payload["tids"] = np.asarray(tids, np.int32)
+        payload["tcount"] = len(tids)
+    return PublishedSnapshot(payload=payload, window=version,
+                             watermark=version, version=version,
+                             epoch=epoch)
+
+
+def test_engine_summary_pull_answers_delta_since_version():
+    nv = 32
+    eng = QueryEngine()
+    lab1 = np.arange(nv, dtype=np.int32)
+    d1 = decode_pull(eng.summary_pull(_snap(lab1, 1), -1))
+    assert d1["kind"] == "full" and d1["n"] == nv
+    # v2 merges {0, 5}: exactly one row's root changed
+    lab2 = lab1.copy()
+    lab2[5] = 0
+    d2 = decode_pull(eng.summary_pull(_snap(lab2, 2), 1))
+    assert d2["kind"] == "delta" and d2["base"] == 1
+    assert d2["u"].tolist() == [5] and d2["r"].tolist() == [0]
+    # pulling AT the current version answers an empty delta, not a
+    # full table — "nothing changed" must cost nothing on the wire
+    d2b = decode_pull(eng.summary_pull(_snap(lab2, 2), 2))
+    assert d2b["kind"] == "delta" and d2b["n"] == 0
+    # v3 touches more rows; a pull spanning BOTH segments dedupes to
+    # the newest root per raw id
+    lab3 = lab2.copy()
+    lab3[7] = 0
+    lab3[9] = 3
+    d3 = decode_pull(eng.summary_pull(_snap(lab3, 3), 1))
+    assert d3["kind"] == "delta" and d3["base"] == 1
+    got = dict(zip(d3["u"].tolist(), d3["r"].tolist()))
+    assert got == {5: 0, 7: 0, 9: 3}
+    # the router-side merge rule: carried full table + dict-update by
+    # the delta rows IS the new full table
+    carried = dict(zip(d1["u"].tolist(), d1["r"].tolist()))
+    carried.update(got)
+    assert [carried[i] for i in range(nv)] == lab3.tolist()
+
+
+def test_engine_delta_uses_the_touchlog_shadow():
+    # when the payload carries the TouchLog novelty shadow, the diff
+    # runs over the touched candidate set only — and still lists every
+    # changed row (changes land only on touched vertices)
+    nv = 32
+    eng = QueryEngine()
+    lab1 = np.arange(nv, dtype=np.int32)
+    eng.summary_pull(_snap(lab1, 1, tids=[0, 5]), -1)
+    lab2 = lab1.copy()
+    lab2[5] = 0
+    d = decode_pull(eng.summary_pull(_snap(lab2, 2, tids=[0, 5]), 1))
+    assert d["kind"] == "delta"
+    assert d["u"].tolist() == [5] and d["r"].tolist() == [0]
+
+
+def test_engine_delta_degrades_honestly_to_full():
+    from gelly_streaming_tpu.serving.query import DELTA_RING
+
+    nv = 16
+    lab = np.arange(nv, dtype=np.int32)
+    eng = QueryEngine()
+    eng.summary_pull(_snap(lab, 1), -1)
+    # a puller AHEAD of this store (it pulled a replica that died with
+    # more versions): full, tagged
+    d = decode_pull(eng.summary_pull(_snap(lab, 1), 9))
+    assert d["kind"] == "full" and d["why"] == "ahead"
+    # a fresh engine holds no chain to diff against
+    d = decode_pull(QueryEngine().summary_pull(_snap(lab, 5), 3))
+    assert d["kind"] == "full" and d["why"] == "no_chain"
+    # a since_version older than the bounded ring: full, tagged stale
+    for v in range(2, DELTA_RING + 4):
+        eng.summary_pull(_snap(lab, v), -1)
+    d = decode_pull(eng.summary_pull(_snap(lab, DELTA_RING + 4), 1))
+    assert d["kind"] == "full" and d["why"] == "stale"
+
+
+def test_engine_chain_resets_on_store_swap():
+    # a NEW store (fresh epoch, version counter restarted) means the
+    # old diff base is gone: a delta request must answer full, never
+    # diff across epochs
+    nv = 16
+    lab = np.arange(nv, dtype=np.int32)
+    eng = QueryEngine()
+    eng.summary_pull(_snap(lab, 1, epoch=1), -1)
+    eng.summary_pull(_snap(lab, 2, epoch=1), -1)
+    d = decode_pull(eng.summary_pull(_snap(lab, 2, epoch=2), 1))
+    assert d["kind"] == "full" and d["why"] == "no_chain"
+
+
+def test_malformed_pull_is_counted_by_kind():
+    from gelly_streaming_tpu.serving.query import (
+        MalformedPull,
+        encode_pull_doc,
+    )
+
+    with pytest.raises(MalformedPull) as ei:
+        decode_pull("gibberish")
+    assert ei.value.kind == "type"
+    assert counter_value("router.pull_malformed", kind="type") == 1
+    # geometry mismatch (ISSUE 17 satellite: the rejection is counted,
+    # not folded into a generic pull error)
+    doc = encode_pull_doc(np.arange(4, dtype=np.int64),
+                          np.zeros(4, np.int64))
+    with pytest.raises(MalformedPull) as ei:
+        decode_pull({**doc, "n": 5})
+    assert ei.value.kind == "geometry"
+    assert counter_value("router.pull_malformed", kind="geometry") == 1
+    with pytest.raises(MalformedPull) as ei:
+        decode_pull({**doc, "kind": "delta"})  # delta without base
+    assert ei.value.kind == "base"
+    assert counter_value("router.pull_malformed") == 3
+
+
+def _delta_stack(nv, nshards, *, cache=True, delta=True):
+    """N hand-cranked shard servers + a router; per-shard carried
+    label tables the test folds churn into (the shard-side oracle)."""
+    feeds = [_FeedServable(nv) for _ in range(nshards)]
+    lab0 = np.arange(nv, dtype=np.int32)
+    deg0 = np.zeros(nv, np.int64)
+    for f in feeds:
+        f.push(lab0, deg0, 1)
+    servers = [StreamServer(f, None).start() for f in feeds]
+    for s in servers:
+        s.store.wait_for(1, timeout=10)
+    rpcs = [RpcServer(s).start() for s in servers]
+    router = ShardRouter(
+        [[f"127.0.0.1:{r.port}"] for r in rpcs],
+        cache=cache, delta=delta,
+    )
+
+    def close():
+        router.close()
+        for r in rpcs:
+            r.close()
+        for f in feeds:
+            f.finish()
+        for s in servers:
+            s.close()
+
+    return feeds, servers, router, close
+
+
+def _churn_bump(feeds, servers, labs, src, dst, ver):
+    """Fold one churn bump's edges into every owner shard's table and
+    publish a new version on ALL shards (lockstep, like the demo)."""
+    nshards = len(feeds)
+    parts = partition_edges_by_vertex(
+        np.asarray(src), np.asarray(dst), None, nshards)
+    for k, (s, d, _v) in enumerate(parts):
+        if len(s):
+            labs[k] = fold_edges_host(labs[k], s, d)
+        feeds[k].push(labs[k], np.zeros(len(labs[k]), np.int64), ver)
+    for srv in servers:
+        srv.store.wait_for(ver, timeout=10)
+
+
+def _uf_roots(edges):
+    root = {}
+    for comp in union_find_components(edges):
+        m = min(comp)
+        for v in comp:
+            root[v] = m
+    return root
+
+
+def test_delta_refresh_matches_scratch_merge_and_oracle():
+    """The tentpole oracle matrix: randomized churn, every answer vs
+    the union-find oracle, and after EVERY delta refresh the carried
+    merged forest resolves byte-identical to a from-scratch
+    merge_forest_tables_host rebuild of the shards' current tables."""
+    from gelly_streaming_tpu.summaries.forest import resolve_flat_host
+
+    nv, nshards = 96, 2
+    feeds, servers, router, close = _delta_stack(nv, nshards)
+    try:
+        rng = np.random.default_rng(23)
+        labs = [np.arange(nv, dtype=np.int32) for _ in range(nshards)]
+        owners = vertex_owner(np.arange(nv, dtype=np.int64), nshards)
+        shard_keys = [np.where(owners == k)[0] for k in range(nshards)]
+        edges = []
+        for bump in range(2, 10):
+            src = rng.integers(0, nv, 6)
+            dst = rng.integers(0, nv, 6)
+            edges += list(zip(src.tolist(), dst.tolist()))
+            _churn_bump(feeds, servers, labs, src, dst, bump)
+            # a fresh-key probe per shard observes the new version the
+            # production way: reply frames on ordinary answers
+            for k in range(nshards):
+                p = int(shard_keys[k][bump])
+                router.ask(DegreeQuery(p), timeout=30, deadline_s=30)
+            qs = [ConnectedQuery(int(a), int(b))
+                  for a, b in zip(rng.integers(0, nv, 30),
+                                  rng.integers(0, nv, 30))]
+            got = router.ask_batch(qs, deadline_s=60, timeout=120)
+            root = _uf_roots(edges)
+            for q, g in zip(qs, got):
+                want = root.get(q.u, q.u) == root.get(q.v, q.v)
+                assert bool(g.value) is want, (bump, q.u, q.v)
+            # byte-identity: carried-and-delta-patched forest vs a
+            # from-scratch rebuild over the same shard tables
+            with router._mlock:
+                m = router._merged
+                assert m is not None and m.n == nv
+                dense = np.arange(nv, dtype=np.int64)
+                got_roots = m.raw_of[m.roots(dense)]
+            want_lab = merge_forest_tables_host(
+                [resolve_flat_host(t) for t in labs]).astype(np.int64)
+            assert np.array_equal(got_roots, want_lab)
+        stats = router.stats_snapshot()
+        # the first refresh is the full baseline; every later one rode
+        # the delta path — no protocol fallbacks, no malformed frames
+        assert stats["delta_pulls"] >= nshards * 6
+        assert stats["merges_delta"] >= 6
+        assert stats["merges_full"] >= 1
+        assert stats["full_fallbacks"] == 0
+        assert stats["pull_malformed"] == 0
+        assert stats["pull_bytes_delta"] < stats["pull_bytes_full"]
+    finally:
+        close()
+
+
+def test_restart_adoption_resets_delta_baseline_to_full_pull():
+    """A version-sequence restart (promoted standby, fresh store) must
+    RESET the delta baseline: the next refresh re-pulls the full table
+    (since=-1) instead of asking the new replica for a diff against a
+    version sequence it never produced. The reset is an honest
+    baseline, NOT a protocol fallback."""
+    nv, nshards = 64, 2
+    feeds, servers, router, close = _delta_stack(nv, nshards)
+    try:
+        labs = [np.arange(nv, dtype=np.int32) for _ in range(nshards)]
+        owners = vertex_owner(np.arange(nv, dtype=np.int64), nshards)
+        shard_keys = [np.where(owners == k)[0] for k in range(nshards)]
+        # two churn bumps: the second refresh rides the delta path
+        _churn_bump(feeds, servers, labs, [0], [1], 2)
+        for k in range(nshards):
+            router.ask(DegreeQuery(int(shard_keys[k][2])),
+                       timeout=30, deadline_s=30)
+        assert bool(router.ask(ConnectedQuery(0, 1), timeout=30,
+                               deadline_s=30).value) is True
+        _churn_bump(feeds, servers, labs, [0], [2], 3)
+        for k in range(nshards):
+            router.ask(DegreeQuery(int(shard_keys[k][3])),
+                       timeout=30, deadline_s=30)
+        assert bool(router.ask(ConnectedQuery(1, 2), timeout=30,
+                               deadline_s=30).value) is True
+        assert router.stats_snapshot()["delta_pulls"] >= 1
+        # drive the owner's version far past the restart slack, then
+        # deliver a restarted sequence the way reply frames would
+        owner = 0
+        for w in range(4, ShardRouter.VERSION_RESTART_SLACK + 8):
+            feeds[owner].push(labs[owner],
+                              np.zeros(nv, np.int64), w)
+        servers[owner].store.wait_for(
+            ShardRouter.VERSION_RESTART_SLACK + 7, timeout=10)
+        router.ask(DegreeQuery(int(shard_keys[owner][4])),
+                   timeout=30, deadline_s=30)
+        bytes_full0 = counter_value("router.pull_bytes", kind="full")
+        router._observe_version(owner, 1)
+        assert router._pulled_vers[owner] == -1
+        # the next CC refresh full-pulls the adopted shard — and the
+        # answers stay oracle-correct across the reset
+        assert bool(router.ask(ConnectedQuery(1, 2), timeout=30,
+                               deadline_s=30).value) is True
+        assert bool(router.ask(ConnectedQuery(3, 4), timeout=30,
+                               deadline_s=30).value) is False
+        assert counter_value(
+            "router.pull_bytes", kind="full") > bytes_full0
+        assert router.stats_snapshot()["full_fallbacks"] == 0
+    finally:
+        close()
+
+
+def test_mixed_v1_v2_fleet_round_trips_with_full_fallback():
+    """A v1 peer ignores since_version and answers the untagged full
+    doc (the old wire shape): the router must detect the full reply,
+    count the fallback, reset that shard's baseline — and keep
+    delta-pulling the v2 shard. Answers stay oracle-correct."""
+    nv, nshards = 64, 2
+    feeds, servers, router, close = _delta_stack(nv, nshards)
+    try:
+        # shard 1 becomes a v1 peer: its engine ignores the since field
+        # and strips the v2 tags from the reply doc
+        eng = servers[1].engine
+        orig = eng.summary_pull
+
+        def v1_pull(snap, since_version=-1):
+            doc = orig(snap, -1)
+            return {k: doc[k] for k in ("n", "u64", "r64")}
+
+        eng.summary_pull = v1_pull
+        rng = np.random.default_rng(7)
+        labs = [np.arange(nv, dtype=np.int32) for _ in range(nshards)]
+        owners = vertex_owner(np.arange(nv, dtype=np.int64), nshards)
+        shard_keys = [np.where(owners == k)[0] for k in range(nshards)]
+        edges = []
+        for bump in range(2, 6):
+            src = rng.integers(0, nv, 4)
+            dst = rng.integers(0, nv, 4)
+            edges += list(zip(src.tolist(), dst.tolist()))
+            _churn_bump(feeds, servers, labs, src, dst, bump)
+            for k in range(nshards):
+                router.ask(DegreeQuery(int(shard_keys[k][bump])),
+                           timeout=30, deadline_s=30)
+            qs = [ConnectedQuery(int(a), int(b))
+                  for a, b in zip(rng.integers(0, nv, 20),
+                                  rng.integers(0, nv, 20))]
+            got = router.ask_batch(qs, deadline_s=60, timeout=120)
+            root = _uf_roots(edges)
+            for q, g in zip(qs, got):
+                want = root.get(q.u, q.u) == root.get(q.v, q.v)
+                assert bool(g.value) is want, (bump, q.u, q.v)
+        stats = router.stats_snapshot()
+        assert stats["delta_pulls"] >= 3        # the v2 shard deltas
+        assert stats["full_fallbacks"] >= 3     # the v1 shard degrades
+        assert counter_value("router.full_fallbacks",
+                             reason="peer_full") >= 3
+        # a full reply in the rendezvous poisons the incremental merge
+        # for that refresh: every refresh rebuilt (honest, correct)
+        assert stats["merges_delta"] == 0
+        assert stats["merges_full"] >= 4
+    finally:
+        close()
+
+
+def test_delta_refresh_retains_provably_untouched_cache_entries():
+    """Selective invalidation: a delta refresh whose touched-component
+    set misses a cached entry's roots PROVES the entry still holds —
+    it is retained (counted) at the new version vector; an entry whose
+    component WAS touched invalidates the blanket way."""
+    nv, nshards = 64, 2
+    feeds, servers, router, close = _delta_stack(nv, nshards)
+    try:
+        labs = [np.arange(nv, dtype=np.int32) for _ in range(nshards)]
+        owners = vertex_owner(np.arange(nv, dtype=np.int64), nshards)
+        shard_keys = [np.where(owners == k)[0] for k in range(nshards)]
+        # merge {2,3}; cache (2,3)=True, (4,5)=False, (0,1)=False
+        _churn_bump(feeds, servers, labs, [2], [3], 2)
+        for k in range(nshards):
+            router.ask(DegreeQuery(int(shard_keys[k][2])),
+                       timeout=30, deadline_s=30)
+        assert bool(router.ask(ConnectedQuery(2, 3), timeout=30,
+                               deadline_s=30).value) is True
+        assert bool(router.ask(ConnectedQuery(4, 5), timeout=30,
+                               deadline_s=30).value) is False
+        assert bool(router.ask(ConnectedQuery(0, 1), timeout=30,
+                               deadline_s=30).value) is False
+        # churn elsewhere: {0,1} merge — components {2}, {4}, {5}
+        # provably untouched
+        _churn_bump(feeds, servers, labs, [0], [1], 3)
+        for k in range(nshards):
+            router.ask(DegreeQuery(int(shard_keys[k][3])),
+                       timeout=30, deadline_s=30)
+        # the touched entry invalidates and re-answers fresh (this ask
+        # also triggers the delta refresh)
+        inval0 = counter_value("router.cache_invalidations")
+        assert bool(router.ask(ConnectedQuery(0, 1), timeout=30,
+                               deadline_s=30).value) is True
+        assert counter_value("router.cache_invalidations") > inval0
+        # the untouched entries are retained: served without fan-out,
+        # revalidated against the delta history
+        ret0 = counter_value("router.cache_retained")
+        hits0 = counter_value("router.cache_hits")
+        assert bool(router.ask(ConnectedQuery(2, 3), timeout=30,
+                               deadline_s=30).value) is True
+        assert bool(router.ask(ConnectedQuery(4, 5), timeout=30,
+                               deadline_s=30).value) is False
+        assert counter_value("router.cache_retained") >= ret0 + 2
+        assert counter_value("router.cache_hits") >= hits0 + 2
+    finally:
+        close()
+
+
+def test_timeline_renders_the_delta_pull_story_in_order():
+    from gelly_streaming_tpu.obs import timeline
+
+    events = [
+        {"kind": "counter", "name": "router.delta_pulls", "ts": 5.0,
+         "shard": "p10", "v": 1},
+        {"kind": "counter", "name": "router.full_fallbacks", "ts": 6.0,
+         "shard": "p10", "labels": {"reason": "stale"}, "v": 1},
+        {"kind": "counter", "name": "router.pull_malformed", "ts": 7.0,
+         "shard": "p10", "labels": {"kind": "geometry"}, "v": 1},
+    ]
+    lines = timeline.render(events)
+    assert len(lines) == 3
+    assert "DELTA-PULL" in lines[0]
+    assert "FULL-FALLBACK" in lines[1] and "reason=stale" in lines[1]
+    assert "PULL-MALFORMED" in lines[2] and "kind=geometry" in lines[2]

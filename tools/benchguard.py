@@ -91,7 +91,17 @@ WATCHED_AUTOTUNE = (
 #: downward), its steady cache-on p99 is latency (regression upward).
 #: The kill/promotion columns are NOT guarded: their latency is
 #: dominated by the configured lease timeout, a correctness parameter.
-WATCHED_SHARDED = ("min:headline.qps", "zipf.cache_on.p99_ms")
+#: The churn cell (ISSUE 17) guards the delta-pull protocol's two
+#: headline ratios in the ``min:`` direction — a regression means a
+#: delta refresh started costing byte- or merge-wise like a full
+#: re-pull again. The absolute per-refresh columns are NOT guarded:
+#: they move with geometry, the ratios are the claim.
+WATCHED_SHARDED = (
+    "min:headline.qps",
+    "zipf.cache_on.p99_ms",
+    "min:churn.bytes_x",
+    "min:churn.merge_x",
+)
 
 #: the transport-fabric artifact's guarded cells
 #: (BENCH_TRANSPORT_CPU.json, ISSUE 16): per-backend store round-trip
