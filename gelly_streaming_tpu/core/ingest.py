@@ -81,12 +81,21 @@ from .stream import SimpleEdgeStream
 #: frame magic (also the protocol's garbage detector)
 MAGIC = b"GSEW"
 VERSION = 1
+#: GSEW v2: identical header/column layout plus the optional i64
+#: event-timestamp column (``F_TS``). v1 frames stay byte-identical —
+#: a ts-less stream never pays the version bump, and every reader
+#: accepts both (the ISSUE 18 wire compat rule).
+VERSION_TS = 2
 #: header: magic | version | flags | n_edges | payload length | sequence
 HEADER = struct.Struct("<4sBBIIQ")
 #: flags bit 0: int64 endpoint columns (else int32)
 F_WIDE = 1
 #: flags bit 1: float64 value column present
 F_VAL = 2
+#: flags bit 2: int64 event-timestamp column present (v2 frames only;
+#: the column rides LAST in the payload so the native column decoder
+#: consumes the unchanged prefix)
+F_TS = 4
 #: reject frames declaring more edges than this before reading them
 MAX_FRAME_EDGES = 1 << 22
 #: reject payloads past this byte length before reading them
@@ -102,7 +111,7 @@ class Disconnect(Exception):
 class MalformedFrame(ValueError):
     """The byte stream violated the frame contract; ``kind`` is the
     ``source.malformed_frames{kind=...}`` label (magic/version/
-    oversized/columns/truncated)."""
+    oversized/columns/truncated/ts_missing)."""
 
     def __init__(self, kind: str, msg: str):
         super().__init__(msg)
@@ -120,14 +129,19 @@ def pack_edge_frame(
     *,
     seq: int = 0,
     wide: Optional[bool] = None,
+    ts: Optional[np.ndarray] = None,
 ) -> bytes:
     """Encode one GSEW frame: header + raw little-endian columns
-    (src, then dst, then the optional float64 value column).
+    (src, then dst, then the optional float64 value column, then the
+    optional int64 event-timestamp column).
 
     ``wide=None`` picks int32 columns when every id fits (half the
     wire bytes — the common dense-id case), int64 otherwise. ``seq``
     is the per-connection frame sequence number (1-based; 0 = unknown,
     never deduped) the reader uses to drop at-least-once replays.
+    ``ts`` makes the frame GSEW v2 (``F_TS``); without it the frame is
+    byte-identical v1 — old readers never see a version they cannot
+    parse unless the stream actually carries event time.
     """
     src = np.ascontiguousarray(src, np.int64)
     dst = np.ascontiguousarray(dst, np.int64)
@@ -147,15 +161,23 @@ def pack_edge_frame(
     # encoder and reader must agree on BOTH bounds (the GL011 ethos):
     # a frame the encoder emits but every reader rejects as oversized
     # would dead-loop the replay path, so reject it at pack time
-    nbytes = n * (8 if wide else 4) * 2 + (8 * n if val is not None else 0)
+    nbytes = (
+        n * (8 if wide else 4) * 2
+        + (8 * n if val is not None else 0)
+        + (8 * n if ts is not None else 0)
+    )
     if nbytes > DEFAULT_MAX_FRAME:
         raise ValueError(
             f"frame payload of {nbytes} bytes exceeds the reader bound "
-            f"{DEFAULT_MAX_FRAME}; lower frame_edges (wide/val columns "
-            "cost up to 24 bytes per edge)"
+            f"{DEFAULT_MAX_FRAME}; lower frame_edges (wide/val/ts "
+            "columns cost up to 32 bytes per edge)"
         )
     dt = "<i8" if wide else "<i4"
-    flags = (F_WIDE if wide else 0) | (F_VAL if val is not None else 0)
+    flags = (
+        (F_WIDE if wide else 0)
+        | (F_VAL if val is not None else 0)
+        | (F_TS if ts is not None else 0)
+    )
     parts = [src.astype(dt, copy=False).tobytes(),
              dst.astype(dt, copy=False).tobytes()]
     if val is not None:
@@ -163,30 +185,57 @@ def pack_edge_frame(
         if val.shape[0] != n:
             raise ValueError("val column length disagrees with src/dst")
         parts.append(val.astype("<f8", copy=False).tobytes())
+    if ts is not None:
+        ts = np.ascontiguousarray(ts, np.int64)
+        if ts.shape[0] != n:
+            raise ValueError("ts column length disagrees with src/dst")
+        parts.append(ts.astype("<i8", copy=False).tobytes())
     payload = b"".join(parts)
-    return HEADER.pack(MAGIC, VERSION, flags, n, len(payload), seq) + payload
+    version = VERSION_TS if ts is not None else VERSION
+    return HEADER.pack(MAGIC, version, flags, n, len(payload), seq) + payload
 
 
-def decode_frame_payload(
-    payload: bytes, n_edges: int, flags: int
-) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+def decode_frame_payload(payload: bytes, n_edges: int, flags: int):
     """Decode a frame payload into ``(src i64, dst i64, val f64|None)``
     columns — one native call per frame
-    (:func:`gelly_streaming_tpu.native.decode_edge_frame`)."""
+    (:func:`gelly_streaming_tpu.native.decode_edge_frame`) — plus a
+    trailing ``ts i64`` column when the frame carries ``F_TS`` (the
+    return arity mirrors the flags, the codec-symmetry rule: a v1
+    frame decodes exactly as it always did). The ts column rides LAST
+    in the payload precisely so the native decoder's prefix stays
+    byte-identical across versions."""
     from .. import native as _native
 
+    ts = None
+    if flags & F_TS:
+        tail = 8 * n_edges
+        if len(payload) < tail:
+            raise MalformedFrame(
+                "columns",
+                f"payload of {len(payload)} bytes cannot carry a "
+                f"{tail}-byte ts column",
+            )
+        ts = np.frombuffer(
+            payload, "<i8", n_edges, len(payload) - tail
+        ).astype(np.int64, copy=True)
+        payload = payload[:-tail] if tail else payload
     try:
-        return _native.decode_edge_frame(
+        cols = _native.decode_edge_frame(
             payload, n_edges, bool(flags & F_WIDE), bool(flags & F_VAL)
         )
     except ValueError as e:
         raise MalformedFrame("columns", str(e)) from e
+    return cols if ts is None else cols + (ts,)
 
 
 def frame_geometry(n_edges: int, flags: int) -> int:
     """Payload byte length the header's (n_edges, flags) pair implies."""
     isz = 8 if flags & F_WIDE else 4
-    return n_edges * isz * 2 + (8 * n_edges if flags & F_VAL else 0)
+    return (
+        n_edges * isz * 2
+        + (8 * n_edges if flags & F_VAL else 0)
+        + (8 * n_edges if flags & F_TS else 0)
+    )
 
 
 def read_edge_frame(
@@ -205,8 +254,14 @@ def read_edge_frame(
     magic, version, flags, n_edges, plen, seq = HEADER.unpack(head)
     if magic != MAGIC:
         raise MalformedFrame("magic", f"bad magic {magic!r}")
-    if version != VERSION:
+    if version not in (VERSION, VERSION_TS):
         raise MalformedFrame("version", f"unsupported version {version}")
+    if version == VERSION and flags & F_TS:
+        # the ts column is exactly what v2 versions: a v1 frame
+        # claiming one is a contract violation, not a decode attempt
+        raise MalformedFrame(
+            "version", "ts column flag requires a version-2 frame"
+        )
     if n_edges > max_edges or plen > max_frame:
         raise MalformedFrame(
             "oversized",
@@ -292,19 +347,25 @@ def shard_of(src, dst, nshards: int) -> np.ndarray:
 
 
 def partition_edges(
-    src, dst, val=None, nshards: int = 1
-) -> List[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+    src, dst, val=None, nshards: int = 1, ts=None
+) -> List[Tuple]:
     """Split edge columns into per-shard column triples, stream order
-    preserved within each shard (what a keyed shuffle delivers)."""
+    preserved within each shard (what a keyed shuffle delivers). With
+    ``ts`` (an aligned i64 event-timestamp column) each entry is the
+    4-tuple ``(src, dst, val|None, ts)`` instead — order preservation
+    is what keeps per-shard watermarks honest."""
     src = np.asarray(src)
     dst = np.asarray(dst)
     owner = shard_of(src, dst, nshards)
     out = []
     for i in range(nshards):
         m = owner == i
-        out.append((
+        cols = (
             src[m], dst[m], None if val is None else np.asarray(val)[m]
-        ))
+        )
+        if ts is not None:
+            cols = cols + (np.asarray(ts, np.int64)[m],)
+        out.append(cols)
     return out
 
 
@@ -349,7 +410,8 @@ class _Shard:
     replay-dedup watermark, and lazily-resolved obs instruments."""
 
     __slots__ = ("index", "addr", "q", "thread", "error", "last_seq",
-                 "nrec", "pend", "have", "_gauge", "_stall", "_resume")
+                 "nrec", "pend", "have", "watermark", "_gauge", "_stall",
+                 "_resume", "_late")
 
     def __init__(self, index: int, addr, depth: int):
         self.index = index
@@ -361,9 +423,15 @@ class _Shard:
         self.nrec = 0       # accepted-record ordinal (fault hook index)
         self.pend: list = []  # buffered column triples of the open window
         self.have = 0
+        # per-shard event-time watermark: max observed ts (monotone;
+        # GSEW preserves per-shard arrival order so the max IS the
+        # promise). Written only by this shard's reader thread; the
+        # cross-shard merge happens on demand at the consumer.
+        self.watermark: Optional[int] = None
         self._gauge = None
         self._stall = None
         self._resume = None
+        self._late = None  # lazy eventtime.late_dropped counter
 
 
 class ShardedEdgeSource:
@@ -395,6 +463,8 @@ class ShardedEdgeSource:
         fmt: str = "binary",
         queue_windows: int = 4,
         weighted: bool = False,
+        timestamps: bool = False,
+        allowed_lateness_s: int = 0,
         tick_s: float = 0.2,
         reconnect: int = 5,
         reconnect_base_s: float = 0.05,
@@ -406,9 +476,21 @@ class ShardedEdgeSource:
             raise ValueError(f"fmt must be binary/text, got {fmt!r}")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+        if timestamps and fmt != "binary":
+            raise ValueError(
+                "timestamps=True requires fmt='binary' (the line "
+                "protocol carries no ts column; use SocketEdgeSource's "
+                "ts extractor for text streams)"
+            )
+        if allowed_lateness_s < 0:
+            raise ValueError(
+                f"allowed_lateness_s must be >= 0, got {allowed_lateness_s}"
+            )
         self.window = int(window)
         self.fmt = fmt
         self.weighted = weighted
+        self.timestamps = bool(timestamps)
+        self.allowed_lateness_s = int(allowed_lateness_s)
         self.tick_s = float(tick_s)
         self.reconnect = int(reconnect)
         self.reconnect_base_s = float(reconnect_base_s)
@@ -423,6 +505,7 @@ class ShardedEdgeSource:
         ]
         self._started = False
         self._consumed = False
+        self._ended: set = set()  # shards whose _DONE was consumed
 
     @property
     def nshards(self) -> int:
@@ -444,11 +527,15 @@ class ShardedEdgeSource:
 
     def close(self, join_timeout_s: float = 10.0) -> None:
         self._stop.set()
+        # ONE total budget across every reader join (the GL008 deadline
+        # discipline): N slow threads share join_timeout_s, they do not
+        # each get a fresh one
+        deadline = time.monotonic() + join_timeout_s
         for sh in self._shards:
             t = sh.thread
             if t is None:
                 continue
-            t.join(timeout=join_timeout_s)
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
             if t.is_alive():
                 # same posture as pipeline.prefetch: a reader that never
                 # honored the stop flag is a silent leak — surface it
@@ -469,6 +556,25 @@ class ShardedEdgeSource:
         arrival order until every shard ends cleanly. Single use. A
         shard's reader error (exhausted reconnect budget, injected
         fatal) re-raises HERE, after its queued windows drained."""
+        for sh, item in self._merged_items():
+            yield (sh.index,) + item[:3]
+
+    def windows_ts(
+        self,
+    ) -> Iterator[Tuple[int, np.ndarray, np.ndarray,
+                        Optional[np.ndarray], np.ndarray]]:
+        """Yield ``(shard, src, dst, val|None, ts)`` closed windows in
+        arrival order — the event-time consumer surface (what
+        :func:`gelly_streaming_tpu.eventtime.stream.drive_sliding`
+        drives). Requires ``timestamps=True``; single use."""
+        if not self.timestamps:
+            raise RuntimeError(
+                "windows_ts() requires ShardedEdgeSource(timestamps=True)"
+            )
+        for sh, item in self._merged_items():
+            yield (sh.index,) + item
+
+    def _merged_items(self):
         if self._consumed:
             raise RuntimeError("ShardedEdgeSource is single-use")
         self._consumed = True
@@ -497,12 +603,40 @@ class ShardedEdgeSource:
                     continue  # close() raced the token; nothing to do
                 if item is _DONE:
                     done += 1
+                    self._ended.add(sh.index)
                     if sh.error is not None:
                         raise sh.error
                     continue
-                yield (sh.index,) + item
+                yield sh, item
         finally:
             self.close()
+
+    # ------------------------------------------------------------------ #
+    # Event-time progress (timestamps=True)
+    # ------------------------------------------------------------------ #
+    def shard_watermarks(self) -> List[int]:
+        """Per-shard watermarks (max accepted ts; ``NO_WATERMARK`` for
+        a shard that has not observed event time yet)."""
+        from ..eventtime.watermark import NO_WATERMARK
+
+        return [
+            NO_WATERMARK if sh.watermark is None else sh.watermark
+            for sh in self._shards
+        ]
+
+    def watermark(self) -> int:
+        """The merged event-time watermark: the min over LIVE shards'
+        marks (THE cross-shard rule,
+        :func:`gelly_streaming_tpu.eventtime.watermark.merge_watermarks`).
+        Ended shards leave the merge — a closed stream holds nothing
+        back."""
+        from ..eventtime.watermark import NO_WATERMARK, merge_watermarks
+
+        return merge_watermarks(
+            NO_WATERMARK if sh.watermark is None else sh.watermark
+            for sh in self._shards
+            if sh.index not in self._ended
+        )
 
     def stream(self, vertex_dict=None, context=None, *,
                val_dtype=np.float32) -> "ShardedEdgeStream":
@@ -578,8 +712,18 @@ class ShardedEdgeSource:
                         {"edges": int(n), "shard": sh.index}
                         if _trace.on() else None,
                     ):
-                        src, dst, val = decode_frame_payload(
-                            payload, n, flags
+                        cols = decode_frame_payload(payload, n, flags)
+                    src, dst, val = cols[:3]
+                    ts = cols[3] if len(cols) > 3 else None
+                    if self.timestamps and ts is None:
+                        # a ts-expecting reader fed a ts-less stream is
+                        # a misconfigured pairing, not decodable data:
+                        # counted malformed + reconnect, and the streak
+                        # guard classifies the determinism
+                        raise MalformedFrame(
+                            "ts_missing",
+                            "reader expects event timestamps but the "
+                            "frame carries no ts column (GSEW v1 peer?)",
                         )
                     # fault hook BEFORE the frame is accepted: an
                     # injected disconnect drops the WHOLE frame (seq
@@ -596,7 +740,20 @@ class ShardedEdgeSource:
                     malformed_streak = 0  # real progress, not a replay
                     if not self.weighted:
                         val = None
-                    if not self._buffer_cols(sh, src, dst, val):
+                    if not self.timestamps:
+                        ts = None  # tolerated, unused: count windows
+                    elif ts is not None and len(ts):
+                        ts, src, dst, val = self._drop_late(
+                            sh, ts, src, dst, val
+                        )
+                        hi = int(ts.max()) if len(ts) else None
+                        if hi is not None and (
+                            sh.watermark is None or hi > sh.watermark
+                        ):
+                            sh.watermark = hi
+                        if not len(src):
+                            continue
+                    if not self._buffer_cols(sh, src, dst, val, ts):
                         raise _Stopped()
             except MalformedFrame as e:
                 # counted evidence + clean reconnect: framing cannot
@@ -704,10 +861,36 @@ class ShardedEdgeSource:
     # ------------------------------------------------------------------ #
     # Window assembly + the backpressure boundary
     # ------------------------------------------------------------------ #
-    def _buffer_cols(self, sh: _Shard, src, dst, val) -> bool:
+    def _drop_late(self, sh: _Shard, ts, src, dst, val):
+        """The source-level lateness policy: a record older than this
+        shard's watermark minus ``allowed_lateness_s`` is DROPPED and
+        counted ``eventtime.late_dropped`` (the LATE-DROP story line) —
+        never silently absorbed into a window that event time already
+        passed. Within the allowance, out-of-order records pass through
+        (the pane assembler buffers them into their proper pane)."""
+        if sh.watermark is None:
+            return ts, src, dst, val
+        late = ts < sh.watermark - self.allowed_lateness_s
+        n_late = int(late.sum())
+        if not n_late:
+            return ts, src, dst, val
+        if sh._late is None:
+            sh._late = get_registry().counter(
+                "eventtime.late_dropped", shard=str(sh.index)
+            )
+        sh._late.inc(n_late)
+        keep = ~late
+        return (
+            ts[keep], src[keep], dst[keep],
+            None if val is None else val[keep],
+        )
+
+    def _buffer_cols(self, sh: _Shard, src, dst, val, ts=None) -> bool:
         from .window import take_cols
 
-        sh.pend.append((src, dst, val))
+        sh.pend.append(
+            (src, dst, val) if ts is None else (src, dst, val, ts)
+        )
         sh.have += len(src)
         while sh.have >= self.window:
             sh.have -= self.window
@@ -849,12 +1032,16 @@ class ShardedEdgeStream(SimpleEdgeStream):
 # --------------------------------------------------------------------- #
 def encode_shard_frames(
     src, dst, val=None, *, frame_edges: int = 8192,
-    wide: Optional[bool] = None,
+    wide: Optional[bool] = None, ts=None,
 ) -> bytes:
     """Pre-encode one shard's whole stream as consecutive GSEW frames
-    (seq 1..N) — what the serve-from-memory peer sends verbatim."""
+    (seq 1..N) — what the serve-from-memory peer sends verbatim.
+    ``ts`` (an i64 column aligned with src/dst) makes every frame
+    GSEW v2."""
     src = np.asarray(src)
     dst = np.asarray(dst)
+    if ts is not None:
+        ts = np.asarray(ts, np.int64)
     parts = []
     seq = 0
     for a in range(0, len(src), frame_edges):
@@ -864,6 +1051,7 @@ def encode_shard_frames(
             src[a:b], dst[a:b],
             None if val is None else np.asarray(val)[a:b],
             seq=seq, wide=wide,
+            ts=None if ts is None else ts[a:b],
         ))
     return b"".join(parts)
 
@@ -955,7 +1143,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             "usage: python -m gelly_streaming_tpu.core.ingest --serve "
             "--shards N --edges M [--scale S] [--seed K] "
-            "[--format binary|text] [--frame-edges F] [--accepts A]",
+            "[--format binary|text] [--frame-edges F] [--accepts A] "
+            "[--timestamps] [--ts-rate R]",
             file=sys.stderr,
         )
         return 2
@@ -967,23 +1156,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     fmt = take("--format", "binary")
     frame_edges = int(take("--frame-edges", "8192"))
     accepts = int(take("--accepts", "1"))
+    timestamps = "--timestamps" in argv
+    if timestamps:
+        argv.remove("--timestamps")
+    ts_rate = int(take("--ts-rate", "4096"))
     from ..datasets import rmat_edges
 
     src, dst = rmat_edges(n_edges, scale, seed=seed)
-    parts = partition_edges(src, dst, None, shards)
+    ts = None
+    if timestamps:
+        # synthetic event time: ts_rate edges per tick, monotone over
+        # the pre-partition stream (per-shard order preserved, so each
+        # shard's watermark promise holds on the wire)
+        ts = np.arange(n_edges, dtype=np.int64) // max(1, ts_rate)
+    parts = partition_edges(src, dst, None, shards, ts=ts)
     if fmt == "binary":
         blobs = [
-            encode_shard_frames(s, d, frame_edges=frame_edges)
-            for s, d, _v in parts
+            encode_shard_frames(
+                p[0], p[1], frame_edges=frame_edges,
+                ts=p[3] if timestamps else None,
+            )
+            for p in parts
         ]
     else:
-        blobs = [encode_shard_text(s, d) for s, d, _v in parts]
+        blobs = [encode_shard_text(p[0], p[1]) for p in parts]
     ports, threads, _stop = serve_blobs(blobs, accepts=accepts)
     print(json.dumps({
         "ports": ports,
         "edges": int(n_edges),
-        "per_shard": [int(len(s)) for s, _d, _v in parts],
+        "per_shard": [int(len(p[0])) for p in parts],
         "format": fmt,
+        "timestamps": bool(timestamps),
     }), flush=True)
     for t in threads:
         t.join()

@@ -71,6 +71,20 @@ def _perturbed(records: Iterator) -> Iterator:
     return records
 
 
+def _with_ts(records: Iterator, extractor) -> Iterator:
+    """Append an event timestamp to each record: ``(s, d, v)`` becomes
+    ``(s, d, v, ts)`` via ``extractor(s, d, v) -> int``. ``None`` idle
+    ticks pass through. Applied BEFORE the fault-plan perturbation so
+    an installed skew schedule (``FaultPlan.skew_records``) jitters the
+    extracted ts like any other field — chaos sees the same record
+    shape the pipeline does."""
+    for rec in records:
+        if rec is None:
+            yield None
+            continue
+        yield rec + (int(extractor(*rec)),)
+
+
 class SocketEdgeSource:
     """Unbounded edge records over TCP (``env.socketTextStream`` parity).
 
@@ -110,6 +124,7 @@ class SocketEdgeSource:
         reconnect: int = 5,
         reconnect_base_s: float = 0.05,
         reconnect_max_s: float = 2.0,
+        ts_extractor=None,
     ):
         self.host = host
         self.port = port
@@ -118,10 +133,18 @@ class SocketEdgeSource:
         self.reconnect = int(reconnect)
         self.reconnect_base_s = float(reconnect_base_s)
         self.reconnect_max_s = float(reconnect_max_s)
+        # event-time extractor (ISSUE 18): ``f(s, d, v) -> int`` turns
+        # each record into the 4-tuple ``(s, d, v, ts)`` — the line
+        # protocol carries no ts column, so event time rides whatever
+        # field the deployment encodes it in (typically the value)
+        self.ts_extractor = ts_extractor
         self._malformed = None  # lazy counter (registry may be swapped)
 
     def __iter__(self) -> Iterator[Optional[Tuple]]:
-        return _perturbed(self._records())
+        records = self._records()
+        if self.ts_extractor is not None:
+            records = _with_ts(records, self.ts_extractor)
+        return _perturbed(records)
 
     # ------------------------------------------------------------------ #
     def _records(self) -> Iterator[Optional[Tuple]]:
@@ -264,13 +287,24 @@ class GeneratorSource:
         chunk: int = 1 << 14,
         seed: int = 0,
         limit: Optional[int] = None,
+        ts_rate: Optional[int] = None,
+        ts_start: int = 0,
     ):
         self.scale = scale
         self.chunk = chunk
         self.seed = seed
         self.limit = limit
+        # synthetic event time (ISSUE 18): ``ts_rate`` edges per tick
+        # starting at ``ts_start`` — monotone by construction, so the
+        # stream's own max IS a valid watermark promise
+        if ts_rate is not None and ts_rate < 1:
+            raise ValueError(f"ts_rate must be >= 1, got {ts_rate}")
+        self.ts_rate = ts_rate
+        self.ts_start = int(ts_start)
 
     def __iter__(self) -> Iterator[Tuple]:
+        if self.ts_rate is not None:
+            return _perturbed(self._records_ts())
         return _perturbed(self._records())
 
     def iter_chunks(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
@@ -322,3 +356,62 @@ class GeneratorSource:
         for src, dst in self._column_chunks():
             for s, d in zip(src.tolist(), dst.tolist()):
                 yield (s, d, 0.0)
+
+    # ------------------------------------------------------------------ #
+    # Event time (ISSUE 18)
+    # ------------------------------------------------------------------ #
+    def _ts_of(self, ordinal: int) -> int:
+        return self.ts_start + ordinal // self.ts_rate
+
+    def _records_ts(self) -> Iterator[Tuple]:
+        ordinal = 0
+        for src, dst in self._column_chunks():
+            for s, d in zip(src.tolist(), dst.tolist()):
+                yield (s, d, 0.0, self._ts_of(ordinal))
+                ordinal += 1
+
+    def iter_chunks_ts(
+        self,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """``iter_chunks`` with the synthetic i64 ts column appended:
+        ``(src, dst, ts)`` — what an event-time drive feeds straight
+        into :class:`~gelly_streaming_tpu.eventtime.SlidingGraphAggregator`.
+        Requires ``ts_rate``. Under an installed fault plan with record
+        perturbations the chunks re-assemble from the perturbed record
+        path (including any ts skew schedule), like
+        :meth:`iter_chunks`."""
+        if self.ts_rate is None:
+            raise RuntimeError(
+                "iter_chunks_ts() requires GeneratorSource(ts_rate=...)"
+            )
+        plan = _faults.plan()
+        if plan is not None and plan.perturbs_records():
+            bs: list = []
+            bd: list = []
+            bt: list = []
+            for rec in _perturbed(self._records_ts()):
+                if rec is None:
+                    continue
+                bs.append(rec[0])
+                bd.append(rec[1])
+                bt.append(rec[3])
+                if len(bs) >= self.chunk:
+                    yield (np.asarray(bs, np.int64),
+                           np.asarray(bd, np.int64),
+                           np.asarray(bt, np.int64))
+                    bs, bd, bt = [], [], []
+            if bs:
+                yield (np.asarray(bs, np.int64),
+                       np.asarray(bd, np.int64),
+                       np.asarray(bt, np.int64))
+            return
+        produced = 0
+        for src, dst in self._column_chunks():
+            n = len(src)
+            ts = (
+                self.ts_start
+                + (produced + np.arange(n, dtype=np.int64))
+                // self.ts_rate
+            )
+            produced += n
+            yield src, dst, ts

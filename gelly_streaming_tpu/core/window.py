@@ -806,28 +806,45 @@ def take_cols(pend: list, take: int, val_dtype=np.float64):
     assembly (``core/ingest.py``). Single-chunk takes hand out slice
     VIEWS (no concatenation copy — the encoder reads views);
     multi-chunk takes concatenate once, zero-filling ``None`` value
-    chunks when any chunk carries values."""
-    s_parts, d_parts, v_parts = [], [], []
+    chunks when any chunk carries values.
+
+    Chunks may carry a 4th element — the i64 event-time ``ts`` column of
+    a GSEW v2 frame (ISSUE 18); the take then returns a matching
+    4-tuple, slicing ``ts`` in lockstep. Mixed pending lists (some
+    chunks timestamped, some not) are a caller bug and raise: a window
+    half of whose records lost their timestamps cannot be assigned to
+    event-time panes honestly."""
+    with_ts = len(pend[0]) == 4
+    s_parts, d_parts, v_parts, t_parts = [], [], [], []
     got = 0
     while got < take:
-        s, d, v = pend[0]
+        chunk = pend[0]
+        if (len(chunk) == 4) != with_ts:
+            raise ValueError(
+                "pending column chunks disagree on carrying a ts column"
+            )
+        s, d, v = chunk[0], chunk[1], chunk[2]
+        t = chunk[3] if with_ts else None
         need = take - got
         if len(s) <= need:
             s_parts.append(s)
             d_parts.append(d)
             v_parts.append(v)
+            t_parts.append(t)
             pend.pop(0)
             got += len(s)
         else:
             s_parts.append(s[:need])
             d_parts.append(d[:need])
             v_parts.append(None if v is None else v[:need])
-            pend[0] = (
-                s[need:], d[need:], None if v is None else v[need:]
-            )
+            t_parts.append(None if t is None else t[:need])
+            rest = (s[need:], d[need:],
+                    None if v is None else v[need:])
+            pend[0] = rest + (t[need:],) if with_ts else rest
             got = take
     if len(s_parts) == 1:
-        return s_parts[0], d_parts[0], v_parts[0]
+        out = (s_parts[0], d_parts[0], v_parts[0])
+        return out + (t_parts[0],) if with_ts else out
     src = np.concatenate(s_parts)
     dst = np.concatenate(d_parts)
     if any(v is not None for v in v_parts):
@@ -840,6 +857,11 @@ def take_cols(pend: list, take: int, val_dtype=np.float64):
         )
     else:
         val = None
+    if with_ts:
+        ts = np.concatenate(
+            [np.asarray(t, np.int64) for t in t_parts]
+        )
+        return src, dst, val, ts
     return src, dst, val
 
 

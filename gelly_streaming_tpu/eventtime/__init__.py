@@ -1,0 +1,74 @@
+"""Event-time windowing + retraction: graphs that forget (ISSUE 18).
+
+Every window the repo streamed before this package was an add-only
+count window: edges entered, summaries grew, nothing ever left. The
+reference's richer half — keyed ``timeWindow``/``slice`` over event
+time (PAPER.md §1 L1/L2) — needs the opposite contract: records carry
+their OWN clock (an i64 ``ts`` column on the wire, GSEW v2), progress
+is a WATERMARK merged across shards by the min rule, and a SLIDING
+window retracts the pane that ages out — results must stay correct on
+the surviving edge multiset, not the union of everything ever seen.
+
+The pieces, one module each:
+
+- :mod:`.watermark` — per-shard watermark tracking and THE cross-shard
+  min-merge rule (:func:`merge_watermarks`), closing PR 11's
+  per-shard-count-windows-only residual.
+- :mod:`.panes` — the pane/slice decomposition: a sliding window of
+  ``(size, slide)`` with ``size % slide == 0`` is a union of
+  ``size//slide`` tumbling PANES of length ``slide``; the assembler
+  buffers arriving column chunks per pane, closes panes as the
+  watermark passes them, and drops records later than the lateness
+  bound as counted ``eventtime.late_dropped`` — never silently.
+  Closed panes are plain raw-id column tuples, so they pack like count
+  windows through
+  :meth:`~gelly_streaming_tpu.core.window.Windower.pack_window_cols`
+  and the superbatch/group-fold path consumes them unchanged.
+- :mod:`.retract` — decremental summaries: exact decremental
+  degree/heavy-hitters, CC via the forest REPAIR kernel
+  (:func:`~gelly_streaming_tpu.summaries.forest.repair_forest_host` —
+  rebuild only affected components from surviving pane edges), and
+  bipartiteness with odd-cycle latch re-resolution on expiry. Each
+  ships its from-scratch oracle; the test suite pins byte identity on
+  the surviving multiset at every pane boundary.
+- :mod:`.stream` — :class:`SlidingGraphAggregator`, the driver that
+  sequences pane close -> retract expired pane -> fold new pane ->
+  emit window, with atomic checksummed commits between panes so a kill
+  between pane close and retraction commit recovers oracle-identical
+  (the chaos contract).
+
+Serving reports how far behind real time an answer is: the emitted
+window results and published snapshots carry an ``event_ts`` watermark
+stamp that rides :class:`~gelly_streaming_tpu.serving.query.Answer`
+next to its snapshot version.
+"""
+
+from .panes import EventTimeSlidingWindow, Pane, PaneAssembler
+from .retract import (
+    DecBipartite,
+    DecDegree,
+    DecForest,
+    oracle_bipartite,
+    oracle_degrees,
+    oracle_labels,
+)
+from .stream import SlidingGraphAggregator, WindowResult, drive_sliding
+from .watermark import NO_WATERMARK, WatermarkTracker, merge_watermarks
+
+__all__ = [
+    "EventTimeSlidingWindow",
+    "Pane",
+    "PaneAssembler",
+    "DecBipartite",
+    "DecDegree",
+    "DecForest",
+    "oracle_bipartite",
+    "oracle_degrees",
+    "oracle_labels",
+    "SlidingGraphAggregator",
+    "WindowResult",
+    "drive_sliding",
+    "NO_WATERMARK",
+    "WatermarkTracker",
+    "merge_watermarks",
+]

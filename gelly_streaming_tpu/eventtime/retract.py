@@ -1,0 +1,278 @@
+"""Decremental summaries: results that stay correct as panes expire.
+
+The add-only single-pass model structurally excludes retraction — a
+degree count can decrement, but a union-find cannot un-union. Each
+summary here picks the cheapest HONEST decremental strategy, and each
+ships its from-scratch oracle so the contract is testable as byte
+identity on the surviving edge multiset (the acceptance criterion
+``tests/test_eventtime.py`` pins at every pane boundary):
+
+- **Degree / heavy hitters** (:class:`DecDegree`) — exactly
+  decremental: per-vertex counts are a sum, so expiry subtracts the
+  pane's contribution (one ``np.subtract.at``). Heavy hitters are the
+  exact top-k of the maintained table with deterministic ties (degree
+  desc, vertex id asc) — no sketch, no approximation to un-approximate.
+- **Connected components** (:class:`DecForest`) — union-find supports
+  union, not deletion, so expiry goes through the forest REPAIR kernel
+  (:func:`~gelly_streaming_tpu.summaries.forest.repair_forest_host`):
+  only the components the expired edges touched are reset and re-folded
+  from the surviving panes' edges — bounded recompute from the
+  group-fold contract's carried table, not a from-scratch rebuild.
+- **Bipartiteness** (:class:`DecBipartite`) — the signed double cover
+  (``summaries/candidates.py`` semantics) over ``2 * vcap`` cover ids.
+  The odd-cycle verdict is a LATCH while adding (a conflict, once
+  merged, stays), but expiry can dissolve the odd cycle — so on
+  retraction the cover forest is repaired and the latch RE-RESOLVED
+  from the repaired cover (conflict iff some live vertex's (+) and (-)
+  cover nodes share a component), never carried stale across an expiry.
+
+All three grow their vertex capacity amortized-doubling; labels of
+existing vertices are preserved exactly across growth (new rows are
+singletons, which is what a from-scratch fold over the same multiset
+produces for unseen ids).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..summaries.forest import (
+    fold_edges_host,
+    fold_into_forest_host,
+    repair_forest_host,
+    resolve_flat_host,
+)
+
+_EMPTY = np.zeros(0, np.int64)
+
+
+# --------------------------------------------------------------------- #
+# From-scratch oracles (the byte-identity reference for every summary)
+# --------------------------------------------------------------------- #
+def oracle_labels(vcap: int, src, dst) -> np.ndarray:
+    """CC labels of the given edge multiset, from scratch: one
+    group-fold over an identity table — THE reference the repair kernel
+    must match byte-for-byte."""
+    return fold_edges_host(
+        np.arange(vcap, dtype=np.int64),
+        np.asarray(src, np.int64), np.asarray(dst, np.int64),
+    )
+
+
+def oracle_degrees(vcap: int, src, dst) -> np.ndarray:
+    """Degrees of the given edge multiset, from scratch (both endpoints
+    count; self-loops count twice — the multiset convention every
+    decremental path must share)."""
+    deg = np.zeros(vcap, np.int64)
+    np.add.at(deg, np.asarray(src, np.int64), 1)
+    np.add.at(deg, np.asarray(dst, np.int64), 1)
+    return deg
+
+
+def oracle_bipartite(vcap: int, src, dst) -> bool:
+    """Bipartiteness of the given edge multiset, from scratch: CC over
+    the signed double cover; bipartite iff no vertex's (+)/(-) cover
+    nodes share a component."""
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    cs, cd = _cover_cols(src, dst, vcap)
+    lab = fold_edges_host(np.arange(2 * vcap, dtype=np.int64), cs, cd)
+    return not bool(np.any(lab[:vcap] == lab[vcap:]))
+
+
+def _cover_cols(src: np.ndarray, dst: np.ndarray,
+                vcap: int) -> Tuple[np.ndarray, np.ndarray]:
+    """One edge column pair expanded to the signed-cover pair
+    ((u,+)~(v,-) and (u,-)~(v,+)) — the same expansion
+    ``library/bipartiteness.py`` uses, over ``2 * vcap`` cover ids."""
+    return (
+        np.concatenate([src, src + vcap]),
+        np.concatenate([dst + vcap, dst]),
+    )
+
+
+# --------------------------------------------------------------------- #
+# Degree / heavy hitters
+# --------------------------------------------------------------------- #
+class DecDegree:
+    """Exact decremental degree table + exact top-k heavy hitters."""
+
+    def __init__(self, vcap: int = 0):
+        self.deg = np.zeros(int(vcap), np.int64)
+
+    @property
+    def vcap(self) -> int:
+        return len(self.deg)
+
+    def grow(self, vcap: int) -> None:
+        if vcap > len(self.deg):
+            self.deg = np.concatenate(
+                [self.deg, np.zeros(vcap - len(self.deg), np.int64)]
+            )
+
+    def add(self, src, dst) -> None:
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        np.add.at(self.deg, src, 1)
+        np.add.at(self.deg, dst, 1)
+
+    def retract(self, src, dst) -> None:
+        """Subtract one expired pane's contribution — degrees are a
+        sum, so this is EXACT (never clamped: a negative degree here
+        is a caller bug the tests would catch, not data)."""
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        np.subtract.at(self.deg, src, 1)
+        np.subtract.at(self.deg, dst, 1)
+
+    def top_k(self, k: int) -> list:
+        """Exact heavy hitters: ``[(vertex, degree), ...]`` sorted by
+        degree desc then vertex id asc (deterministic ties), zero-degree
+        vertices excluded."""
+        nz = np.nonzero(self.deg)[0]
+        if len(nz) == 0 or k < 1:
+            return []
+        # sort by (-degree, id): lexsort's LAST key is primary
+        order = np.lexsort((nz, -self.deg[nz]))[:k]
+        picked = nz[order]
+        return [(int(v), int(self.deg[v])) for v in picked]
+
+    def state_dict(self) -> dict:
+        return {"deg": self.deg.copy()}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.deg = np.asarray(state["deg"], np.int64).copy()
+
+
+# --------------------------------------------------------------------- #
+# Connected components
+# --------------------------------------------------------------------- #
+class DecForest:
+    """CC over the live multiset: incremental union on pane close,
+    bounded repair on pane expiry.
+
+    The carried table is the canonical min-rooted host forest the
+    group-fold contract already uses
+    (:func:`~gelly_streaming_tpu.summaries.forest.fold_edges_host`
+    output), so between retractions it is byte-identical to a
+    from-scratch fold by construction; across a retraction the repair
+    kernel re-establishes the identity over the SURVIVING multiset and
+    reports the bounded-recompute stats (affected roots/members,
+    re-folded edges) the bench's retraction-vs-rebuild cell commits."""
+
+    def __init__(self, vcap: int = 0):
+        self.lab = np.arange(int(vcap), dtype=np.int64)
+        self.last_repair: Dict[str, int] = {}
+
+    @property
+    def vcap(self) -> int:
+        return len(self.lab)
+
+    def grow(self, vcap: int) -> None:
+        if vcap > len(self.lab):
+            self.lab = np.concatenate([
+                self.lab,
+                np.arange(len(self.lab), vcap, dtype=np.int64),
+            ])
+
+    def add(self, src, dst) -> None:
+        self.lab = fold_into_forest_host(self.lab, src, dst)
+
+    def retract(self, expired_src, expired_dst,
+                surviving_src, surviving_dst) -> Dict[str, int]:
+        self.lab, stats = repair_forest_host(
+            self.lab, expired_src, expired_dst,
+            surviving_src, surviving_dst,
+        )
+        self.last_repair = stats
+        return stats
+
+    def labels(self) -> np.ndarray:
+        return resolve_flat_host(self.lab)
+
+    def state_dict(self) -> dict:
+        return {"lab": self.lab.copy()}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.lab = np.asarray(state["lab"], np.int64).copy()
+
+
+# --------------------------------------------------------------------- #
+# Bipartiteness
+# --------------------------------------------------------------------- #
+class DecBipartite:
+    """Bipartiteness over the live multiset via the signed double
+    cover, with the odd-cycle latch RE-RESOLVED on every expiry.
+
+    While only adding, the verdict is the usual latch — once some
+    vertex's (+)/(-) cover nodes merge, more edges cannot unmerge them.
+    Expiry breaks the latch's monotonicity, so :meth:`retract` repairs
+    the cover forest (the same bounded kernel as CC, over ``2 * vcap``
+    cover ids and cover-expanded columns) and recomputes the verdict
+    from the repaired structure — the cover table is the truth, the
+    latch is only a cache of it (the ``serving/query.py`` bipartite
+    ethos)."""
+
+    def __init__(self, vcap: int = 0):
+        self.vcap = int(vcap)
+        self.cover = np.arange(2 * self.vcap, dtype=np.int64)
+
+    def grow(self, vcap: int) -> None:
+        """Grow the COVER table preserving labels: cover ids are
+        ``v`` / ``v + vcap``, so growth re-homes the (-) half to the
+        new offset (labels that pointed into the old (-) half shift
+        with it)."""
+        vcap = int(vcap)
+        if vcap <= self.vcap:
+            return
+        old = self.vcap
+        lab = resolve_flat_host(self.cover)
+        grown = np.arange(2 * vcap, dtype=np.int64)
+        shift = np.where(lab >= old, lab + (vcap - old), lab)
+        grown[:old] = shift[:old]
+        grown[vcap:vcap + old] = shift[old:]
+        self.vcap = vcap
+        self.cover = resolve_flat_host(grown)
+
+    def add(self, src, dst) -> None:
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        cs, cd = _cover_cols(src, dst, self.vcap)
+        self.cover = fold_into_forest_host(self.cover, cs, cd)
+
+    def retract(self, expired_src, expired_dst,
+                surviving_src, surviving_dst) -> Dict[str, int]:
+        es, ed = _cover_cols(
+            np.asarray(expired_src, np.int64),
+            np.asarray(expired_dst, np.int64), self.vcap,
+        )
+        ss, sd = _cover_cols(
+            np.asarray(surviving_src, np.int64),
+            np.asarray(surviving_dst, np.int64), self.vcap,
+        )
+        self.cover, stats = repair_forest_host(
+            self.cover, es, ed, ss, sd,
+        )
+        return stats
+
+    def is_bipartite(self) -> bool:
+        """The verdict, resolved from the cover structure (never a
+        carried boolean across an expiry)."""
+        lab = resolve_flat_host(self.cover)
+        return not bool(np.any(lab[:self.vcap] == lab[self.vcap:]))
+
+    def conflict_witness(self) -> Optional[int]:
+        """The smallest vertex whose (+)/(-) cover nodes share a
+        component, None when bipartite."""
+        lab = resolve_flat_host(self.cover)
+        hit = np.nonzero(lab[:self.vcap] == lab[self.vcap:])[0]
+        return int(hit[0]) if len(hit) else None
+
+    def state_dict(self) -> dict:
+        return {"vcap": self.vcap, "cover": self.cover.copy()}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.vcap = int(state["vcap"])
+        self.cover = np.asarray(state["cover"], np.int64).copy()

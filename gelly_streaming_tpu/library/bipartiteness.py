@@ -124,6 +124,21 @@ class BipartitenessCheck(SummaryBulkAggregation):
         self._prep = None     # WindowPrep scratch (forest carry)
         self._uf = None       # native CompactUnionFind over cover ids
 
+    @classmethod
+    def sliding(cls, size: int, slide=None, **kwargs):
+        """The EVENT-TIME shape of this workload: bipartiteness over a
+        sliding window, the odd-cycle latch RE-RESOLVED when panes
+        expire (ISSUE 18) — a configured
+        :class:`~gelly_streaming_tpu.eventtime.SlidingGraphAggregator`
+        restricted to the cover summary. ``size``/``slide`` are event
+        time units; extra kwargs pass through (``allowed_lateness``,
+        ``nshards``, ``commit_dir``, ...)."""
+        from ..eventtime import SlidingGraphAggregator
+
+        return SlidingGraphAggregator(
+            size, slide, summaries=("bipartite",), **kwargs
+        )
+
     # ---- dense-engine hooks (mesh / device-transformed fallback) ---- #
     def initial_state(self, vcap: int):
         return init_cover(max(1, vcap))

@@ -567,6 +567,21 @@ class CCServable:
 class ConnectedComponents(_CCMixin, SummaryBulkAggregation):
     """Flat-combine streaming CC (``library/ConnectedComponents.java``)."""
 
+    @classmethod
+    def sliding(cls, size: int, slide=None, **kwargs):
+        """The EVENT-TIME shape of this workload: CC over a sliding
+        window that retracts expired panes via bounded forest repair
+        (ISSUE 18) — a configured
+        :class:`~gelly_streaming_tpu.eventtime.SlidingGraphAggregator`
+        restricted to the CC summary. ``size``/``slide`` are event time
+        units; extra kwargs pass through (``allowed_lateness``,
+        ``nshards``, ``commit_dir``, ...)."""
+        from ..eventtime import SlidingGraphAggregator
+
+        return SlidingGraphAggregator(
+            size, slide, summaries=("cc",), **kwargs
+        )
+
 
 class ConnectedComponentsTree(_CCMixin, SummaryTreeReduce):
     """Tree-combine variant (``library/ConnectedComponentsTree.java:26-36``):

@@ -94,6 +94,21 @@ class DegreeDistribution:
         self._emit_base = 0  # event watermark of the last materialized batch
         self._emit_prev = None  # host hist at the last materialized batch
 
+    @classmethod
+    def sliding(cls, size: int, slide: Optional[int] = None, **kwargs):
+        """The EVENT-TIME shape of this workload: exact decremental
+        degrees + heavy hitters over a sliding window that retracts
+        expired panes (ISSUE 18) — a configured
+        :class:`~gelly_streaming_tpu.eventtime.SlidingGraphAggregator`
+        restricted to the degree summary. ``size``/``slide`` are event
+        time units; extra kwargs pass through (``allowed_lateness``,
+        ``nshards``, ``commit_dir``, ...)."""
+        from ..eventtime import SlidingGraphAggregator
+
+        return SlidingGraphAggregator(
+            size, slide, summaries=("degree",), **kwargs
+        )
+
     def run(self, events: Iterable[Tuple]) -> Iterator["HistogramBatch"]:
         """Yields one lazy :class:`HistogramBatch` per window — list-like
         ``(degree, count)`` change-only entries, downloaded on first read

@@ -118,6 +118,16 @@ STORY = {
     "fabric.exchange": "EXCHANGE",
     "fabric.elect": "ELECT",
     "fabric.agree": "AGREE",
+    # the event-time story (ISSUE 18): the merged watermark's advances,
+    # each pane the clock closed, each retraction of an expired pane
+    # out of the live summaries, and every record dropped past the
+    # lateness allowance — so a sliding-window chaos run renders as
+    # WATERMARK / PANE-CLOSE / KILL / RESTART / PANE-CLOSE (the replay)
+    # / RETRACT in causal order, late drops counted, never silent
+    "eventtime.watermark_advance": "WATERMARK",
+    "eventtime.pane_close": "PANE-CLOSE",
+    "eventtime.retract": "RETRACT",
+    "eventtime.late_dropped": "LATE-DROP",
     "flight": "BLACKBOX",
 }
 

@@ -78,6 +78,15 @@ class FaultPlan:
     drop_records: Tuple[int, ...] = ()
     duplicate_records: Tuple[int, ...] = ()
     swap_records: Tuple[int, ...] = ()     # swap record i with record i+1
+    # -- event-time skew (ISSUE 18): jitter record i's timestamp field
+    # by a deterministic bounded offset in [-skew_ts_s, +skew_ts_s],
+    # derived from (seed, i) — the out-of-order-ARRIVAL analog of
+    # swap_records, testing watermark/lateness handling instead of
+    # delivery order. ``skew_ts_field`` indexes the ts inside the
+    # record tuple (-1 = last element, the ``(s, d, v, ts)`` shape)
+    skew_records: Tuple[int, ...] = ()
+    skew_ts_s: int = 0
+    skew_ts_field: int = -1
     # -- checkpoint corruption ----------------------------------------- #
     corrupt_at_barrier: Optional[int] = None
     corrupt_mode: str = "flip"             # "flip" | "truncate"
@@ -86,7 +95,8 @@ class FaultPlan:
 
     def perturbs_records(self) -> bool:
         return bool(
-            self.drop_records or self.duplicate_records or self.swap_records
+            self.drop_records or self.duplicate_records
+            or self.swap_records or self.skew_records
         )
 
     # ------------------------------------------------------------------ #
@@ -194,11 +204,14 @@ class FaultPlan:
         through unindexed (they are time, not data). ``swap_records``
         holds record ``i`` back and emits ``i+1`` first — a bounded,
         deterministic reorder (the shape out-of-order delivery actually
-        takes at a window boundary).
+        takes at a window boundary). ``skew_records`` jitters record
+        ``i``'s timestamp field by a seed-derived bounded offset —
+        event-time disorder without reordering delivery.
         """
         drop = set(self.drop_records)
         dup = set(self.duplicate_records)
         swap = set(self.swap_records)
+        skew = set(self.skew_records)
         held = None  # (index, record) awaiting its swap partner
         i = 0
         for rec in records:
@@ -210,6 +223,9 @@ class FaultPlan:
             if idx in drop:
                 self._count("source.perturb")
                 continue
+            if idx in skew:
+                self._count("source.perturb")
+                rec = self._skewed(rec, idx)
             if held is not None:
                 yield rec
                 if idx in dup:
@@ -229,6 +245,27 @@ class FaultPlan:
                 yield rec
         if held is not None:  # swap partner never arrived: emit late
             yield held[1]
+
+    def _skewed(self, rec: tuple, idx: int):
+        """Record ``idx`` with its timestamp field jittered by a
+        DETERMINISTIC bounded offset in ``[-skew_ts_s, +skew_ts_s]``
+        derived from ``(seed, idx)`` — same plan, same jitter, every
+        run (the seeded-chaos rule). Records too short to carry the
+        field pass through untouched (a ts-less stream has no event
+        time to skew)."""
+        f = self.skew_ts_field
+        pos = f if f >= 0 else len(rec) + f
+        if self.skew_ts_s <= 0 or not (0 <= pos < len(rec)):
+            return rec
+        span = 2 * self.skew_ts_s + 1
+        # splitmix-style integer mix of (seed, idx): cheap, stateless,
+        # and identical across processes — no RNG object to carry
+        h = (idx * 0x9E3779B97F4A7C15 + self.seed * 0xC2B2AE3D27D4EB4F)
+        h ^= h >> 31
+        offset = (h % span) - self.skew_ts_s
+        out = list(rec)
+        out[pos] = int(out[pos]) + offset
+        return tuple(out)
 
 
 def corrupt_file(path: str, mode: str = "flip", *, seed: int = 0) -> None:

@@ -621,8 +621,11 @@ class RpcClient:
                         watermark=int(a[3]), staleness=int(a[4]),
                         # the snapshot version rides newer servers'
                         # replies (cache-invalidation key); absent on a
-                        # v1 peer's answers, which read as version 0
+                        # v1 peer's answers, which read as version 0.
+                        # the event-time watermark stamp follows it —
+                        # absent reads as -1, "no event time"
                         version=int(a[5]) if len(a) > 5 else 0,
+                        event_ts=int(a[6]) if len(a) > 6 else -1,
                     ))
                 elif a[0] == "deadline":
                     # a SERVER-reported expiry (the answer rode a RESP

@@ -145,13 +145,18 @@ class Answer:
     windows-behind-head gap at answer time (0 = answered at the head),
     ``version`` the snapshot's publish version — the monotone counter a
     routing tier keys its cache invalidation on (reply frames carry it,
-    so a router learns of shard progress from ordinary answers)."""
+    so a router learns of shard progress from ordinary answers).
+    ``event_ts`` is the snapshot's EVENT-TIME watermark (``-1`` when
+    the pipeline carries no event time): next to ``staleness``'s
+    windows-behind-head, it answers "how far behind the world" — the
+    data's own clock at the moment the served summaries were true."""
 
     value: Any
     window: int
     watermark: int
     staleness: int
     version: int = 0
+    event_ts: int = -1
 
 
 # --------------------------------------------------------------------- #
@@ -706,7 +711,7 @@ class QueryEngine:
                     out[i] = Answer(
                         value=doc, window=snap.window,
                         watermark=snap.watermark, staleness=staleness,
-                        version=snap.version,
+                        version=snap.version, event_ts=snap.event_ts,
                     )
                 continue
             if qcls is ConnectedQuery:
@@ -725,6 +730,6 @@ class QueryEngine:
                 out[i] = Answer(
                     value=v, window=snap.window,
                     watermark=snap.watermark, staleness=staleness,
-                    version=snap.version,
+                    version=snap.version, event_ts=snap.event_ts,
                 )
         return out  # type: ignore[return-value]

@@ -827,7 +827,7 @@ class ShardRouter:
                     self._pulled_vers[shard] = v
                     self._pull_meta[shard] = (
                         int(ans.window), int(ans.watermark),
-                        int(ans.staleness),
+                        int(ans.staleness), int(ans.event_ts),
                     )
                     self._pull_err[shard] = None
                     cur = self._vers[shard]
@@ -920,13 +920,22 @@ class ShardRouter:
     def _meta_locked(self) -> tuple:
         """Merged answer meta from the newest per-shard pulls (caller
         holds ``_mlock``): MIN window (conservative progress), summed
-        watermark, MAX staleness, summed versions."""
+        watermark, MAX staleness, summed versions, MIN event-time
+        watermark (the cross-shard merge rule
+        :func:`gelly_streaming_tpu.eventtime.watermark.merge_watermarks`
+        applies: a merged answer is only as current as its
+        laggiest shard; shards without event time (-1) are left out,
+        -1 when none carries it)."""
         metas = [m for m in self._pull_meta if m is not None]
+        stamped = [
+            m[3] for m in metas if len(m) > 3 and m[3] >= 0
+        ]
         return (
             min(m[0] for m in metas) if metas else -1,
             sum(m[1] for m in metas),
             max(m[2] for m in metas) if metas else 0,
             sum(max(0, v) for v in self._pulled_vers),
+            min(stamped) if stamped else -1,
         )
 
     def _rebuild_merged_locked(self) -> None:
@@ -1034,11 +1043,11 @@ class ShardRouter:
                     vals[i] = int(got[k])
                     roots_of[i] = frozenset(
                         (int(rvd[k]) if fv[k] else int(vs[k]),))
-        window, watermark, staleness, version = meta
+        window, watermark, staleness, version, event_ts = meta
         for i, e in enumerate(entries):
             ans = Answer(
                 value=vals[i], window=window, watermark=watermark,
-                staleness=staleness, version=version,
+                staleness=staleness, version=version, event_ts=event_ts,
             )
             if self.cache_enabled:
                 self._cache_put(e.key, ans, stamp,

@@ -243,11 +243,12 @@ def encode_answer(ans: Answer) -> list:
     if hasattr(v, "item"):
         v = v.item()
     # the trailing snapshot version is what a routing tier keys its
-    # hot-key cache invalidation on (decoders tolerate its absence, so
-    # v1 peers stay interoperable — GL011: written here, read in
-    # client._settle_ok)
+    # hot-key cache invalidation on; the event-time watermark stamp
+    # after it says how far behind the WORLD the answer is (decoders
+    # tolerate the absence of either, so v1 peers stay interoperable —
+    # GL011: written here, read in client._settle_ok)
     return ["ok", v, ans.window, ans.watermark, ans.staleness,
-            ans.version]
+            ans.version, ans.event_ts]
 
 
 # --------------------------------------------------------------------- #

@@ -252,7 +252,14 @@ class StreamServer:
                 if payload is None:  # a window with nothing servable
                     continue
                 self._window += 1
-                self.store.publish(payload, self._window, int(watermark))
+                # an event-time pipeline's servable carries its
+                # watermark stamp in the payload; count windows do not
+                # (-1 = "no event time", the Answer default)
+                self.store.publish(
+                    payload, self._window, int(watermark),
+                    event_ts=int(payload.get("event_ts", -1))
+                    if hasattr(payload, "get") else -1,
+                )
         except BaseException as e:  # surfaced via join()/close()
             self._ingest_error = e
         finally:

@@ -77,6 +77,10 @@ class PublishedSnapshot:
     a stale entry across a store swap at a coincidentally-equal
     version. Caches key on ``(epoch, version)`` instead; 0 marks a
     hand-built snapshot that never went through a store.
+    ``event_ts`` is the EVENT-TIME watermark the summaries were built
+    at (``-1`` when the pipeline carries no event time) — the stamp
+    answers forward so a consumer can tell "how far behind the world"
+    an answer is, next to ``staleness``'s "how far behind the head".
     """
 
     payload: Mapping[str, Any]
@@ -85,6 +89,7 @@ class PublishedSnapshot:
     version: int
     published_at: float = field(default_factory=time.monotonic)
     epoch: int = 0
+    event_ts: int = -1
 
 
 class SnapshotStore:
@@ -168,7 +173,8 @@ class SnapshotStore:
 
     # -- write side ---------------------------------------------------- #
     def publish(
-        self, payload: Mapping[str, Any], window: int, watermark: int
+        self, payload: Mapping[str, Any], window: int, watermark: int,
+        event_ts: int = -1,
     ) -> PublishedSnapshot:
         """Swap in a new snapshot and wake waiters. The assignment to
         ``_current`` IS the publication point; the lock below only
@@ -180,6 +186,7 @@ class SnapshotStore:
             watermark=watermark,
             version=1 if prev is None else prev.version + 1,
             epoch=self.epoch,
+            event_ts=int(event_ts),
         )
         # both swaps are single reference assignments (atomic under the
         # GIL); _recent is an immutable tuple rebuilt per publish

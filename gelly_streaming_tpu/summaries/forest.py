@@ -632,6 +632,48 @@ def fold_edges_host(canon_np: np.ndarray, src: np.ndarray,
             return lab
 
 
+def fold_into_forest_host(canon_np: np.ndarray, src: np.ndarray,
+                          dst: np.ndarray) -> np.ndarray:
+    """Fold a SMALL edge group into a BIG flat table without paying the
+    whole-table fixpoint per pass (ISSUE 18's per-pane fold shape: a
+    few thousand edges against a table of a million rows, where
+    :func:`fold_edges_host`'s resolve-per-pass iterations are all
+    table scans).
+
+    Union happens at ROOT granularity: the group's edges project to
+    edges between current component roots, those roots compact to a
+    dense local id space, the local forest folds with
+    :func:`fold_edges_host` (tiny arrays, same fixpoint), and ONE
+    whole-table mapping pass rewrites every vertex whose root merged.
+    Roots are min vertex ids and the local fold picks the min local
+    index — which is the min root under the sorted compaction — so the
+    result is byte-identical to ``fold_edges_host(canon_np, src, dst)``
+    (the oracle contract), at O(group·fixpoint + table) instead of
+    O(table·fixpoint)."""
+    lab = resolve_flat_host(np.asarray(canon_np))
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    if len(src) == 0:
+        return lab
+    rs, rd = lab[src], lab[dst]
+    roots = np.unique(np.concatenate([rs, rd]))
+    if len(roots) < 2:
+        return lab
+    local = fold_edges_host(
+        np.arange(len(roots), dtype=np.int64),
+        np.searchsorted(roots, rs),
+        np.searchsorted(roots, rd),
+    )
+    newroot = roots[local]
+    if np.array_equal(newroot, roots):
+        return lab  # the group united nothing new
+    # one table pass: a scatter/gather translation table (root ->
+    # merged root, identity elsewhere) beats a binary search per row
+    trans = np.arange(len(lab), dtype=np.int64)
+    trans[roots] = newroot
+    return trans[lab]
+
+
 def merge_forest_tables_host(tables) -> np.ndarray:
     """Cross-shard union step: merge N same-length forest tables into
     one canonical table whose components are the components of the
@@ -712,6 +754,80 @@ def apply_forest_delta_host(lab: np.ndarray, sizes: np.ndarray,
     if not touched:
         return np.zeros(0, np.int64)
     return np.fromiter(touched, np.int64, len(touched))
+
+
+def repair_forest_host(
+    lab: np.ndarray,
+    expired_src: np.ndarray,
+    expired_dst: np.ndarray,
+    surviving_src: np.ndarray,
+    surviving_dst: np.ndarray,
+):
+    """Decremental counterpart to :func:`apply_forest_delta_host`: REPAIR
+    a host forest after a batch of edges EXPIRED (event-time retraction,
+    ISSUE 18), rebuilding ONLY the affected components.
+
+    Union-find supports cheap union but not cheap deletion; the repair
+    rule this repo uses is bounded recompute from the carried table: the
+    components the expired edges touched (their roots in ``lab``) are
+    reset to singletons, and exactly the SURVIVING edges incident to
+    those components are re-folded through :func:`fold_edges_host` — one
+    group-fold call over the suspect subgraph, never the whole stream.
+    An edge's endpoints always share a component, so membership of ONE
+    endpoint in an affected component selects precisely the suspect
+    edges.
+
+    ``lab`` is a canonical forest table (any pointer depth; resolved
+    here). ``surviving_src``/``surviving_dst`` are the live edge
+    multiset AFTER the expiry (callers keep per-pane columns, so this is
+    a concatenation of the surviving panes' views, not a recompute).
+    Returns ``(new_lab, stats)`` where ``new_lab`` is fully-canonical
+    min-rooted flat (byte-identical to a from-scratch
+    :func:`fold_edges_host` over the surviving multiset — the oracle
+    contract ``tests/test_eventtime.py`` pins) and ``stats`` records the
+    bounded-recompute evidence: affected roots/members and re-folded
+    edge count (the retraction-vs-rebuild ratio ``bench.py --eventtime``
+    commits)."""
+    lab = resolve_flat_host(np.asarray(lab))
+    expired_src = np.asarray(expired_src, np.int64)
+    expired_dst = np.asarray(expired_dst, np.int64)
+    surviving_src = np.asarray(surviving_src, np.int64)
+    surviving_dst = np.asarray(surviving_dst, np.int64)
+    if len(expired_src) != len(expired_dst):
+        raise ValueError(
+            f"expired columns disagree on length: "
+            f"{len(expired_src)} != {len(expired_dst)}"
+        )
+    if len(surviving_src) != len(surviving_dst):
+        raise ValueError(
+            f"surviving columns disagree on length: "
+            f"{len(surviving_src)} != {len(surviving_dst)}"
+        )
+    stats = {"roots": 0, "members": 0, "refolded": 0,
+             "surviving": int(len(surviving_src))}
+    if len(expired_src) == 0:
+        return lab, stats
+    roots = np.unique(
+        np.concatenate([lab[expired_src], lab[expired_dst]])
+    )
+    # membership via a scatter bitmap (roots are vertex ids, so the
+    # bitmap is table-sized): one gather instead of isin's sort
+    root_hit = np.zeros(len(lab), bool)
+    root_hit[roots] = True
+    affected = root_hit[lab]
+    members = np.nonzero(affected)[0]
+    out = lab.copy()
+    out[members] = members.astype(out.dtype)
+    if len(surviving_src):
+        suspect = affected[surviving_src]
+        s = surviving_src[suspect]
+        d = surviving_dst[suspect]
+        stats["refolded"] = int(len(s))
+        if len(s):
+            out = fold_into_forest_host(out, s, d)
+    stats["roots"] = int(len(roots))
+    stats["members"] = int(len(members))
+    return out, stats
 
 
 class TouchLog:

@@ -130,6 +130,28 @@ def test_transport_watch_list_matches_the_transport_artifact():
         assert committed["backends"][backend]["recovery"]["ok"] is True
 
 
+def test_eventtime_watch_list_matches_the_eventtime_artifact():
+    # ISSUE 18 satellite: the CI event-time step watches the sliding
+    # eps and the repair-vs-rebuild ratio (both min: — throughput and
+    # an economic claim that regresses downward). The committed
+    # artifact must also PROVE the tentpole's claim: incremental
+    # repair beat the from-scratch rebuild (ratio > 1) with zero
+    # oracle mismatches across every expiry boundary.
+    from tools.benchguard import WATCHED_EVENTTIME
+
+    path = os.path.join(REPO, "BENCH_EVENTTIME_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_EVENTTIME:
+        value = dig(committed, metric[4:] if metric.startswith("min:")
+                    else metric)
+        assert isinstance(value, (int, float)), metric
+    assert all(m.startswith("min:") for m in WATCHED_EVENTTIME)
+    assert committed["cells"]["retract"]["ratio_vs_rebuild"] > 1.0
+    assert committed["cells"]["retract"]["mismatches"] == 0
+    assert committed["ok"] is True
+
+
 def test_chaos_watch_list_matches_the_chaos_artifact():
     # the ISSUE 10 satellite: the CI chaos step watches recovery p50
     # from the committed chaos artifact — the watch list must resolve

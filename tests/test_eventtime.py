@@ -1,0 +1,558 @@
+"""Event-time windowing + retraction (ISSUE 18): graphs that forget.
+
+The load-bearing contracts pinned here:
+
+- **wire**: a ts-less GSEW frame stays byte-identical v1 and decodes
+  exactly as it always did (codec symmetry); a ts column makes the
+  frame v2 and round-trips exactly; the ``F_TS`` flag on a v1 header is
+  a counted rejection, never a misparse;
+- **watermarks**: per-shard watermarks are monotone, the merged clock
+  is the MIN over live shards, one silent shard pins the merge at
+  :data:`NO_WATERMARK`, and ENDED shards leave the merge (an empty
+  merge is i64 max — the end-of-stream total promise);
+- **lateness**: records behind the allowance drop as counted
+  ``eventtime.late_dropped``, NEVER silently absorbed into a closed
+  pane (which would corrupt the retraction multiset); in-order streams
+  drop nothing;
+- **the acceptance criterion**: sliding-window CC / degree /
+  heavy-hitter / bipartiteness answers are byte-identical to a
+  from-scratch rebuild on the EXTERNALLY-computed surviving edge
+  multiset at every pane boundary, across >= 8 randomized expiry
+  rounds per seed (the oracle is computed from the raw input stream,
+  not from the aggregator's own state — a tautological self-check
+  cannot catch an assembler that wrongly drops records);
+- **retraction semantics**: the bipartite odd-cycle latch UN-latches
+  when the odd cycle expires (the verdict re-resolves from the repaired
+  cover, it is never a carried boolean);
+- **chaos**: a kill between summary mutation and the atomic state
+  commit recovers — restore + full at-least-once replay converges to
+  answers byte-identical to an uninterrupted run;
+- **serving**: the event-time watermark stamp rides the snapshot, the
+  Answer, and wire element 6 (decoded tolerantly: old peers report -1).
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import obs
+from gelly_streaming_tpu.core.ingest import (
+    F_TS,
+    HEADER,
+    VERSION,
+    VERSION_TS,
+    MalformedFrame,
+    ShardedEdgeSource,
+    decode_frame_payload,
+    encode_shard_frames,
+    frame_geometry,
+    pack_edge_frame,
+    partition_edges,
+    serve_blobs,
+)
+from gelly_streaming_tpu.core.sources import GeneratorSource
+from gelly_streaming_tpu.eventtime import (
+    NO_WATERMARK,
+    SlidingGraphAggregator,
+    WatermarkTracker,
+    merge_watermarks,
+    oracle_bipartite,
+    oracle_degrees,
+    oracle_labels,
+)
+from gelly_streaming_tpu.eventtime.stream import drive_sliding
+from gelly_streaming_tpu.obs.registry import get_registry
+from gelly_streaming_tpu.resilience import faults
+from gelly_streaming_tpu.resilience.errors import SimulatedCrash
+from gelly_streaming_tpu.resilience.faults import FaultPlan
+from gelly_streaming_tpu.serving.query import Answer, DegreeQuery, QueryEngine
+from gelly_streaming_tpu.serving.rpc import encode_answer
+from gelly_streaming_tpu.serving.snapshot_store import SnapshotStore
+
+I64_MAX = int(np.iinfo(np.int64).max)
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    obs.reset()
+    faults.clear()
+    yield
+    obs.reset()
+    faults.clear()
+
+
+def counter_value(name, **labels):
+    for lab, inst in get_registry().find(name):
+        if all(lab.get(k) == v for k, v in labels.items()):
+            return inst.value
+    return 0.0
+
+
+def make_ts_stream(n, vmax, tmax, seed):
+    """An in-order timestamped edge stream: sorted ts is what a real
+    per-shard arrival order delivers (GSEW preserves it)."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, vmax, n).astype(np.int64)
+    dst = rng.integers(0, vmax, n).astype(np.int64)
+    ts = np.sort(rng.integers(0, tmax, n)).astype(np.int64)
+    return src, dst, ts
+
+
+def expected_top(deg, k=8):
+    nz = np.nonzero(deg)[0]
+    order = np.lexsort((nz, -deg[nz]))[:k]
+    return [(int(v), int(deg[v])) for v in nz[order]]
+
+
+def assert_window_matches_oracles(res, src, dst, ts):
+    """THE acceptance criterion: the emitted window equals a
+    from-scratch rebuild on the surviving multiset, where "surviving"
+    is computed from the RAW input stream (externally), not from the
+    aggregator's own state."""
+    m = (ts >= res.start) & (ts < res.end)
+    s, d = src[m], dst[m]
+    assert res.n_edges == int(m.sum())
+    vcap = len(res.labels)
+    np.testing.assert_array_equal(res.labels, oracle_labels(vcap, s, d))
+    want_deg = oracle_degrees(len(res.degrees), s, d)
+    np.testing.assert_array_equal(res.degrees, want_deg)
+    assert res.top == expected_top(want_deg)
+    assert res.bipartite == oracle_bipartite(len(res.degrees), s, d)
+
+
+# --------------------------------------------------------------------- #
+# 1. The wire: GSEW v2 ts column
+# --------------------------------------------------------------------- #
+def test_ts_less_frames_stay_version_1_and_decode_unchanged():
+    src = np.array([1, 2, 3], np.int64)
+    dst = np.array([4, 5, 6], np.int64)
+    frame = pack_edge_frame(src, dst, seq=1)
+    _, version, flags, n, plen, _ = HEADER.unpack(frame[: HEADER.size])
+    assert version == VERSION and not (flags & F_TS)
+    cols = decode_frame_payload(frame[HEADER.size:], n, flags)
+    assert len(cols) == 3  # codec symmetry: v1 arity is v1 arity
+    np.testing.assert_array_equal(cols[0], src)
+    np.testing.assert_array_equal(cols[1], dst)
+
+
+def test_v2_frame_round_trips_the_ts_column_exactly():
+    src = np.array([1, 2, 3, 4], np.int64)
+    dst = np.array([5, 6, 7, 8], np.int64)
+    val = np.array([0.5, 1.5, 2.5, 3.5])
+    ts = np.array([10, 11, -5, I64_MAX - 1], np.int64)
+    frame = pack_edge_frame(src, dst, val, seq=1, ts=ts)
+    _, version, flags, n, plen, _ = HEADER.unpack(frame[: HEADER.size])
+    assert version == VERSION_TS and (flags & F_TS)
+    assert plen == frame_geometry(n, flags)
+    s, d, v, t = decode_frame_payload(frame[HEADER.size:], n, flags)
+    np.testing.assert_array_equal(s, src)
+    np.testing.assert_array_equal(d, dst)
+    np.testing.assert_array_equal(v, val)
+    np.testing.assert_array_equal(t, ts)
+
+
+def test_ts_flag_on_a_v1_header_is_rejected():
+    import socket as _socket
+
+    frame = bytearray(pack_edge_frame(
+        np.array([1], np.int64), np.array([2], np.int64), seq=1,
+        ts=np.array([7], np.int64),
+    ))
+    frame[4] = VERSION  # lie: v1 header carrying the F_TS flag
+    a, b = _socket.socketpair()
+    try:
+        a.sendall(bytes(frame))
+        from gelly_streaming_tpu.core.ingest import read_edge_frame
+
+        with pytest.raises(MalformedFrame) as exc:
+            read_edge_frame(b)
+        assert exc.value.kind == "version"
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------------------------- #
+# 2. Watermarks: the cross-shard min-merge rule
+# --------------------------------------------------------------------- #
+def test_merge_is_min_and_one_silent_shard_pins_it():
+    tr = WatermarkTracker(3)
+    assert tr.current() == NO_WATERMARK
+    tr.observe(0, np.array([50], np.int64))
+    tr.observe(1, np.array([80], np.int64))
+    # shard 2 has not spoken: the merged clock must not move
+    assert tr.current() == NO_WATERMARK
+    tr.observe(2, np.array([30], np.int64))
+    assert tr.current() == 30  # the min, not the max
+    assert merge_watermarks([50, 80, 30]) == 30
+    assert merge_watermarks([]) == I64_MAX  # every shard ended
+
+
+def test_finished_shards_stop_holding_the_clock_back():
+    tr = WatermarkTracker(2)
+    tr.observe(0, np.array([100], np.int64))
+    assert tr.current() == NO_WATERMARK  # shard 1 silent
+    tr.finish(1)
+    assert tr.current() == 100
+    tr.finish(0)
+    assert tr.current() == I64_MAX  # the total end-of-stream promise
+
+
+def test_per_shard_watermarks_are_monotone():
+    tr = WatermarkTracker(1)
+    tr.observe(0, np.array([10, 40, 20], np.int64))
+    assert tr.current() == 40
+    tr.observe(0, np.array([5], np.int64))  # a late record
+    assert tr.current() == 40  # never regresses
+    assert counter_value("eventtime.watermark_advance") >= 1
+
+
+# --------------------------------------------------------------------- #
+# 3. Lateness + pane cadence
+# --------------------------------------------------------------------- #
+def test_late_records_drop_counted_never_absorbed():
+    agg = SlidingGraphAggregator(20, 10, summaries=("degree",))
+    agg.push(np.array([1]), np.array([2]), np.array([35], np.int64))
+    assert counter_value("eventtime.late_dropped") == 0
+    # ts=3's pane closed when the watermark hit 35: counted drop
+    results = agg.push(np.array([8]), np.array([9]),
+                       np.array([3], np.int64))
+    assert counter_value("eventtime.late_dropped") == 1
+    results += agg.finish()
+    # vertex 8/9 never entered any window's multiset (the summary
+    # tables never even grew to hold them)
+    for r in results:
+        assert len(r.degrees) <= 3
+        assert all(v in (1, 2) for v, _ in r.top)
+
+
+def test_lateness_allowance_keeps_panes_open_longer():
+    strict = SlidingGraphAggregator(20, 10, summaries=("degree",))
+    strict.push(np.array([1]), np.array([2]), np.array([2], np.int64))
+    strict.push(np.array([1]), np.array([2]), np.array([12], np.int64))
+    # watermark 12 closes pane 0 under zero allowance...
+    assert strict.assembler._next_pane == 1
+    lax = SlidingGraphAggregator(20, 10, allowed_lateness=5,
+                                 summaries=("degree",))
+    lax.push(np.array([1]), np.array([2]), np.array([2], np.int64))
+    lax.push(np.array([1]), np.array([2]), np.array([12], np.int64))
+    # ...but an allowance of 5 holds it open until the clock hits 15
+    assert lax.assembler._next_pane == 0
+    # a straggler INSIDE the allowance is absorbed, not dropped
+    lax.push(np.array([3]), np.array([4]), np.array([8], np.int64))
+    assert counter_value("eventtime.late_dropped") == 0
+    results = lax.advance_watermark(15)  # horizon 10: pane 0 closes
+    assert lax.assembler._next_pane == 1
+    # window 0 is pane 0: the on-time edge AND the absorbed straggler
+    # (ts=12 sits in the still-open pane 1)
+    assert results[0].n_edges == 2
+    assert results[0].degrees[3] == 1 and results[0].degrees[4] == 1
+
+
+def test_empty_pane_slots_still_slide_the_window():
+    agg = SlidingGraphAggregator(20, 10, summaries=("degree", "cc"))
+    agg.push(np.array([1]), np.array([2]), np.array([0], np.int64))
+    results = agg.advance_watermark(45)  # panes 0..3 close, 1..3 empty
+    assert [r.index for r in results] == [0, 1, 2, 3]
+    # window 3 spans panes {2, 3}: the edge expired, nothing replaced it
+    assert results[-1].n_edges == 0
+    assert int(results[-1].degrees.sum()) == 0
+    labels = results[-1].labels
+    np.testing.assert_array_equal(labels, np.arange(len(labels)))
+
+
+# --------------------------------------------------------------------- #
+# 4. THE acceptance criterion: randomized expiry rounds vs the oracles
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sliding_answers_match_from_scratch_rebuild_every_boundary(seed):
+    """>= 8 randomized expiry rounds per seed; every emitted window's
+    CC labels / degrees / heavy hitters / bipartite verdict must be
+    byte-identical to a from-scratch rebuild on the surviving multiset
+    computed EXTERNALLY from the raw stream."""
+    rng = np.random.default_rng(100 + seed)
+    src, dst, ts = make_ts_stream(
+        n=1500, vmax=48, tmax=160, seed=200 + seed
+    )
+    agg = SlidingGraphAggregator(40, 10, verify=True)
+    nw = agg.policy.panes_per_window
+    results = []
+    i = 0
+    while i < len(src):  # randomized chunk boundaries
+        k = int(rng.integers(1, 64))
+        results.extend(agg.push(src[i:i + k], dst[i:i + k], ts[i:i + k]))
+        i += k
+    results.extend(agg.finish())
+    # in-order streams drop nothing — the survivors really are ts-range
+    assert counter_value("eventtime.late_dropped") == 0
+    expiry_rounds = [r for r in results if r.index >= nw]
+    assert len(expiry_rounds) >= 8
+    for r in results:
+        assert_window_matches_oracles(r, src, dst, ts)
+    # every expiry reported bounded-recompute stats from the repair
+    assert any(
+        r.repair is not None and r.repair["refolded"] >= 0
+        for r in expiry_rounds
+    )
+
+
+def test_tumbling_is_the_degenerate_slide():
+    src, dst, ts = make_ts_stream(n=400, vmax=24, tmax=60, seed=7)
+    agg = SlidingGraphAggregator(20, verify=True)  # slide == size
+    results = agg.push(src, dst, ts) + agg.finish()
+    assert agg.policy.panes_per_window == 1
+    for r in results:
+        assert r.end - r.start == 20
+        assert_window_matches_oracles(r, src, dst, ts)
+
+
+# --------------------------------------------------------------------- #
+# 5. Retraction semantics: the odd-cycle latch un-latches on expiry
+# --------------------------------------------------------------------- #
+def test_odd_cycle_expiry_unlatches_the_bipartite_verdict():
+    agg = SlidingGraphAggregator(20, 10, summaries=("bipartite",))
+    # pane 0: a triangle (odd cycle) — the verdict latches false
+    r = agg.push(np.array([0, 1, 2]), np.array([1, 2, 0]),
+                 np.array([1, 2, 3], np.int64))
+    # pane 1: a lone bipartite edge; closing pane 0 emits window 0
+    r += agg.push(np.array([0]), np.array([1]),
+                  np.array([12], np.int64))
+    r += agg.push(np.array([1]), np.array([2]),
+                  np.array([22], np.int64))  # closes pane 1 -> window 1
+    r += agg.finish()
+    by_index = {w.index: w for w in r}
+    assert by_index[0].bipartite is False
+    assert by_index[0].witness is not None
+    assert by_index[1].bipartite is False  # triangle still in span
+    # window 2 spans panes {1, 2}: the triangle expired — the latch
+    # must RE-RESOLVE from the repaired cover, not carry the stale latch
+    assert by_index[2].bipartite is True
+    assert by_index[2].witness is None
+
+
+# --------------------------------------------------------------------- #
+# 6. Multi-shard clock + the full wire path
+# --------------------------------------------------------------------- #
+def test_one_slow_shard_holds_the_whole_clock():
+    agg = SlidingGraphAggregator(20, 10, nshards=2,
+                                 summaries=("degree",))
+    out = agg.push(np.array([1]), np.array([2]),
+                   np.array([35], np.int64), shard=0)
+    assert out == []  # shard 1 silent: nothing may close
+    out = agg.push(np.array([3]), np.array([4]),
+                   np.array([70], np.int64), shard=0)
+    assert out == []  # still pinned, however far shard 0 runs ahead
+    out = agg.push(np.array([5]), np.array([6]),
+                   np.array([45], np.int64), shard=1)
+    # merged clock is min(70, 45) = 45: exactly pane 3 closes
+    assert [r.index for r in out] == [3]
+    assert out[0].event_ts == 45
+    # shard 1's record is EARLIER than shard 0's high ts but must not
+    # be dropped — the min rule exists precisely to protect it
+    assert counter_value("eventtime.late_dropped") == 0
+    tail = agg.finish()
+    assert [r.index for r in tail] == [4, 5, 6, 7]
+    assert tail[0].degrees[5] == 1 and tail[0].degrees[6] == 1
+
+
+def test_socket_ingest_to_sliding_aggregator_end_to_end():
+    """The whole path: partitioned v2 frames over real sockets ->
+    ShardedEdgeSource(timestamps=True) -> windows_ts -> drive_sliding,
+    final window byte-identical to the global survivor rebuild."""
+    src, dst, ts = make_ts_stream(n=1200, vmax=40, tmax=120, seed=31)
+    parts = partition_edges(src, dst, None, 2, ts=ts)
+    blobs = [
+        encode_shard_frames(s, d, ts=t, frame_edges=64)
+        for s, d, _v, t in parts
+    ]
+    ports, threads, _stop = serve_blobs(blobs)
+    source = ShardedEdgeSource(
+        [("127.0.0.1", p) for p in ports], window=32, timestamps=True,
+    )
+    agg = SlidingGraphAggregator(30, 10, nshards=2, verify=True)
+    results = drive_sliding(source.windows_ts(), agg)
+    for t in threads:
+        t.join(10)
+    assert counter_value("eventtime.late_dropped") == 0
+    final = results[-1]
+    assert final.event_ts == I64_MAX  # end of stream: total promise
+    assert_window_matches_oracles(final, src, dst, ts)
+    # mid-stream windows are stamped with real merged watermarks
+    assert any(0 <= r.event_ts < I64_MAX for r in results)
+    payload = agg.servable_payload()
+    assert payload["event_ts"] == I64_MAX
+    np.testing.assert_array_equal(payload["labels"], final.labels)
+
+
+# --------------------------------------------------------------------- #
+# 7. Chaos: kill between summary mutation and the state commit
+# --------------------------------------------------------------------- #
+@pytest.mark.chaos_fast
+def test_kill_before_commit_recovers_oracle_identical(tmp_path):
+    """The fault hook fires AFTER the retraction/fold mutated the
+    summaries and BEFORE the atomic commit — the worst spot. Recovery
+    restores the last committed pane boundary, the source replays from
+    the start (at-least-once), and the final answers are byte-identical
+    to an uninterrupted run."""
+    src, dst, ts = make_ts_stream(n=800, vmax=32, tmax=120, seed=13)
+    chunks = [
+        (src[i:i + 50], dst[i:i + 50], ts[i:i + 50])
+        for i in range(0, 800, 50)
+    ]
+
+    def run_all(agg):
+        out = []
+        for s, d, t in chunks:
+            out.extend(agg.push(s, d, t))
+        out.extend(agg.finish())
+        return out
+
+    baseline = run_all(SlidingGraphAggregator(30, 10, verify=True))
+
+    cdir = str(tmp_path / "commits")
+    agg1 = SlidingGraphAggregator(30, 10, commit_dir=cdir)
+    faults.install(FaultPlan(
+        kill_at_window=5, kill_site="eventtime.retract",
+        kill_exit_code=None,  # SimulatedCrash, not os._exit
+    ))
+    with pytest.raises(SimulatedCrash):
+        run_all(agg1)
+    faults.clear()
+
+    agg2 = SlidingGraphAggregator(30, 10, commit_dir=cdir, verify=True)
+    assert agg2.restore() is True
+    # pane 5's mutation died uncommitted: the committed cursor is 5
+    assert agg2._done_panes == 5
+    recovered = run_all(agg2)
+    # replayed records of already-committed panes drop as counted late
+    assert counter_value("eventtime.late_dropped") > 0
+    got = {r.index: r for r in recovered}
+    want = {r.index: r for r in baseline}
+    assert set(got) == {i for i in want if i >= 5}
+    for i, w in got.items():
+        b = want[i]
+        assert w.n_edges == b.n_edges
+        np.testing.assert_array_equal(w.labels, b.labels)
+        np.testing.assert_array_equal(w.degrees, b.degrees)
+        assert w.top == b.top
+        assert w.bipartite == b.bipartite
+
+
+def test_commit_restore_round_trip_without_a_crash(tmp_path):
+    src, dst, ts = make_ts_stream(n=300, vmax=20, tmax=60, seed=5)
+    cdir = str(tmp_path / "c")
+    agg = SlidingGraphAggregator(20, 10, commit_dir=cdir)
+    agg.push(src, dst, ts)
+    fresh = SlidingGraphAggregator(20, 10, commit_dir=cdir)
+    assert fresh.restore() is True
+    np.testing.assert_array_equal(fresh._cc.lab, agg._cc.lab)
+    np.testing.assert_array_equal(fresh._deg.deg, agg._deg.deg)
+    np.testing.assert_array_equal(fresh._bip.cover, agg._bip.cover)
+    assert fresh._done_panes == agg._done_panes
+    assert [p.index for p in fresh._live] == [p.index for p in agg._live]
+    empty = SlidingGraphAggregator(20, 10, commit_dir=str(tmp_path / "x"))
+    assert empty.restore() is False
+
+
+# --------------------------------------------------------------------- #
+# 8. FaultPlan event-time skew
+# --------------------------------------------------------------------- #
+def test_ts_skew_is_deterministic_and_bounded():
+    records = [(i, i + 1, 0.0, 1000 + i) for i in range(10)]
+
+    def skewed(seed):
+        plan = FaultPlan(seed=seed, skew_records=(2, 5), skew_ts_s=3)
+        return list(plan.perturb_records(iter(records)))
+
+    out1, out2 = skewed(seed=7), skewed(seed=7)
+    assert out1 == out2  # same seed -> byte-identical jitter
+    for i, (orig, got) in enumerate(zip(records, out1)):
+        if i in (2, 5):
+            assert abs(got[3] - orig[3]) <= 3
+            assert got[:3] == orig[:3]  # only the ts field moves
+        else:
+            assert got == orig
+    assert counter_value(
+        "resilience.fault_injected", site="source.perturb"
+    ) >= 2
+
+
+def test_skew_plan_perturbs_the_generator_ts_chunks():
+    def all_ts():
+        gen = GeneratorSource(scale=6, chunk=64, limit=256, ts_rate=8)
+        return np.concatenate([t for _s, _d, t in gen.iter_chunks_ts()])
+
+    clean = all_ts()
+    with faults.injected(FaultPlan(
+        seed=3, skew_records=(10,), skew_ts_s=5,
+    )):
+        skewed = all_ts()
+    diff = np.nonzero(clean != skewed)[0]
+    assert list(diff) == [10] or len(diff) == 0  # offset may be 0
+    if len(diff):
+        assert abs(int(skewed[10]) - int(clean[10])) <= 5
+
+
+def test_skewed_stream_feeds_the_lateness_policy():
+    """Skew is the out-of-order-ARRIVAL fault: under zero allowance a
+    backdated record drops as counted late; the aggregator's answers
+    stay oracle-identical on what SURVIVED."""
+    src = np.arange(40, dtype=np.int64) % 8
+    dst = (np.arange(40, dtype=np.int64) + 1) % 8
+    ts = np.arange(40, dtype=np.int64)  # one tick apart: panes of 10
+    plan = FaultPlan(seed=11, skew_records=(25,), skew_ts_s=30)
+    recs = list(plan.perturb_records(
+        iter([(int(s), int(d), 0.0, int(t))
+              for s, d, t in zip(src, dst, ts)])
+    ))
+    agg = SlidingGraphAggregator(20, 10, verify=True)
+    for s, d, _v, t in recs:
+        agg.push(np.array([s]), np.array([d]), np.array([t], np.int64))
+    agg.finish()  # verify=True raises on any divergence from oracle
+
+
+# --------------------------------------------------------------------- #
+# 9. Serving: the event-time stamp rides snapshot -> Answer -> wire
+# --------------------------------------------------------------------- #
+def test_snapshot_answer_and_wire_carry_the_event_time_stamp():
+    from gelly_streaming_tpu.datasets import IdentityDict
+
+    store = SnapshotStore()
+    vd = IdentityDict(8)
+    vd.observe(7)
+    deg = np.arange(8, dtype=np.int64)
+    store.publish({"deg": deg, "vdict": vd}, window=3, watermark=4)
+    assert store.latest().event_ts == -1  # unstamped: "no event time"
+    store.publish({"deg": deg, "vdict": vd}, window=4, watermark=5,
+                  event_ts=77)
+    snap = store.latest()
+    assert snap.event_ts == 77
+    ans = QueryEngine().answer_batch(snap, [DegreeQuery(3)])[0]
+    assert ans.event_ts == 77 and int(ans.value) == 3
+    wire = encode_answer(ans)
+    assert wire[6] == 77  # element 6: the stamp (old peers read -1)
+    assert Answer(value=0, window=0, watermark=0, staleness=0,
+                  version=0).event_ts == -1  # tolerant default
+
+
+# --------------------------------------------------------------------- #
+# 10. Timeline story lines
+# --------------------------------------------------------------------- #
+def test_timeline_renders_the_eventtime_story():
+    from gelly_streaming_tpu.obs import timeline
+
+    events = [
+        {"kind": "counter", "name": "eventtime.watermark_advance",
+         "v": 1, "ts": 1.0, "shard": "p0"},
+        {"kind": "counter", "name": "eventtime.pane_close", "v": 1,
+         "ts": 2.0, "shard": "p0"},
+        {"kind": "counter", "name": "eventtime.retract", "v": 1,
+         "ts": 3.0, "shard": "p0"},
+        {"kind": "counter", "name": "eventtime.late_dropped", "v": 2,
+         "ts": 4.0, "shard": "p0"},
+    ]
+    lines = timeline.render(events)
+    assert len(lines) == 4
+    assert "WATERMARK" in lines[0]
+    assert "PANE-CLOSE" in lines[1]
+    assert "RETRACT" in lines[2]
+    assert "LATE-DROP" in lines[3]

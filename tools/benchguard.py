@@ -117,6 +117,18 @@ WATCHED_TRANSPORT = (
     "backends.socket.exchange.p50_ms",
 )
 
+#: the event-time artifact's guarded cells (BENCH_EVENTTIME_CPU.json,
+#: ISSUE 18): end-to-end sliding throughput (``min:`` — watermarks,
+#: pane assembly, retraction, all three summaries in the loop) and the
+#: retraction cell's economic claim itself (``min:`` — repair seconds
+#: saved per rebuild second; a drop below 1.0 means bounded repair
+#: stopped beating the from-scratch rebuild it exists to beat). The
+#: mismatch count is asserted zero INSIDE bench.py, not bounded here.
+WATCHED_EVENTTIME = (
+    "min:cells.sliding.eps",
+    "min:cells.retract.ratio_vs_rebuild",
+)
+
 #: a fresh value may be up to this many times the committed one
 DEFAULT_RATIO = 3.0
 

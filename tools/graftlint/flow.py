@@ -42,9 +42,13 @@ from typing import Dict, List, Optional, Set, Tuple
 from .core import call_name, dotted, last_attr
 from .graph import FunctionInfo, RepoGraph
 
-#: parameter names that carry a deadline/timeout budget (GL008)
+#: parameter names that carry a deadline/timeout budget (GL008).
+#: ``join_timeout_s`` is the ingest reader-drain vocabulary (ISSUE 18):
+#: a per-shard close() that hands the same budget to every join would
+#: multiply the caller's wait by the shard count.
 DEADLINE_PARAMS = frozenset({
     "deadline_s", "deadline", "timeout", "timeout_s", "budget_s",
+    "join_timeout_s",
 })
 
 #: dict keys that carry a deadline across a wire/frame boundary

@@ -54,12 +54,17 @@ _BROAD = {"Exception", "BaseException"}
 #: threads and the client's reconnect loop sit on sockets under the
 #: same contract (``fabric.malformed{kind}`` / ``fabric.reconnects`` /
 #: ``fabric.swallowed{site}``).
+#: ISSUE 18 adds the event-time driver: its pane cycle sits between
+#: the sharded sockets and the retraction commit — a swallowed error
+#: there silently forks the summaries from the surviving multiset, so
+#: broad handlers must count ``eventtime.swallowed{site}`` or re-raise.
 THREADED_SOCKET_MODULES = (
     "serving/rpc.py",
     "serving/client.py",
     "serving/router.py",
     "core/ingest.py",
     "fabric/exchange.py",
+    "eventtime/stream.py",
 )
 
 #: calls that count as "left registry evidence": instrument factories
