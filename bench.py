@@ -3231,6 +3231,86 @@ def main():
             sys.exit(1)
         return
 
+    if "--storm" in sys.argv:
+        # the failover storm (ISSUE 19): one sustained Zipfian run
+        # through a 2-router fleet over 2 shard replicas, surviving a
+        # router SIGKILL, a shard-primary SIGKILL (lease-lapse standby
+        # promotion) and a LIVE split of the hot shard — autotune on
+        # both tiers throughout. Gates: zero client-visible failures in
+        # every phase, zero post-split oracle mismatches, a trace
+        # joining client -> surviving router -> both post-split shards,
+        # and no admission knob reverting more than once per phase.
+        import tempfile
+
+        from gelly_streaming_tpu.resilience.chaos import (
+            run_storm_scenario,
+        )
+
+        root = tempfile.mkdtemp(prefix="bench_storm_")
+        # --smoke (the CI liveness step): shrunken geometry + shorter
+        # phases, nothing committed — the non-blocking tier-1 probe
+        smoke = "--smoke" in sys.argv
+        if smoke:
+            artifact = None
+            obs_log = os.path.join(root, "obs_smoke.jsonl")
+            kw = dict(
+                n_vertices=1 << 11, n_edges=1 << 12, phase_s=1.2,
+                clients=2, oracle_checks=64,
+            )
+        else:
+            artifact = "BENCH_STORM_CPU.json"
+            obs_log = "BENCH_STORM_CPU_OBS.jsonl"
+            kw = {}
+        obs_f = open(obs_log, "w")
+        scenario_ok = False
+        try:
+            doc = run_storm_scenario(root, log=log, obs_f=obs_f, **kw)
+            scenario_ok = bool(doc.get("ok"))
+        finally:
+            obs_f.close()
+            import shutil
+
+            # keep the run directory (replica/router logs, portfiles)
+            # as the post-mortem for a failed full run
+            if (scenario_ok or smoke) and os.path.isdir(root):
+                shutil.rmtree(root, ignore_errors=True)
+            elif not scenario_ok:
+                log(f"storm: scenario artifacts kept at {root} "
+                    f"for post-mortem")
+        doc["platform"] = "cpu-xla"
+        if artifact is not None:
+            doc["obs_log"] = obs_log
+            with open(artifact, "w") as f:
+                json.dump(doc, f, indent=2)
+        log(f"storm: ok={doc['ok']} "
+            f"failures={doc['load_total']['failures']} "
+            f"promoted={doc['storm']['promoted']} "
+            f"adopted={doc['storm']['split_adopted']} "
+            f"oracle_mismatches={doc['oracle']['mismatches']} "
+            f"retune_moves={doc['retune']['total_moves']} "
+            f"worst_reverts={doc['retune']['worst_reverts_per_phase']}")
+        print(json.dumps({
+            "metric": "storm_client_failures",
+            "value": doc["load_total"]["failures"],
+            "unit": "count",
+            "batches": doc["load_total"]["batches"],
+            "steady_p50_ms": doc["load"]["steady"]["p50_ms"],
+            "kill_router_p99_ms": doc["load"]["kill_router"]["p99_ms"],
+            "split_p99_ms": doc["load"]["split"]["p99_ms"],
+            "promoted": doc["storm"]["promoted"],
+            "split_adopted": doc["storm"]["split_adopted"],
+            "oracle_mismatches": doc["oracle"]["mismatches"],
+            "joined_trace": doc["trace"]["joined_trace"],
+            "retune_moves": doc["retune"]["total_moves"],
+            "worst_reverts": doc["retune"]["worst_reverts_per_phase"],
+            "ok": doc["ok"],
+            "artifact": artifact,
+            "obs_log": obs_log if artifact else None,
+        }))
+        if not doc["ok"]:
+            sys.exit(1)
+        return
+
     if "--serving" in sys.argv and "--sharded" in sys.argv:
         # sharded serving (ISSUE 12): shard replicas + the routing tier
         # as real processes — aggregate QPS scaling across 1/2/4
@@ -3346,6 +3426,7 @@ def main():
                 root,
                 clients=4, batch=16, pace_s=0.005,
                 kill_at_sweep=1500, post_kill_batches=150,
+                autotune=True,
                 log=log, obs_f=obs_f,
             )
         finally:
@@ -3361,6 +3442,11 @@ def main():
             f"failures={doc['failures']} outage={doc.get('outage_s')}s "
             f"steady_p99={doc['steady']['p99_ms']}ms "
             f"promo_p99={doc['promotion_window']['p99_ms']}ms")
+        tuner = (doc.get("autotune") or {}).get("standby") or {}
+        log(f"serving-rpc autotune: moves={len(tuner.get('history', []))} "
+            f"max_pending={tuner.get('max_pending')}"
+            f"/{tuner.get('ceiling')} "
+            f"shed_watermark={tuner.get('shed_watermark')}")
         # the per-stage attribution table (ISSUE 9): where an answered
         # batch's milliseconds went, steady vs promotion window, from
         # the merged trace spans in the OBS log
@@ -3391,6 +3477,8 @@ def main():
             "attribution_coverage_p50": (
                 (attr.get("steady") or {}).get("coverage_p50")
             ),
+            "autotune_moves": len(tuner.get("history", [])),
+            "shed_watermark": tuner.get("shed_watermark"),
             "ok": doc["ok"],
             "artifact": artifact,
             "obs_log": obs_log,

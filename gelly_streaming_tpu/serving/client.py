@@ -137,6 +137,7 @@ class RpcClient:
         route_attempts: int = 512,
         max_frame: int = DEFAULT_MAX_FRAME,
         seed: int = 0,
+        start_index: int = 0,
     ):
         if isinstance(addresses, str) or (
             isinstance(addresses, tuple)
@@ -158,7 +159,16 @@ class RpcClient:
         self._lock = threading.Lock()
         self._pending: dict = {}
         self._wire: Optional[Wire] = None
-        self._addr_i = 0
+        # first address tried; an EXPLICIT spread knob, deliberately
+        # not seed-derived — against a primary/standby pair an implicit
+        # spread would park half the clients on a standby that never
+        # promotes, spinning on not_primary. A router FLEET (every
+        # member serves) passes start_index=i to balance connections.
+        self._addr_i = int(start_index) % len(self._addrs)
+        # highest ownership epoch any reply frame carried (the router
+        # fleet reads this off its shard clients to learn of a live
+        # split from ordinary traffic — serving/reshard.py)
+        self.epoch_observed = 0
         self._closing = threading.Event()
         self._counter = itertools.count()
         self._id_prefix = f"{os.getpid():x}.{os.urandom(3).hex()}"
@@ -305,6 +315,7 @@ class RpcClient:
         hist = reg.histogram("rpc.client_wire_seconds")
         doc = {
             "pending": self.pending(),
+            "epoch_observed": self.epoch_observed,
             "connects": _count("rpc.client_connects"),
             "disconnects": _count("rpc.client_disconnects"),
             "reconnects": _count("rpc.client_reconnects"),
@@ -481,6 +492,11 @@ class RpcClient:
             return  # late duplicate of an already-settled batch
         if t_frame is not None:
             batch.t_resp = t_frame
+        ep = doc.get("epoch")
+        if ep is not None and int(ep) > self.epoch_observed:
+            # monotone adoption: reply frames from pre-split servers
+            # keep arriving after the bump and must not flap it back
+            self.epoch_observed = int(ep)
         status = doc.get("status")
         if status == OK:
             self._settle_ok(batch, doc.get("answers"))

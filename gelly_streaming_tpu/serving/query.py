@@ -47,6 +47,8 @@ from __future__ import annotations
 
 import base64
 import binascii
+import pickle
+import threading
 from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -57,6 +59,7 @@ import numpy as np
 from jax import lax
 
 from ..core.edgeblock import bucket_capacity
+from ..obs.registry import get_registry
 from .snapshot_store import PublishedSnapshot
 
 
@@ -397,6 +400,10 @@ class QueryEngine:
         self._chain_lab: Optional[np.ndarray] = None
         self._chain_n: int = 0
         self._ring: deque = deque(maxlen=DELTA_RING)
+        # the chain is touched from the server worker (summary_pull)
+        # AND, when a PullRingMirror is attached, from the ingest
+        # thread's publish listener (chain_sync) — hence the lock
+        self._chain_lock = threading.Lock()
 
     # -- table access (per-version host cache on the host path) -------- #
     def _table(self, snap: PublishedSnapshot, key: str):
@@ -512,20 +519,34 @@ class QueryEngine:
         connectivity fact forever. Docs are cached per
         ``(epoch, version, since)`` — the O(vcap) canonicalize + decode
         runs once however many routers pull."""
-        key = (snap.epoch, snap.version)
-        if self._pull_key != key:
-            self._advance_chain(snap)
-            self._pull_key = key
-            self._pull_docs = {}
-        since = int(since_version)
-        eff = since if since >= 0 else -1
-        cached = self._pull_docs.get(eff)
-        if cached is None:
-            cached = self._build_pull_doc(snap, eff)
-            self._pull_docs[eff] = cached
-        return cached
+        with self._chain_lock:
+            key = (snap.epoch, snap.version)
+            if self._pull_key != key:
+                self._advance_chain_locked(snap)
+                self._pull_key = key
+                self._pull_docs = {}
+            since = int(since_version)
+            eff = since if since >= 0 else -1
+            cached = self._pull_docs.get(eff)
+            if cached is None:
+                cached = self._build_pull_doc(snap, eff)
+                self._pull_docs[eff] = cached
+            return cached
 
-    def _advance_chain(self, snap: PublishedSnapshot) -> None:
+    def chain_sync(self, snap: PublishedSnapshot) -> None:
+        """Advance the delta chain to ``snap`` without answering a
+        pull — the :class:`PullRingMirror` hook.  Runs on the ingest
+        thread (publish listener); idempotent per (epoch, version), so
+        a later ``summary_pull`` at the same snapshot reuses the
+        already-advanced chain."""
+        with self._chain_lock:
+            key = (snap.epoch, snap.version)
+            if self._pull_key != key:
+                self._advance_chain_locked(snap)
+                self._pull_key = key
+                self._pull_docs = {}
+
+    def _advance_chain_locked(self, snap: PublishedSnapshot) -> None:
         """Canonicalize this snapshot's forest and record the changed
         rows since the previous pulled version as one ring segment.
         Resets the chain (no segment) on a store swap — a new epoch or
@@ -608,6 +629,69 @@ class QueryEngine:
         roots = np.asarray(vdict.decode(lab[:n].astype(np.int64)),
                            np.int64)
         return encode_pull_doc(raws, roots, kind="full", why=why)
+
+    # -- delta-ring persistence (ISSUE 19 satellite, PR 17 residual) --- #
+    def chain_state(self) -> dict:
+        """A picklable copy of the delta chain: the canonical table at
+        the last pulled version plus the ring segments.  Empty dict
+        before the chain exists.  The copy is what
+        :class:`PullRingMirror` persists so a RESTARTED shard can keep
+        serving delta pulls instead of always paying one full pull."""
+        with self._chain_lock:
+            if self._chain_lab is None:
+                return {}
+            return {
+                "version": int(self._chain_version),
+                "n": int(self._chain_n),
+                "lab": np.array(self._chain_lab, copy=True),
+                "ring": [
+                    {"base": int(s["base"]), "to": int(s["to"]),
+                     "u": np.array(s["u"], copy=True),
+                     "r": np.array(s["r"], copy=True)}
+                    for s in self._ring
+                ],
+            }
+
+    def restore_chain(self, state: dict, epoch: int,
+                      boot_version: int) -> bool:
+        """Adopt a persisted chain after a restart.
+
+        Accepted ONLY when the persisted chain head equals
+        ``boot_version`` — the version the restarted store republished
+        at boot (snapshot-mirror adoption with the version override).
+        Any mismatch means the ring and the served state diverged
+        (snapshot newer than the ring, or vice versa) and a delta
+        built on it could claim coverage it does not have; the engine
+        then keeps its empty chain and the next pull degrades to the
+        existing full fallback, counted
+        (``serving.pullring_rejected{reason}``)."""
+        reason = None
+        if not state or "lab" not in state:
+            reason = "empty"
+        elif int(state.get("version", -2)) != int(boot_version):
+            reason = "version"
+        if reason is not None:
+            get_registry().counter(
+                "serving.pullring_rejected", reason=reason).inc()
+            return False
+        with self._chain_lock:
+            self._chain_epoch = int(epoch)
+            self._chain_version = int(state["version"])
+            self._chain_lab = np.asarray(state["lab"]).copy()
+            self._chain_n = int(state["n"])
+            self._ring.clear()
+            for s in state.get("ring", []):
+                self._ring.append(
+                    {"base": int(s["base"]), "to": int(s["to"]),
+                     "u": np.asarray(s["u"], np.int64),
+                     "r": np.asarray(s["r"], np.int64)}
+                )
+            # the boot snapshot IS the restored chain head: mark it
+            # current so the first pull serves from the ring instead
+            # of appending a degenerate (V -> V) segment
+            self._pull_key = (int(epoch), int(boot_version))
+            self._pull_docs = {}
+        return True
 
     def bipartite(self, snap: PublishedSnapshot) -> dict:
         """The :class:`BipartiteQuery` answer value (see its docstring).
@@ -733,3 +817,69 @@ class QueryEngine:
                     version=snap.version, event_ts=snap.event_ts,
                 )
         return out  # type: ignore[return-value]
+
+
+# --------------------------------------------------------------------- #
+# Pull-ring persistence (ISSUE 19 satellite): checkpoint the delta
+# chain alongside the snapshot mirror so a RESTARTED shard bridges
+# routers with a delta pull instead of always paying one full pull.
+# --------------------------------------------------------------------- #
+
+PULL_RING_TAG = "pullring.bin"
+
+
+class PullRingMirror:
+    """Snapshot-store listener that keeps an engine's delta chain
+    advancing with every publish and persists it next to the snapshot
+    mirror (CRC-framed, overwrite — only the newest chain matters).
+
+    ``every`` throttles the O(n) persist the same way
+    ``SnapshotMirror(every=...)`` throttles snapshot writes; the chain
+    itself advances on EVERY publish (ring segments are per-version,
+    skipping one would tear the chain).  A failed persist is counted
+    (``serving.swallowed{site=pullring_write}``) and retried on the
+    next publish — the in-memory chain is still intact, only restart
+    bridging is at stake."""
+
+    def __init__(self, engine: QueryEngine, dirpath: str, *,
+                 every: int = 1) -> None:
+        self.engine = engine
+        self.dirpath = dirpath
+        self.every = max(1, int(every))
+        self._published = 0
+
+    def __call__(self, snap: PublishedSnapshot) -> None:
+        from ..fabric import as_transport
+
+        self.engine.chain_sync(snap)
+        self._published += 1
+        if self._published % self.every:
+            return
+        try:
+            blob = pickle.dumps(self.engine.chain_state(), protocol=4)
+            as_transport(self.dirpath).put_framed(
+                PULL_RING_TAG, blob, overwrite=True)
+        except Exception:
+            get_registry().counter(
+                "serving.swallowed", site="pullring_write").inc()
+
+
+def load_pull_ring(dirpath: str) -> dict:
+    """The persisted delta chain from ``dirpath`` (empty dict when
+    absent, torn, or undecodable — torn/undecodable are recorded, and
+    :meth:`QueryEngine.restore_chain` turns an empty dict into the
+    counted full-fallback degrade)."""
+    from ..fabric import as_transport
+    from ..resilience.integrity import record_rejection
+
+    tr = as_transport(dirpath)
+    data = tr.get_framed(PULL_RING_TAG)
+    if data is None:
+        return {}
+    try:
+        state = pickle.loads(data)
+    except Exception as e:
+        record_rejection(tr.describe(PULL_RING_TAG),
+                         f"undecodable pull ring: {e!r}")
+        return {}
+    return state if isinstance(state, dict) else {}

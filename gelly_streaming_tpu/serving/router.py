@@ -67,7 +67,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.ingest import vertex_owner
+from ..core.ingest import vertex_owner, vertex_owner_epoch
 from ..obs import trace as _trace
 from ..obs.registry import get_registry
 from .client import RpcClient
@@ -307,12 +307,14 @@ class ShardRouter:
         autotune: bool = False,
         target_wait_s: Optional[float] = None,
         delta: bool = True,
+        reshard=None,
     ):
         if not shard_addrs:
             raise ValueError("at least one shard address is required")
         factory = client_factory or (
             lambda addrs, i: RpcClient(addrs, seed=seed + i)
         )
+        self._factory = factory
         self._clients: List[RpcClient] = [
             factory(a if isinstance(a, (list, tuple)) and not (
                 isinstance(a, tuple) and len(a) == 2
@@ -321,6 +323,16 @@ class ShardRouter:
             for i, a in enumerate(shard_addrs)
         ]
         self.nshards = len(self._clients)
+        #: elastic resharding (ISSUE 19): the BOOT shard count is the
+        #: hash base forever — splits compose on top of it
+        #: (``core.ingest.vertex_owner_epoch``), so adopting a split
+        #: never moves keys that did not split. ``reshard`` is the
+        #: coordination store (dir/transport) split plans are read
+        #: from; adoption triggers off reply-frame epoch stamps.
+        self._hash_shards = len(self._clients)
+        self._reshard = reshard
+        self._splits: list = []   # adopted plan dicts, epoch order
+        self._epoch = 0           # == len(self._splits)
         self.max_pending = int(max_pending)
         #: load-aware admission (ISSUE 15): same contract as
         #: ``StreamServer(autotune=True)`` — the router's drain sweep
@@ -491,6 +503,7 @@ class ShardRouter:
             pending = len(self._pending) + self._inflight
         return {
             "shards": self.nshards,
+            "epoch": self._epoch,
             "pending": pending,
             "cache_entries": cache_n,
             "shard_versions": list(self._vers),
@@ -510,6 +523,10 @@ class ShardRouter:
 
         return {
             "pending": self.pending(),
+            "epoch": self._epoch,
+            "shards": self.nshards,
+            "reshard_adopts":
+                int(_count("reshard.adopt", site="router")),
             "cache_hits": int(_count("router.cache_hits")),
             "cache_misses": int(_count("router.cache_misses")),
             "cache_invalidations":
@@ -564,6 +581,8 @@ class ShardRouter:
             self._wake.clear()
 
     def _sweep(self, batch: List[_Entry]) -> None:
+        if self._reshard is not None:
+            self._maybe_adopt_epoch()
         reg = get_registry()
         now = time.perf_counter()
         t_sweep = now
@@ -628,11 +647,74 @@ class ShardRouter:
             self._route_cc(cc)
 
     # ------------------------------------------------------------------ #
+    # Elastic resharding: epoch adoption (worker thread only)
+    # ------------------------------------------------------------------ #
+    def _maybe_adopt_epoch(self) -> None:
+        """Adopt newly actionable split plans once any shard's reply
+        frames stamp an epoch ahead of ours.
+
+        Runs on the router worker (the only thread that reads
+        ``_clients`` by index for fan-out), so appending a child
+        client is race-free for routing; the merged-CC arrays grow
+        under ``_mlock`` where every other reader holds it. A stamp
+        ahead of the store's ACTIONABLE prefix just retries next sweep
+        (the child's address commit is what we are waiting on).
+        Adoption never rolls back — splits are monotone history."""
+        observed = max(c.epoch_observed for c in self._clients)
+        if observed <= self._epoch:
+            return
+        from .reshard import actionable_plans
+
+        try:
+            plans = actionable_plans(self._reshard)
+        except Exception:
+            # a flaky store read must not take the sweep down; the
+            # reply frames keep stamping, the next sweep retries
+            get_registry().counter(
+                "router.swallowed", site="reshard_read").inc()
+            return
+        reg = get_registry()
+        for p in plans[self._epoch:]:
+            if int(p["child"]) != len(self._clients):
+                # a plan whose child index does not extend the client
+                # list would mis-route every moved key; refuse it (and
+                # everything after — plans compose in order)
+                reg.counter(
+                    "router.swallowed", site="reshard_geometry").inc()
+                return
+            cl = self._factory([p["addr"]], len(self._clients))
+            with self._mlock:
+                self._clients.append(cl)
+                self._vers.append(0)
+                self._pulled_vers.append(-1)
+                self._pairs.append(None)
+                self._rows.append(None)
+                self._pull_meta.append(None)
+                self._pull_err.append(None)
+                self._splits.append(
+                    {k: int(p[k])
+                     for k in ("epoch", "parent", "child", "salt")})
+                self.nshards = len(self._clients)
+                self._epoch = len(self._splits)
+                # the merged forest must now cover the child's pull
+                # before answering: drop the merge so the next CC
+                # query refreshes against ALL shards including the
+                # child (its first pull is a full, since=-1)
+                self._merged = None
+            reg.counter(
+                "reshard.adopt", epoch=str(p["epoch"]), site="router",
+            ).inc()
+
+    # ------------------------------------------------------------------ #
     # Degree / rank: owner fan-out
     # ------------------------------------------------------------------ #
     def _fan_out(self, entries: List[_Entry]) -> None:
-        owners = vertex_owner(
-            np.asarray([e.q.v for e in entries], np.int64), self.nshards
+        # ownership = boot hash + adopted split generations: the hash
+        # base NEVER changes (self._hash_shards), splits move only the
+        # split-off half of the split shard's keys (ISSUE 19)
+        owners = vertex_owner_epoch(
+            np.asarray([e.q.v for e in entries], np.int64),
+            self._hash_shards, self._splits,
         )
         # sub-batch per (shard, trace group, has-deadline): untraced
         # entries coalesce per shard; traced ones split per group so
@@ -969,7 +1051,7 @@ class ShardRouter:
         )
         self._delta_pending = []
         self._delta_hist.clear()
-        self._full_pending = False  # graftlint: disable=GL002 (caller holds _mlock — the _locked suffix is the contract, enforced by every call site sitting inside a `with self._mlock:` block)
+        self._full_pending = False
 
     def _apply_deltas_locked(self) -> None:
         """Fold the delta rows accepted since the last refresh into the
@@ -1381,7 +1463,12 @@ def router_main(cfg: dict) -> None:
     """The router as a real process. ``cfg`` keys: ``shards`` (one
     address list per shard), ``portfile``, optional ``events`` (ShardSink
     path + ``shard`` label), ``cache``/``cache_cap``/``cache_ttl_s``,
-    ``delta`` (pull protocol v2 on/off), ``run_s``, ``meta``."""
+    ``delta`` (pull protocol v2 on/off), ``run_s``, ``meta``.
+
+    ISSUE 19 keys: ``autotune``/``target_wait_s`` (load-aware
+    admission), ``reshard`` (split-plan store dir — live ownership
+    epoch adoption; the router's own reply frames re-stamp the adopted
+    epoch, so clients of a router FLEET converge too)."""
     import json
     import signal
 
@@ -1395,6 +1482,13 @@ def router_main(cfg: dict) -> None:
         get_registry().add_sink(sink)
         obs_trace.add_sink(sink)
         obs_trace.enable(registry_spans=False)
+    kw = {}
+    if cfg.get("autotune"):
+        kw["autotune"] = True
+        if cfg.get("target_wait_s") is not None:
+            kw["target_wait_s"] = float(cfg["target_wait_s"])
+    if cfg.get("reshard"):
+        kw["reshard"] = cfg["reshard"]
     router = ShardRouter(
         cfg["shards"],
         cache=bool(cfg.get("cache", True)),
@@ -1402,8 +1496,9 @@ def router_main(cfg: dict) -> None:
         cache_ttl_s=cfg.get("cache_ttl_s"),
         max_pending=int(cfg.get("max_pending", 1 << 14)),
         delta=bool(cfg.get("delta", True)),
+        **kw,
     )
-    rpc = RpcServer(router).start()
+    rpc = RpcServer(router, epoch=lambda: router._epoch).start()
     if cfg.get("portfile"):
         from ..resilience import integrity
 

@@ -303,11 +303,16 @@ class RpcServer:
         gate: Optional[Callable[[], Optional[str]]] = None,
         max_frame: int = DEFAULT_MAX_FRAME,
         dedupe_cap: int = 1024,
+        epoch: Optional[Callable[[], int]] = None,
     ):
         self.server = server
         self.host = host
         self._port = int(port)
         self.gate = gate
+        # ownership-epoch provider (serving.reshard): when it returns
+        # > 0, reply frames carry the epoch so routers learn of live
+        # splits from ordinary traffic, no control channel needed
+        self.epoch = epoch
         self.max_frame = int(max_frame)
         self.dedupe_cap = int(dedupe_cap)
         self._lock = threading.Lock()
@@ -572,9 +577,19 @@ class RpcServer:
                 return
             self._inflight.pop(batch.id, None)
         t_reply = time.perf_counter()
-        data = pack_frame(T_RESP, json.dumps(
-            {"id": batch.id, "status": OK, "answers": batch.slots}
-        ).encode("utf-8"))
+        doc = {"id": batch.id, "status": OK, "answers": batch.slots}
+        if self.epoch is not None:
+            try:
+                ep = int(self.epoch())
+            except Exception:
+                # a broken epoch provider must never cost an answer;
+                # the frame just rides without the stamp, counted
+                get_registry().counter(
+                    "rpc.swallowed", site="epoch_probe").inc()
+                ep = 0
+            if ep > 0:
+                doc["epoch"] = ep
+        data = pack_frame(T_RESP, json.dumps(doc).encode("utf-8"))
         with self._lock:
             self._done[batch.id] = data
             while len(self._done) > self.dedupe_cap:
@@ -813,6 +828,23 @@ class ReplicaServer:
     and the promoted standby serves the last mirrored snapshot — the
     same keep-serving-from-final-state contract a closed stream has.
     Stream-processing recovery stays with the supervisor/cluster layer.
+
+    ``role="split"`` (ISSUE 19, elastic resharding): the CHILD of a
+    live shard split. Follows the PARENT's serving directory exactly
+    like a standby — but its gate is OPEN (it answers immediately from
+    the followed state), it never monitors or touches the parent's
+    lease, and it never promotes. The parent keeps every key, so the
+    child serving the full followed table is oracle-identical on the
+    moved half of the keyspace — routers send it only keys whose
+    ``split_side`` bit moved (``core.ingest.vertex_owner_epoch``).
+
+    ``reshard={"store": <dir>, "shard": <int>}`` attaches a
+    :class:`~gelly_streaming_tpu.serving.reshard.ReshardWatcher`: the
+    replica learns the live ownership epoch and stamps it on every
+    reply frame (``RpcServer(epoch=...)``), which is how routers hear
+    about splits from ordinary traffic. An adopted plan whose parent
+    is THIS shard is counted ``reshard.split``; any other adoption is
+    ``reshard.adopt``.
     """
 
     def __init__(
@@ -830,10 +862,12 @@ class ReplicaServer:
         mirror_keep: int = 2,
         poll_s: float = 0.02,
         monitor: bool = True,
+        reshard: Optional[dict] = None,
         **server_kwargs,
     ):
-        if role not in ("primary", "standby"):
-            raise ValueError(f"role must be primary/standby, got {role!r}")
+        if role not in ("primary", "standby", "split"):
+            raise ValueError(
+                f"role must be primary/standby/split, got {role!r}")
         self.dirpath = dirpath
         self.rejoined = False
         if role == "primary":
@@ -867,6 +901,10 @@ class ReplicaServer:
         self._plock = threading.Lock()
         self._closed = False
         self.lease: Optional[HeartbeatLease] = None
+        self._reshard_cfg = reshard
+        self._reshard = None  # ReshardWatcher, created in start()
+        self._reshard_seen = 0  # adopted-plan prefix already counted
+        self.shard = None if reshard is None else reshard.get("shard")
         if role == "primary":
             if servable is None:
                 raise ValueError("a primary replica needs a servable")
@@ -886,7 +924,8 @@ class ReplicaServer:
             self.server = StreamServer(follower, None, **server_kwargs)
             self.store = self.server.store
         self.rpc = RpcServer(
-            self.server, host=host, port=port, gate=self._gate
+            self.server, host=host, port=port, gate=self._gate,
+            epoch=self._epoch,
         )
 
     # ------------------------------------------------------------------ #
@@ -912,9 +951,44 @@ class ReplicaServer:
         return False  # fresh but silent: a dead predecessor's record
 
     def _gate(self) -> Optional[str]:
-        return None if self.role == "primary" else NOT_PRIMARY
+        # a split child answers from boot — its traffic is routed by
+        # ownership epoch, not by lease, so there is nothing to refuse
+        return None if self.role in ("primary", "split") else NOT_PRIMARY
+
+    def _epoch(self) -> int:
+        """Current ownership epoch for reply-frame stamping (0 before
+        any split is actionable, or with no reshard store attached)."""
+        w = self._reshard
+        return 0 if w is None else w.epoch()
+
+    def _on_reshard(self, plans: list) -> None:
+        """Watcher callback: count each NEWLY adopted plan — a split
+        of this shard's own keyspace (``reshard.split``) reads
+        differently in the storm timeline than a peer's split this
+        replica merely adopts (``reshard.adopt``)."""
+        reg = get_registry()
+        for p in plans[self._reshard_seen:]:
+            if self.shard is not None and p["parent"] == self.shard:
+                reg.counter(
+                    "reshard.split", epoch=str(p["epoch"]),
+                    parent=str(p["parent"]), child=str(p["child"]),
+                ).inc()
+            else:
+                reg.counter(
+                    "reshard.adopt", epoch=str(p["epoch"]),
+                    site="replica",
+                ).inc()
+        self._reshard_seen = len(plans)
 
     def start(self) -> "ReplicaServer":
+        if self._reshard_cfg is not None:
+            from .reshard import ReshardWatcher
+
+            self._reshard = ReshardWatcher(
+                self._reshard_cfg["store"],
+                poll_s=float(self._reshard_cfg.get("poll_s", 0.1)),
+                on_adopt=self._on_reshard,
+            )
         self.server.start()
         self.rpc.start()
         if self.role == "primary":
@@ -1048,6 +1122,7 @@ class ReplicaServer:
             "pending": len(self.server._pending),
             "heartbeat_age_s": self.heartbeat_age_s(),
             "rpc_port": self.rpc.port,
+            "epoch": self._epoch(),
         }
         rec = HeartbeatLease.read(self.dirpath)
         if rec is not None:
@@ -1082,6 +1157,8 @@ class ReplicaServer:
         # fresh copy each
         deadline = time.monotonic() + float(timeout)
         self._mon_stop.set()
+        if self._reshard is not None:
+            self._reshard.close(max(0.0, deadline - time.monotonic()))
         if self._mon_thread is not None:
             self._mon_thread.join(
                 max(0.0, deadline - time.monotonic()))
@@ -1140,7 +1217,18 @@ def replica_main(cfg: dict) -> None:
     ``flight`` (flight-recorder dump base), ``kill_at_sweep`` (FaultPlan
     ``serving.worker`` kill -> ``os._exit(KILL_RC)`` with the black box
     dumped first), ``windows``/``vcap``/``pace_s`` (primary demo
-    stream), ``lease_s``, ``run_s`` (wall-clock cap), ``meta``."""
+    stream), ``lease_s``, ``run_s`` (wall-clock cap), ``meta``.
+
+    ISSUE 19 keys: ``autotune``/``target_wait_s`` (load-aware
+    admission on the inner StreamServer), ``reshard``
+    (``{"store": dir, "shard": k}`` — epoch stamping + adoption),
+    ``role="split"`` + ``split_epoch`` (boot as a split child of
+    ``dir``'s parent shard and publish this process's address under
+    the split epoch once servable), ``pullring`` (persist the delta
+    pull ring next to the snapshot mirror), ``adopt_boot`` (republish
+    the newest mirrored snapshot under its ORIGINAL version before
+    ingest, restoring the pull ring when present — the restarted-shard
+    bridge)."""
     import signal
 
     import jax
@@ -1174,6 +1262,16 @@ def replica_main(cfg: dict) -> None:
             kill_at_window=int(kill_at),
             kill_exit_code=KILL_RC,
         ))
+    kw = dict(
+        lease_s=float(cfg.get("lease_s", 0.5)),
+        max_pending=int(cfg.get("max_pending", 1 << 14)),
+    )
+    if cfg.get("autotune"):
+        kw["autotune"] = True
+        if cfg.get("target_wait_s") is not None:
+            kw["target_wait_s"] = float(cfg["target_wait_s"])
+    if cfg.get("reshard"):
+        kw["reshard"] = cfg["reshard"]
     if role == "primary":
         if cfg.get("cc_shard"):
             # one SHARD of the partitioned serving deployment: real CC
@@ -1189,17 +1287,53 @@ def replica_main(cfg: dict) -> None:
                 pace_s=float(cfg.get("pace_s", 0.005)),
             )
         rep = ReplicaServer(
-            servable, None, dirpath=cfg["dir"], role="primary",
-            lease_s=float(cfg.get("lease_s", 0.5)),
-            max_pending=int(cfg.get("max_pending", 1 << 14)),
+            servable, None, dirpath=cfg["dir"], role="primary", **kw
         )
+        if cfg.get("pullring"):
+            from .query import PullRingMirror
+
+            rep.store.add_listener(PullRingMirror(
+                rep.server.engine, cfg["dir"],
+                every=int(cfg.get("pullring_every", 1)),
+            ))
+        if cfg.get("adopt_boot") and not rep.rejoined:
+            # restart adoption: republish the newest mirrored snapshot
+            # under its ORIGINAL version so router delta baselines (and
+            # the persisted pull ring) survive the restart; a missing
+            # mirror just means a cold boot
+            from .snapshot_store import load_newest_snapshot
+
+            doc = load_newest_snapshot(cfg["dir"])
+            if doc is not None:
+                rep.server.publish_boot(
+                    doc["payload"], int(doc["watermark"]),
+                    version=int(doc["version"]),
+                )
+                if cfg.get("pullring"):
+                    from .query import load_pull_ring
+
+                    rep.server.engine.restore_chain(
+                        load_pull_ring(cfg["dir"]),
+                        rep.store.epoch, int(doc["version"]),
+                    )
     else:
-        rep = ReplicaServer(
-            dirpath=cfg["dir"], role="standby",
-            lease_s=float(cfg.get("lease_s", 0.5)),
-            max_pending=int(cfg.get("max_pending", 1 << 14)),
-        )
+        rep = ReplicaServer(dirpath=cfg["dir"], role=role, **kw)
     rep.start()
+    if role == "split" and cfg.get("reshard"):
+        # the child address is published ONLY once servable (first
+        # followed snapshot answered) — the actionable-prefix rule in
+        # serving/reshard.py is what keeps routers from adopting an
+        # epoch whose child would refuse traffic
+        from .reshard import publish_addr
+
+        rep.store.wait_for(
+            min_version=1,
+            timeout=float(cfg.get("split_boot_timeout_s", 60.0)),
+        )
+        publish_addr(
+            cfg["reshard"]["store"], int(cfg["split_epoch"]),
+            f"127.0.0.1:{rep.rpc.port}",
+        )
     if cfg.get("portfile"):
         from ..resilience import integrity
 
@@ -1217,6 +1351,17 @@ def replica_main(cfg: dict) -> None:
         "promoted": rep.promoted,
         "port": rep.rpc.port,
     }
+    adm = getattr(rep.server, "admission", None)
+    if cfg.get("autotune") and adm is not None:
+        # the admission tuner's full trajectory: every knob move plus
+        # the final watermark — the committed shed-trajectory evidence
+        meta["autotune"] = {
+            "knob": adm.knob,
+            "ceiling": adm.ceiling,
+            "max_pending": adm.max_pending,
+            "shed_watermark": round(adm.shed_watermark, 4),
+            "history": [list(h) for h in adm.history],
+        }
     rep.close()
     if cfg.get("meta"):
         with open(cfg["meta"], "w") as f:

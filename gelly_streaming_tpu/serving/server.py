@@ -198,13 +198,17 @@ class StreamServer:
     # ------------------------------------------------------------------ #
     # Lifecycle
     # ------------------------------------------------------------------ #
-    def publish_boot(self, payload: dict, watermark: int = 0) -> None:
+    def publish_boot(self, payload: dict, watermark: int = 0,
+                     version: Optional[int] = None) -> None:
         """Publish a pre-ingest snapshot (window -1): the checkpoint-boot
         path serves the restored summary immediately, before the first
-        catch-up window folds. Must run before :meth:`start`."""
+        catch-up window folds. Must run before :meth:`start`.
+        ``version`` carries the mirrored snapshot's original version
+        through a restart (see :meth:`SnapshotStore.publish`)."""
         if self._ingest_thread is not None:
             raise RuntimeError("publish_boot must precede start()")
-        self.store.publish(payload, window=-1, watermark=watermark)
+        self.store.publish(payload, window=-1, watermark=watermark,
+                           version=version)
 
     def start(self) -> "StreamServer":
         if self._ingest_thread is not None:

@@ -174,17 +174,26 @@ class SnapshotStore:
     # -- write side ---------------------------------------------------- #
     def publish(
         self, payload: Mapping[str, Any], window: int, watermark: int,
-        event_ts: int = -1,
+        event_ts: int = -1, version: Optional[int] = None,
     ) -> PublishedSnapshot:
         """Swap in a new snapshot and wake waiters. The assignment to
         ``_current`` IS the publication point; the lock below only
-        guards the condition notify."""
+        guards the condition notify.
+
+        ``version`` overrides the monotone counter for ONE publish —
+        the restart-adoption boot path republishes the mirrored
+        snapshot under its original version so downstream delta
+        baselines (routers, the persisted pull ring) stay valid
+        instead of watching versions restart from 1. Later publishes
+        continue from the override."""
         prev = self._current
+        if version is None:
+            version = 1 if prev is None else prev.version + 1
         snap = PublishedSnapshot(
             payload=payload,
             window=window,
             watermark=watermark,
-            version=1 if prev is None else prev.version + 1,
+            version=int(version),
             epoch=self.epoch,
             event_ts=int(event_ts),
         )

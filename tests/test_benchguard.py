@@ -295,3 +295,46 @@ def test_sharded_watch_directions():
     assert by["zipf.cache_on.p99_ms"]["ok"] is False
     assert by["min:churn.bytes_x"]["ok"] is False
     assert by["min:churn.merge_x"]["ok"] is False
+
+
+def test_storm_watch_list_matches_the_storm_artifact():
+    # ISSUE 19 satellite: the CI storm guard watches client-visible
+    # QPS + the zero-failures indicator (min: direction) and the two
+    # kill phases' client p50 (recovery latency, regression upward).
+    # The committed artifact must also PROVE the storm: zero failures,
+    # promotion, adoption, a clean oracle, and an overall green gate.
+    from tools.benchguard import WATCHED_STORM
+
+    path = os.path.join(REPO, "BENCH_STORM_CPU.json")
+    with open(path) as f:
+        committed = json.load(f)
+    for metric in WATCHED_STORM:
+        value = dig(committed, metric[4:] if metric.startswith("min:")
+                    else metric)
+        assert isinstance(value, (int, float)), metric
+    assert "min:load_total.qps" in WATCHED_STORM
+    assert "min:load_total.zero_failures" in WATCHED_STORM
+    assert committed["load_total"]["failures"] == 0
+    assert committed["load_total"]["zero_failures"] == 1
+    assert committed["oracle"]["mismatches"] == 0
+    assert committed["storm"]["promoted"] is True
+    assert committed["storm"]["split_adopted"] is True
+    assert committed["ok"] is True
+
+
+def test_storm_watch_directions():
+    from tools.benchguard import WATCHED_STORM
+
+    base = {"load_total": {"qps": 1000.0, "zero_failures": 1},
+            "load": {"kill_router": {"p50_ms": 5.0},
+                     "kill_shard": {"p50_ms": 5.0}}}
+    # ONE client-visible failure must regress the indicator even when
+    # every latency metric stayed flat — the contract is the zero
+    bad = {"load_total": {"qps": 900.0, "zero_failures": 0},
+           "load": {"kill_router": {"p50_ms": 5.0},
+                    "kill_shard": {"p50_ms": 5.0}}}
+    by = {v["metric"]: v for v in
+          compare(base, bad, ratio=3.0, watched=WATCHED_STORM)}
+    assert by["min:load_total.zero_failures"]["ok"] is False
+    assert by["min:load_total.qps"]["ok"] is True
+    assert by["load.kill_router.p50_ms"]["ok"] is True

@@ -510,6 +510,119 @@ def test_skewed_stream_feeds_the_lateness_policy():
     agg.finish()  # verify=True raises on any divergence from oracle
 
 
+def test_watermark_skew_under_auto_k_no_drops_no_oscillation():
+    """Watermark-skew x auto-K interplay (ISSUE 19 satellite, PR 18
+    residual): one shard's timestamps jitter by a bounded skew. With
+    ``allowed_lateness`` covering the bound the pane assembler must
+    drop NOTHING (skew moves records across pane boundaries, never off
+    the stream), and the pane-ordered stream under
+    ``superbatch="auto"`` must stay value-identical to the pinned-K
+    oracle without the tuner oscillating K."""
+    from gelly_streaming_tpu.core.stream import SimpleEdgeStream
+    from gelly_streaming_tpu.core.window import CountWindow
+    from gelly_streaming_tpu.datasets import IdentityDict
+    from gelly_streaming_tpu.eventtime import PaneAssembler
+    from gelly_streaming_tpu.eventtime.panes import EventTimeSlidingWindow
+    from gelly_streaming_tpu.library import ConnectedComponents
+
+    rng = np.random.default_rng(19)
+    n = 1 << 15
+    vmax = 4096
+    src = rng.integers(0, vmax, n).astype(np.int64)
+    dst = rng.integers(0, vmax, n).astype(np.int64)
+    ts = (np.arange(n, dtype=np.int64) // 8)  # 8 records per tick
+
+    # shard 1 (odd indices) is the skewed shard: every one of its
+    # timestamps jitters by a deterministic offset in [-3, +3]
+    skew = 3
+    plan = FaultPlan(seed=19, skew_records=tuple(range(1, n, 2)),
+                     skew_ts_s=skew)
+    recs = list(plan.perturb_records(
+        iter([(int(s), int(d), 0.0, int(t))
+              for s, d, t in zip(src, dst, ts)])
+    ))
+    pts = np.array([r[3] for r in recs], np.int64)
+    # the skew must actually cross pane boundaries or this pins nothing
+    policy = EventTimeSlidingWindow(4, 4)
+    assert (policy.pane_of(pts) != policy.pane_of(ts)).any()
+
+    # Interleaved two-shard arrival through the min-merge clock.
+    # Deliveries land under the clock of the PREVIOUS chunk (watermarks
+    # trail delivery), so the merged watermark never runs more than
+    # 2*skew past a record's perturbed ts: wm <= prev_front + skew and
+    # perturbed >= true - skew. lateness >= 2*skew + 2 therefore
+    # guarantees no record's pane has closed when it arrives.
+    drop0 = counter_value("eventtime.late_dropped")
+    tr = WatermarkTracker(2)
+    asm = PaneAssembler(policy, allowed_lateness=2 * skew + 2)
+    panes = []
+    dropped = 0
+    wm = tr.current()  # NO_WATERMARK before any shard speaks
+    for lo in range(0, n, 512):
+        chunk = recs[lo:lo + 512]
+        for shard in (0, 1):
+            mine = [r for i, r in enumerate(chunk)
+                    if (lo + i) % 2 == shard]
+            dropped += asm.add(
+                np.array([r[0] for r in mine], np.int64),
+                np.array([r[1] for r in mine], np.int64),
+                np.array([r[3] for r in mine], np.int64),
+                wm,
+            )
+            tr.observe(
+                shard, np.array([r[3] for r in mine], np.int64)
+            )
+        wm = tr.current()
+        panes.extend(asm.advance(wm))
+    panes.extend(asm.flush())
+    assert dropped == 0, "skew within the allowance must not drop"
+    assert counter_value("eventtime.late_dropped") == drop0
+    live = [p for p in panes if len(p)]
+    assert len(live) > 8  # many closed panes, a real cadence
+    cols = [p.cols() for p in live]
+    src_all = np.concatenate([c[0] for c in cols])
+    dst_all = np.concatenate([c[1] for c in cols])
+    assert len(src_all) == n  # conservation: every record in a pane
+
+    # the closed panes, in close order, ARE the superbatch stream;
+    # auto-K over them must match the pinned-K oracle emission-for-
+    # emission and must not thrash the ladder
+    def stream():
+        return SimpleEdgeStream(
+            (src_all, dst_all), window=CountWindow(256),
+            vertex_dict=IdentityDict(vmax),
+        )
+
+    base = [
+        str(c) for c in ConnectedComponents(superbatch=1).run(stream())
+    ]
+    agg = ConnectedComponents(superbatch="auto")
+    auto = [str(c) for c in agg.run(stream())]
+    assert auto == base
+    moves = [(old, new) for old, new, _sig in agg.control.autok.history]
+    assert moves, (
+        "the run must have re-tuned K mid-stream (otherwise this test "
+        "pinned nothing)"
+    )
+    # oscillation = the same rung pair bouncing A->B->A more than once.
+    # ONE bounce is the guarded hill-climb's designed probe->refuse->
+    # re-probe; repeating it means the refused-rung memory failed.
+    kseq = [moves[0][0]] + [new for _old, new in moves]
+    bounces: dict = {}
+    i = 0
+    while i + 2 < len(kseq):
+        if kseq[i] == kseq[i + 2] != kseq[i + 1]:
+            key = frozenset((kseq[i], kseq[i + 1]))
+            bounces[key] = bounces.get(key, 0) + 1
+            i += 2  # a bounce's end can start the NEXT bounce, not
+            # re-count this one
+        else:
+            i += 1
+    assert all(v <= 1 for v in bounces.values()), (
+        f"K oscillated under skewed panes: {kseq}"
+    )
+
+
 # --------------------------------------------------------------------- #
 # 9. Serving: the event-time stamp rides snapshot -> Answer -> wire
 # --------------------------------------------------------------------- #

@@ -252,6 +252,63 @@ def test_gl002_one_direction_nesting_is_clean(tmp_path):
     assert "GL002" not in rule_ids(res)
 
 
+# The ``_locked`` suffix contract (ISSUE 19): a caller-holds-the-lock
+# helper's writes are exempt, and in exchange every call site must
+# actually hold a lock (or carry the suffix itself).
+GL002_LOCKED_HELPER = {
+    "serving/router.py": """
+    import threading
+
+    class ShardRouter:
+        def __init__(self):
+            self._mlock = threading.Lock()
+            self._merged = None
+
+        def refresh(self):
+            with self._mlock:
+                self._merged = object()
+                self._rebuild_merged_locked()
+
+        def _rebuild_merged_locked(self):
+            self._merged = object()
+    """,
+}
+
+GL002_LOCKED_UNHELD = {
+    "serving/router.py": """
+    import threading
+
+    class ShardRouter:
+        def __init__(self):
+            self._mlock = threading.Lock()
+            self._merged = None
+
+        def refresh(self):
+            with self._mlock:
+                self._merged = object()
+
+        def _rebuild_merged_locked(self):
+            self._merged = object()
+
+        def sweep(self):
+            self._rebuild_merged_locked()
+    """,
+}
+
+
+def test_gl002_locked_suffix_helper_writes_are_clean(tmp_path):
+    res = lint_files(tmp_path, GL002_LOCKED_HELPER)
+    assert "GL002" not in rule_ids(res)
+
+
+def test_gl002_locked_helper_called_without_lock_fires(tmp_path):
+    res = lint_files(tmp_path, GL002_LOCKED_UNHELD)
+    msgs = [f.message for f in res.findings if f.rule == "GL002"]
+    assert msgs and any(
+        "_rebuild_merged_locked" in m and "sweep" in m for m in msgs
+    )
+
+
 # --------------------------------------------------------------------- #
 # GL003 silent-swallow
 # --------------------------------------------------------------------- #

@@ -268,6 +268,26 @@ def test_gl008_close_budget_reuse_fires_once(tmp_path):
     assert len(msgs) == 1 and "re-spends" in msgs[0]
 
 
+def test_gl008_split_boot_budget_is_deadline_vocabulary(tmp_path):
+    """ISSUE 19 vocabulary: ``split_boot_timeout_s`` is a deadline —
+    forwarding the raw budget after wall time passed is the same
+    fresh-full-budget bug GL008 pins on ``deadline_s``."""
+    res = lint_files(tmp_path, {
+        "resilience/chaos.py": """
+        import time
+
+        def wait_portfile(path, timeout_s=90.0):
+            return 1
+
+        def run_storm(split_boot_timeout_s=90.0):
+            time.sleep(1.0)
+            wait_portfile("a", timeout_s=split_boot_timeout_s)
+        """,
+    })
+    msgs = [f.message for f in res.findings if f.rule == "GL008"]
+    assert msgs and "split_boot_timeout_s" in msgs[0]
+
+
 def test_gl008_near_misses_are_clean(tmp_path):
     res = lint_files(tmp_path, GL008_NEG)
     assert "GL008" not in rule_ids(res)

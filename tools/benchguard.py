@@ -129,6 +129,24 @@ WATCHED_EVENTTIME = (
     "min:cells.retract.ratio_vs_rebuild",
 )
 
+#: the failover-storm artifact's guarded cells (BENCH_STORM_CPU.json,
+#: ISSUE 19): client-visible QPS through the WHOLE storm — router
+#: kill, shard kill, live split, retunes — is throughput (``min:`` —
+#: a regression means elasticity started costing the clients), the
+#: zero-failures contract rides as a 1/0 indicator in the same
+#: direction (compare() skips a committed 0, so the raw failure count
+#: cannot gate; the indicator can — a fresh 0 fails the 1/3 bound),
+#: and the two kill phases' client p50 are the recovery latencies
+#: (regression upward). The split phase's p99 is NOT guarded: it is
+#: dominated by the child's snapshot restore, which scales with
+#: geometry, not code.
+WATCHED_STORM = (
+    "min:load_total.qps",
+    "min:load_total.zero_failures",
+    "load.kill_router.p50_ms",
+    "load.kill_shard.p50_ms",
+)
+
 #: a fresh value may be up to this many times the committed one
 DEFAULT_RATIO = 3.0
 

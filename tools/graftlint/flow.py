@@ -46,9 +46,13 @@ from .graph import FunctionInfo, RepoGraph
 #: ``join_timeout_s`` is the ingest reader-drain vocabulary (ISSUE 18):
 #: a per-shard close() that hands the same budget to every join would
 #: multiply the caller's wait by the shard count.
+#: ``split_boot_timeout_s`` is the elastic-resharding vocabulary
+#: (ISSUE 19): the budget a split child gets to restore the parent's
+#: snapshot and publish its address — a copy that never reaches the
+#: store's bounded wait hangs the storm's SPLIT phase forever.
 DEADLINE_PARAMS = frozenset({
     "deadline_s", "deadline", "timeout", "timeout_s", "budget_s",
-    "join_timeout_s",
+    "join_timeout_s", "split_boot_timeout_s",
 })
 
 #: dict keys that carry a deadline across a wire/frame boundary

@@ -89,10 +89,19 @@ class LockDiscipline(Rule):
         guarded -= lock_attrs
         if not guarded:
             return
+        # the ``_locked`` suffix is the repo's caller-holds-the-lock
+        # contract (router.py documents it on ``_rebuild_merged_locked``
+        # et al.): writes inside such a helper are exempt from pass 2,
+        # and pass 3 makes the contract REAL by flagging any call site
+        # that does not itself hold a lock (or carry the suffix)
+        locked_helpers = {m.name for m in methods
+                          if m.name.endswith("_locked")}
         # pass 2: writes to guarded attributes outside every owned lock
         for m in methods:
             if m.name == "__init__":
                 continue  # no concurrent reader can exist yet
+            if m.name in locked_helpers:
+                continue  # caller holds the lock; pass 3 checks callers
             locked_nodes: Set[ast.AST] = set()
             for w in self._with_lock_blocks(m, lock_attrs):
                 locked_nodes |= set(ast.walk(w))
@@ -107,6 +116,28 @@ class LockDiscipline(Rule):
                         f"'self.{self._guard_name(cls, lock_attrs)}' "
                         f"elsewhere but written here without it "
                         f"(method '{m.name}')",
+                    )
+        # pass 3: every ``self.<helper>_locked(...)`` call must sit
+        # inside a with-lock block or inside another ``_locked`` method
+        # (the suffix composes) — otherwise the contract is a comment
+        for m in methods:
+            if m.name == "__init__" or m.name in locked_helpers:
+                continue
+            locked_nodes = set()
+            for w in self._with_lock_blocks(m, lock_attrs):
+                locked_nodes |= set(ast.walk(w))
+            for sub in ast.walk(m):
+                if not isinstance(sub, ast.Call) or sub in locked_nodes:
+                    continue
+                name = dotted(sub.func)
+                if name is not None and name.startswith("self.") and \
+                        name.split(".", 1)[1] in locked_helpers:
+                    yield mod.finding(
+                        "GL002", sub,
+                        f"'{cls.name}.{name.split('.', 1)[1]}' is a "
+                        f"'_locked'-contract helper but '{m.name}' "
+                        f"calls it without holding "
+                        f"'self.{self._guard_name(cls, lock_attrs)}'",
                     )
 
     @staticmethod

@@ -58,6 +58,12 @@ _BROAD = {"Exception", "BaseException"}
 #: the sharded sockets and the retraction commit — a swallowed error
 #: there silently forks the summaries from the surviving multiset, so
 #: broad handlers must count ``eventtime.swallowed{site}`` or re-raise.
+#: ISSUE 19 adds the reshard store: the split-plan/addr reads and the
+#: watcher's poll thread are the ONLY witnesses of a torn or
+#: undecodable ownership record — a swallowed error there strands a
+#: router on a stale epoch with no counted evidence, so broad handlers
+#: must count (``reshard.swallowed{site}`` / ``record_rejection``) or
+#: re-raise.
 THREADED_SOCKET_MODULES = (
     "serving/rpc.py",
     "serving/client.py",
@@ -65,6 +71,7 @@ THREADED_SOCKET_MODULES = (
     "core/ingest.py",
     "fabric/exchange.py",
     "eventtime/stream.py",
+    "serving/reshard.py",
 )
 
 #: calls that count as "left registry evidence": instrument factories
