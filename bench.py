@@ -3240,6 +3240,9 @@ def main():
         # every phase, zero post-split oracle mismatches, a trace
         # joining client -> surviving router -> both post-split shards,
         # and no admission knob reverting more than once per phase.
+        # ISSUE 20 adds the transactional lane: snapshot-pinned
+        # multi-read txns spanning KILL/PROMOTE/SPLIT with zero
+        # consistency violations (honest typed expiries only).
         import tempfile
 
         from gelly_streaming_tpu.resilience.chaos import (
@@ -3288,7 +3291,9 @@ def main():
             f"adopted={doc['storm']['split_adopted']} "
             f"oracle_mismatches={doc['oracle']['mismatches']} "
             f"retune_moves={doc['retune']['total_moves']} "
-            f"worst_reverts={doc['retune']['worst_reverts_per_phase']}")
+            f"worst_reverts={doc['retune']['worst_reverts_per_phase']} "
+            f"txn_committed={doc['txn']['committed']} "
+            f"txn_violations={doc['txn']['violations']}")
         print(json.dumps({
             "metric": "storm_client_failures",
             "value": doc["load_total"]["failures"],
@@ -3303,6 +3308,11 @@ def main():
             "joined_trace": doc["trace"]["joined_trace"],
             "retune_moves": doc["retune"]["total_moves"],
             "worst_reverts": doc["retune"]["worst_reverts_per_phase"],
+            "txn_committed": doc["txn"]["committed"],
+            "txn_expired": doc["txn"]["expired"],
+            "txn_violations": doc["txn"]["violations"],
+            "txn_spanning": doc["txn"]["spanning"],
+            "txn_zero_violations": doc["txn"]["zero_violations"],
             "ok": doc["ok"],
             "artifact": artifact,
             "obs_log": obs_log if artifact else None,

@@ -137,6 +137,20 @@ STORY = {
     "eventtime.pane_close": "PANE-CLOSE",
     "eventtime.retract": "RETRACT",
     "eventtime.late_dropped": "LATE-DROP",
+    # the transaction story (ISSUE 20): each snapshot-pinned
+    # transaction's begin, every read answered AT a pinned version,
+    # and every honest expiry — the ring slid
+    # (txn.snapshot_expired{reason}), a promoted standby's mirror
+    # missing the pin (txn.failover_expired), or a txn-unaware peer
+    # detected from its reply stamp — so a storm run renders
+    # TXN-BEGIN / TXN-READ / KILL / PROMOTE / TXN-READ (the survivor
+    # answering the same pin) or TXN-EXPIRED, never a silently
+    # fresher answer
+    "txn.begin": "TXN-BEGIN",
+    "txn.pinned_reads": "TXN-READ",
+    "txn.snapshot_expired": "TXN-EXPIRED",
+    "txn.failover_expired": "TXN-EXPIRED",
+    "txn.unaware_peer": "TXN-EXPIRED",
     "flight": "BLACKBOX",
 }
 

@@ -2159,6 +2159,13 @@ def run_storm_scenario(
     host fold of the whole stream), at least one trace joining client
     -> surviving router -> BOTH post-split shards, promotion + adoption
     evidence in the shipped event streams, and the revert bound above.
+
+    ISSUE 20 adds a TRANSACTIONAL lane: a client thread running
+    snapshot-pinned multi-read transactions (:class:`~.txn.TxnContext`)
+    through every phase. Gate: zero repeated-read / oracle violations,
+    at least one committed transaction spanning each of KILL, PROMOTE,
+    and SPLIT, and no lane failures other than typed, counted
+    :class:`~.txn.TxnSnapshotExpired` honest expiries.
     """
     import threading
 
@@ -2330,11 +2337,79 @@ def run_storm_scenario(
             finally:
                 cl.close()
 
+        # ---- the transactional lane (ISSUE 20): snapshot-pinned
+        # multi-read transactions riding the same storm. Each txn pins
+        # a per-shard snapshot vector from its first reads, re-reads
+        # the same keys, and commits only if every repeat is BYTE-
+        # IDENTICAL (value, version, boot lineage) and matches the
+        # single-host oracle. A TxnSnapshotExpired is an HONEST
+        # failure (typed, counted, never a silently fresher answer);
+        # anything else is a driver error that fails the gate -------- #
+        from ..serving.txn import TxnContext, TxnSnapshotExpired
+
+        tlock = threading.Lock()
+        txn_recs: list = []   # (wall_t0, wall_t1, committed)
+        tstats = {"txns": 0, "committed": 0, "expired": 0,
+                  "violations": 0, "reads": 0}
+        texp_kinds: dict = {}
+        terrs: list = []
+
+        def txn_drive() -> None:
+            cl = RpcClient(fleet, seed=seed + 500, start_index=1)
+            rng = np.random.default_rng(seed + 500)
+            try:
+                while not stop.is_set():
+                    w0 = time.time()
+                    committed = False
+                    expired = False
+                    viol = 0
+                    reads = 0
+                    try:
+                        t = TxnContext(deadline_s=90.0)
+                        ks = [int(v) for v in zipf_keys(rng, 4)]
+                        first = [cl.ask(DegreeQuery(k), timeout=90,
+                                        txn=t) for k in ks]
+                        again = [cl.ask(DegreeQuery(k), timeout=90,
+                                        txn=t) for k in ks]
+                        reads = len(first) + len(again)
+                        for a, b in zip(first, again):
+                            if (a.value, a.version, a.boot) != \
+                                    (b.value, b.version, b.boot):
+                                viol += 1
+                        for k, a in zip(ks, first):
+                            if int(a.value) != int(odeg[k]):
+                                viol += 1
+                        committed = True
+                    except TxnSnapshotExpired as e:
+                        expired = True
+                        with tlock:
+                            texp_kinds[e.kind] = \
+                                texp_kinds.get(e.kind, 0) + 1
+                    except BaseException as e:
+                        with tlock:
+                            if len(terrs) < 5:
+                                terrs.append(repr(e)[:200])
+                    with tlock:
+                        tstats["txns"] += 1
+                        tstats["committed"] += int(committed)
+                        tstats["expired"] += int(expired)
+                        tstats["violations"] += viol
+                        tstats["reads"] += reads
+                        txn_recs.append((w0, time.time(), committed))
+                    time.sleep(0.002)
+            except BaseException as e:
+                # same contract as storm_drive: a dead transactional
+                # lane must not let its gates pass vacuously
+                with tlock:
+                    terrs.append(f"txn_driver: {e!r:.300}")
+            finally:
+                cl.close()
+
         threads = [
             threading.Thread(target=storm_drive, args=(i,),
                              daemon=True)
             for i in range(clients)
-        ]
+        ] + [threading.Thread(target=txn_drive, daemon=True)]
         phases.append(("steady", time.time()))
         for t in threads:
             t.start()
@@ -2451,6 +2526,50 @@ def run_storm_scenario(
             # in the min: direction (a fresh 0 regresses, 1 passes)
             "zero_failures": int(total_failures == 0 and not errs),
         }
+
+        # ---- transactional-lane accounting (ISSUE 20) ---------------- #
+        with tlock:
+            trecs = list(txn_recs)
+            tstat = dict(tstats)
+            texp = dict(texp_kinds)
+            terr = list(terrs)
+        spanning: dict = {}
+        for name in ("kill_router", "kill_shard", "split"):
+            i = next(i for i, (n, _t) in enumerate(walls)
+                     if n == name)
+            t0w, t1w = walls[i][1], walls[i + 1][1]
+            # a txn SPANS the phase when its begin..commit interval
+            # overlaps the phase window — only COMMITTED txns count
+            # (an expired one proved honesty, not survival)
+            spanning[name] = int(sum(
+                1 for w0, w1, c in trecs
+                if c and w0 < t1w and w1 > t0w))
+        twall = ((max(r[1] for r in trecs) - min(r[0] for r in trecs))
+                 if trecs else 0.0)
+        # the committed 1/0 indicator benchguard watches min:-style —
+        # zero repeated-read/oracle violations, no lane deaths, and at
+        # least one committed txn spanning EACH chaos phase
+        tzero = int(
+            tstat["violations"] == 0 and not terr
+            and all(v >= 1 for v in spanning.values())
+        )
+        doc["txn"] = {
+            "txns": tstat["txns"],
+            "committed": tstat["committed"],
+            "expired": tstat["expired"],
+            "expired_kinds": texp,
+            "violations": tstat["violations"],
+            "reads": tstat["reads"],
+            "driver_errors": terr,
+            "spanning": spanning,
+            "qps": (round(tstat["reads"] / twall, 1)
+                    if twall > 0 else None),
+            "zero_violations": tzero,
+        }
+        say(f"storm: txn lane {tstat['txns']} txns "
+            f"({tstat['committed']} committed, "
+            f"{tstat['expired']} expired honestly), "
+            f"violations={tstat['violations']}, spanning={spanning}")
 
         # ---- convergence + the joined trace -------------------------- #
         # both post-split shards must serve the FULL shard-1 stream
@@ -2600,6 +2719,7 @@ def run_storm_scenario(
             and doc["oracle"]["mismatches"] == 0
             and doc["trace"]["joined_trace"] is not None
             and worst_reverts <= 1
+            and doc["txn"]["zero_violations"] == 1
         )
         doc["ok"] = bool(ok)
         doc["note"] = (
@@ -2620,7 +2740,12 @@ def run_storm_scenario(
             "deadline so the admission tuners judge waits against "
             "target_wait_s; the shed floor sits far above the "
             "closed-loop pending depth, so knobs move but shedding "
-            "never manufactures a failure."
+            "never manufactures a failure. A transactional lane "
+            "(ISSUE 20) runs snapshot-pinned multi-read transactions "
+            "through the same storm: at least one committed txn spans "
+            "each of KILL, PROMOTE, and SPLIT with zero repeated-read "
+            "or oracle violations — the only permitted failures are "
+            "typed, counted TxnSnapshotExpired honesty."
         )
         if not ok:
             doc["reason"] = (
@@ -2630,7 +2755,11 @@ def run_storm_scenario(
                 f"split_events={doc['storm']['split_events']}, "
                 f"oracle={doc['oracle']['mismatches']}, "
                 f"trace={doc['trace']['joined_trace']}, "
-                f"worst_reverts={worst_reverts}"
+                f"worst_reverts={worst_reverts}, "
+                f"txn={doc['txn']['zero_violations']} "
+                f"(violations={doc['txn']['violations']}, "
+                f"spanning={doc['txn']['spanning']}, "
+                f"errs={doc['txn']['driver_errors']})"
             )
         say(f"storm: ok={ok} failures={total_failures} "
             f"promoted={promoted} adopted={adopted} "

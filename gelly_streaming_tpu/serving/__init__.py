@@ -39,6 +39,13 @@ stack:
   dense actionable-prefix rule that defines the live ownership
   epoch, and the :class:`~.reshard.ReshardWatcher` replicas and
   routers adopt it through.
+- :mod:`txn` — snapshot-pinned read transactions (ISSUE 20): a
+  :class:`~.txn.TxnContext` pins a per-shard ``{shard: (version,
+  boot)}`` vector from ordinary reply stamps, every later read is
+  answered AT the pinned snapshot or raises the typed, counted
+  :class:`~.txn.TxnSnapshotExpired` — never a silently fresher
+  answer — and non-transactional sessions get monotonic reads via
+  the client's per-shard version floor.
 
 Workloads opt in via a small ``servable()`` adapter
 (``library/connected_components.py``, ``library/degrees.py``,
@@ -69,6 +76,7 @@ from .snapshot_store import (
     follow_snapshots,
 )
 from .stats import ServingStats
+from .txn import TxnContext, TxnSnapshotExpired
 
 #: PEP 562 lazy exports: the RPC modules are runnable CLIs
 #: (``python -m gelly_streaming_tpu.serving.rpc --smoke``), and an
@@ -123,5 +131,7 @@ __all__ = [
     "SnapshotStore",
     "StreamServer",
     "SummaryPullQuery",
+    "TxnContext",
+    "TxnSnapshotExpired",
     "follow_snapshots",
 ]

@@ -67,11 +67,32 @@ from .rpc import (
     pack_frame,
 )
 from .server import Overloaded, Shed
+from .txn import TxnContext, TxnSnapshotExpired
 
 
 class RpcError(RuntimeError):
     """Terminal wire-level failure (server error / bad request / spent
     routing budget). Never retried by the client."""
+
+
+def _sent_pin(sent, shard: int):
+    """The ``(version, boot)`` pin the batch's LAST send carried for
+    ``shard`` (from its recorded wire txn field), or None when that
+    shard was unpinned at send time — the distinction that tells "the
+    peer ignored my pin" (honest typed failure) from "this answer is
+    doing the pinning" (observe it)."""
+    if not sent:
+        return None
+    p = sent.get("pin")
+    if p is not None:
+        return int(p[0]), str(p[1]) if len(p) > 1 else ""
+    vec = sent.get("vec")
+    if not vec:
+        return None
+    q = vec.get(str(int(shard)))
+    if q is None:
+        return None
+    return int(q[0]), str(q[1]) if len(q) > 1 else ""
 
 
 class _Batch:
@@ -86,7 +107,8 @@ class _Batch:
 
     __slots__ = ("id", "enc", "futures", "deadline_abs",
                  "attempts", "routes", "ctx", "parent_sid",
-                 "t0", "t_send", "t_resp")
+                 "t0", "t_send", "t_resp",
+                 "txn_ctx", "txn_doc", "txn_sent", "reasks")
 
     def __init__(self, qid: str, enc: list, futures: list,
                  deadline_abs: Optional[float]):
@@ -101,6 +123,10 @@ class _Batch:
         self.t0 = 0.0       # perf_counter at submit (e2e measurement)
         self.t_send = 0.0   # perf_counter at the LAST send attempt
         self.t_resp = 0.0   # perf_counter when the RESP frame arrived
+        self.txn_ctx = None   # TxnContext riding this batch (ISSUE 20)
+        self.txn_doc = None   # raw wire txn dict (router sub-requests)
+        self.txn_sent = None  # the txn field the LAST send carried
+        self.reasks = 0       # floor-regression fresh-id re-asks
 
     def remaining_s(self) -> Optional[float]:
         if self.deadline_abs is None:
@@ -125,6 +151,12 @@ class RpcClient:
     #: not_primary re-ask backoff shape (a standby mid-promotion)
     ROUTE_BASE_S = 0.02
     ROUTE_MAX_S = 0.25
+    #: monotonic-floor regression re-asks (fresh id each — the old id
+    #: would replay the server's CACHED stale answer) before the typed
+    #: failure; backoff shape for the staler survivor to catch up
+    FLOOR_REASKS = 6
+    FLOOR_BASE_S = 0.02
+    FLOOR_MAX_S = 0.25
 
     def __init__(
         self,
@@ -169,6 +201,14 @@ class RpcClient:
         # fleet reads this off its shard clients to learn of a live
         # split from ordinary traffic — serving/reshard.py)
         self.epoch_observed = 0
+        # monotonic-read floor: highest (version, boot) answered per
+        # shard. Every later non-pinned answer from the same lineage
+        # must be >= it — a resubmit that lands on a staler survivor is
+        # DETECTED here (counted rpc.client_regressions) and re-asked
+        # under a fresh id, never delivered as silent time travel.
+        # Mutated only on the io thread (_settle_ok); boot "" answers
+        # (router-merged, no single lineage) are excluded.
+        self._vfloor: dict = {}
         self._closing = threading.Event()
         self._counter = itertools.count()
         self._id_prefix = f"{os.getpid():x}.{os.urandom(3).hex()}"
@@ -203,6 +243,7 @@ class RpcClient:
         *,
         deadline_s: Optional[float] = None,
         ctx=None,
+        txn=None,
     ) -> List["Future[Answer]"]:
         """Send one query batch; one future per query. ``deadline_s``
         bounds each query's TOTAL budget — network, retries, reconnects,
@@ -214,17 +255,40 @@ class RpcClient:
         :class:`~gelly_streaming_tpu.obs.trace.TraceContext` to join:
         the batch stays on that trace id and its root span parents to
         ``ctx.parent_sid`` — the hop a fan-out router makes so client,
-        router, and shard spans form one causal tree."""
+        router, and shard spans form one causal tree.
+
+        ``txn`` (ISSUE 20) is a
+        :class:`~gelly_streaming_tpu.serving.txn.TxnContext` (or a
+        pre-encoded wire txn dict, the router's per-shard form): the
+        batch rides the transaction's pinned vector on every send and
+        observes OK answers back into the context; a pinned read is
+        answered at the pinned snapshot or fails
+        :class:`TxnSnapshotExpired` — never silently fresher."""
         if self._closing.is_set():
             raise RuntimeError("rpc client is closed")
         enc = encode_queries(queries)
         qid = f"{self._id_prefix}-{next(self._counter)}"
         futures: List["Future[Answer]"] = [Future() for _ in queries]
+        tctx = tdoc = None
+        if txn is not None:
+            if isinstance(txn, TxnContext):
+                tctx = txn
+                # GL008: the transaction's ONE deadline budget bounds
+                # every read issued under it — a batch never grants
+                # itself more clock than the transaction has left
+                rem = tctx.remaining_s()
+                if rem is not None:
+                    deadline_s = rem if deadline_s is None \
+                        else min(float(deadline_s), rem)
+            else:
+                tdoc = dict(txn)
         deadline_abs = (
             None if deadline_s is None
             else time.monotonic() + float(deadline_s)
         )
         batch = _Batch(qid, enc, futures, deadline_abs)
+        batch.txn_ctx = tctx
+        batch.txn_doc = tdoc
         batch.t0 = time.perf_counter()
         if _trace.on():
             # mint ONE context per batch; its parent sid is reserved
@@ -258,9 +322,9 @@ class RpcClient:
 
     def submit(self, query: Query, *,
                deadline_s: Optional[float] = None,
-               ctx=None) -> "Future[Answer]":
+               ctx=None, txn=None) -> "Future[Answer]":
         return self.submit_batch(
-            [query], deadline_s=deadline_s, ctx=ctx
+            [query], deadline_s=deadline_s, ctx=ctx, txn=txn
         )[0]
 
     def ask_batch(
@@ -269,8 +333,11 @@ class RpcClient:
         *,
         deadline_s: Optional[float] = None,
         timeout: Optional[float] = None,
+        txn=None,
     ) -> List[Answer]:
-        futures = self.submit_batch(queries, deadline_s=deadline_s)
+        futures = self.submit_batch(
+            queries, deadline_s=deadline_s, txn=txn
+        )
         # `timeout` bounds the WHOLE batch wait (GL008): each result()
         # spends what remains of one budget — N sequential waits of
         # the full timeout would wait N× what the caller asked for
@@ -285,8 +352,10 @@ class RpcClient:
         return out
 
     def ask(self, query: Query, timeout: Optional[float] = None,
-            deadline_s: Optional[float] = None) -> Answer:
-        return self.submit(query, deadline_s=deadline_s).result(timeout)
+            deadline_s: Optional[float] = None, txn=None) -> Answer:
+        return self.submit(
+            query, deadline_s=deadline_s, txn=txn
+        ).result(timeout)
 
     def pending(self) -> int:
         with self._lock:
@@ -322,6 +391,7 @@ class RpcClient:
             "resubmitted": _count("rpc.client_resubmitted"),
             "retries": _count("rpc.client_retries"),
             "reroutes": _count("rpc.client_reroutes"),
+            "regressions": _count("rpc.client_regressions"),
             "sweeper_expired": _count("rpc.client_sweeper_expired"),
             "deadline_expired": _count("rpc.client_deadline_expired"),
             "wire_ms": {
@@ -349,6 +419,17 @@ class RpcClient:
             # resubmit after an outage must not grant the server a
             # fresh full deadline the client no longer has
             doc["deadline_s"] = max(0.001, remaining)
+        if batch.txn_ctx is not None:
+            # the vector is re-read at EVERY send (first, retry,
+            # reconnect resubmit): pins acquired since the last send
+            # ride too, and txn_sent records exactly what THIS send
+            # carried — the settle path compares the answer stamp
+            # against it to detect a peer that ignored the pin
+            batch.txn_sent = batch.txn_ctx.wire_doc()
+            doc["txn"] = batch.txn_sent
+        elif batch.txn_doc is not None:
+            batch.txn_sent = batch.txn_doc
+            doc["txn"] = batch.txn_doc
         if _trace.on() and batch.ctx is not None:
             doc["tc"] = batch.ctx.to_wire()
         batch.t_send = time.perf_counter()
@@ -598,6 +679,14 @@ class RpcClient:
             for f in batch.futures:
                 self._set_exc(f, err)
             return
+        # monotonic-floor regression scan BEFORE delivery: a resubmit
+        # that landed on a staler survivor must not answer BEHIND an
+        # already-delivered answer — re-ask under a FRESH id (the old
+        # id would replay the server's cached stale RESP) while the
+        # survivor catches up, typed failure when the budget is spent
+        floor_fail = self._regressed(batch, answers)
+        if floor_fail is None:
+            return  # re-asked; the batch is pending again
         # per-batch wire latency (submit -> answered), always recorded:
         # client-side latency parity with the server's ServingStats.
         # The exemplar (tracing only) links this histogram's tail to a
@@ -629,19 +718,62 @@ class RpcClient:
                            max(0.0, now - batch.t_resp)
                            if batch.t_resp > 0.0 else 0.0, 6)},
             )
-        for f, a in zip(batch.futures, answers):
+        for i, (f, a) in enumerate(zip(batch.futures, answers)):
             try:
                 if a[0] == "ok":
-                    self._set_res(f, Answer(
+                    ans = Answer(
                         value=a[1], window=int(a[2]),
                         watermark=int(a[3]), staleness=int(a[4]),
                         # the snapshot version rides newer servers'
                         # replies (cache-invalidation key); absent on a
                         # v1 peer's answers, which read as version 0.
                         # the event-time watermark stamp follows it —
-                        # absent reads as -1, "no event time"
+                        # absent reads as -1, "no event time"; the
+                        # shard + boot-lineage stamps after THAT are
+                        # what a transaction pins from (ISSUE 20)
                         version=int(a[5]) if len(a) > 5 else 0,
                         event_ts=int(a[6]) if len(a) > 6 else -1,
+                        shard=int(a[7]) if len(a) > 7 else -1,
+                        boot=str(a[8]) if len(a) > 8 else "",
+                    )
+                    pin = _sent_pin(batch.txn_sent, ans.shard)
+                    if pin is not None and \
+                            (ans.version, ans.boot) != pin:
+                        # the peer ignored the pin (a v1 txn-unaware
+                        # server, or a stripped tag): DETECTED from
+                        # the reply stamp and failed honestly — the
+                        # transaction is never quietly handed this
+                        # fresher (or older) answer
+                        get_registry().counter(
+                            "txn.unaware_peer"
+                        ).inc()
+                        self._set_exc(f, TxnSnapshotExpired(
+                            f"pinned read (v{pin[0]}) answered at "
+                            f"v{ans.version} by a txn-unaware peer",
+                            kind="unaware_peer",
+                        ))
+                        continue
+                    if i in floor_fail:
+                        self._set_exc(f, RpcError(
+                            f"monotonic read violated: shard "
+                            f"{ans.shard} answered v{ans.version} "
+                            f"behind the delivered floor "
+                            f"(re-ask budget spent)"
+                        ))
+                        continue
+                    if pin is None:
+                        if batch.txn_ctx is not None:
+                            batch.txn_ctx.observe(ans)
+                        self._floor_note(
+                            ans.shard, ans.version, ans.boot)
+                    self._set_res(f, ans)
+                elif a[0] == "txn_expired":
+                    # typed honest expiry from the server's pinned
+                    # answer path — re-raised per answer, counted at
+                    # the server's raise site
+                    self._set_exc(f, TxnSnapshotExpired(
+                        str(a[1]),
+                        kind=str(a[2]) if len(a) > 2 else "expired",
                     ))
                 elif a[0] == "deadline":
                     # a SERVER-reported expiry (the answer rode a RESP
@@ -659,6 +791,62 @@ class RpcClient:
                     "rpc.malformed", kind="answer"
                 ).inc()
                 self._set_exc(f, RpcError(f"malformed answer {a!r:.120}"))
+
+    def _regressed(self, batch: _Batch, answers):
+        """Floor-regression scan over a decoded OK payload.
+
+        Returns the set of answer indices that must fail typed (re-ask
+        budget spent), an empty set when nothing regressed, or None
+        when the whole batch was RE-ASKED under a fresh id (satellite
+        1: the resubmit-behind-the-floor bug). Pinned answers are
+        exempt — a pin is exact-match checked at settle, not
+        floor-checked. Runs on the io thread only (like _vfloor)."""
+        hit = set()
+        for i, a in enumerate(answers):
+            try:
+                if not (isinstance(a, list) and a and a[0] == "ok"
+                        and len(a) > 8):
+                    continue
+                shard = int(a[7])
+                boot = str(a[8])
+                version = int(a[5])
+            except (IndexError, TypeError, ValueError):
+                continue  # the settle loop reports malformed answers
+            if not boot or version <= 0:
+                continue  # unstamped/merged answers carry no lineage
+            if _sent_pin(batch.txn_sent, shard) is not None:
+                continue
+            fl = self._vfloor.get(shard)
+            if fl is not None and fl[1] == boot and version < fl[0]:
+                hit.add(i)
+        if not hit:
+            return hit
+        get_registry().counter("rpc.client_regressions").inc()
+        if batch.reasks >= self.FLOOR_REASKS:
+            return hit  # typed failure at settle, never time travel
+        batch.reasks += 1
+        batch.id = f"{self._id_prefix}-{next(self._counter)}"
+        with self._lock:
+            self._pending[batch.id] = batch
+        delay = jittered(
+            exp_backoff(batch.reasks - 1, self.FLOOR_BASE_S,
+                        self.FLOOR_MAX_S),
+            0.5, self.seed, batch.reasks,
+        )
+        remaining = batch.remaining_s()
+        if remaining is not None:
+            delay = min(delay, max(0.001, remaining))
+        self._schedule_resend(batch, delay)
+        return None
+
+    def _floor_note(self, shard: int, version: int, boot: str) -> None:
+        """Advance the monotonic floor from one DELIVERED answer; a
+        boot change is a new lineage and resets the shard's floor."""
+        if not boot or version <= 0:
+            return
+        fl = self._vfloor.get(shard)
+        if fl is None or fl[1] != boot or version > fl[0]:
+            self._vfloor[shard] = (version, boot)
 
     def _fail(self, batch: _Batch, exc: BaseException) -> None:
         with self._lock:

@@ -152,7 +152,14 @@ class Answer:
     ``event_ts`` is the snapshot's EVENT-TIME watermark (``-1`` when
     the pipeline carries no event time): next to ``staleness``'s
     windows-behind-head, it answers "how far behind the world" — the
-    data's own clock at the moment the served summaries were true."""
+    data's own clock at the moment the served summaries were true.
+    ``shard`` and ``boot`` (ISSUE 20) complete the stamp a
+    snapshot-pinned transaction needs: which shard answered (``-1``
+    for an unsharded replica; the router re-stamps its fan-outs) and
+    the answering store's lineage nonce — together with ``version``
+    they are the ``(shard, version, boot)`` triple a
+    :class:`~gelly_streaming_tpu.serving.txn.TxnContext` pins from
+    ordinary replies, with no extra round trip."""
 
     value: Any
     window: int
@@ -160,6 +167,8 @@ class Answer:
     staleness: int
     version: int = 0
     event_ts: int = -1
+    shard: int = -1
+    boot: str = ""
 
 
 # --------------------------------------------------------------------- #
@@ -390,6 +399,9 @@ class QueryEngine:
         # different baselines share one engine without thrashing
         self._pull_key: Optional[tuple] = None
         self._pull_docs: dict = {}
+        # historical (pinned) pull docs: a bounded side cache so
+        # transactional merges never thrash the live head's cache
+        self._hist_docs: dict = {}
         self._bp_cache: Tuple[Optional[tuple], Optional[dict]] = (
             None, None,
         )
@@ -518,9 +530,26 @@ class QueryEngine:
         stream is add-only: a ``(vertex, root)`` pair once true is a
         connectivity fact forever. Docs are cached per
         ``(epoch, version, since)`` — the O(vcap) canonicalize + decode
-        runs once however many routers pull."""
+        runs once however many routers pull.
+
+        A pull against a snapshot BEHIND the chain head (a pinned
+        transactional read from the retention ring, ISSUE 20) takes a
+        read-only historical path: advancing the chain to an older
+        version would CLEAR the ring (the backward-version reset), so
+        the live chain is never touched — the historical version is
+        served from the covering ring segments when they reach it,
+        else from a full canonicalization of that snapshot's own
+        payload (the ring retains payloads, so the table is right
+        there)."""
         with self._chain_lock:
             key = (snap.epoch, snap.version)
+            if (
+                self._chain_lab is not None
+                and self._chain_epoch == snap.epoch
+                and snap.version < self._chain_version
+            ):
+                return self._historical_pull_locked(
+                    snap, int(since_version))
             if self._pull_key != key:
                 self._advance_chain_locked(snap)
                 self._pull_key = key
@@ -594,6 +623,58 @@ class QueryEngine:
         self._chain_version = snap.version
         self._chain_lab = np.array(lab, copy=True)
         self._chain_n = n
+
+    def _historical_pull_locked(
+        self, snap: PublishedSnapshot, since: int
+    ) -> dict:
+        """Serve a pull pinned at a version BEHIND the chain head
+        without touching the live chain (see :meth:`summary_pull`).
+        Delta when the ring's consecutive segments span exactly
+        ``(since, snap.version]``; else a full table canonicalized
+        from the historical snapshot's own payload, tagged
+        ``why="pinned"`` (or ``"ahead"`` for a baseline past the pin).
+        Cached per ``(epoch, version, since)`` in a small side cache so
+        a transaction's repeated merges cost one canonicalization."""
+        eff = since if since >= 0 else -1
+        hkey = (snap.epoch, snap.version, eff)
+        cached = self._hist_docs.get(hkey)
+        if cached is not None:
+            return cached
+        doc = None
+        why = "pinned"
+        if eff == snap.version:
+            empty = np.zeros(0, np.int64)
+            doc = encode_pull_doc(empty, empty, kind="delta", base=eff)
+        elif eff > snap.version:
+            why = "ahead"
+        elif eff >= 0:
+            segs = [s for s in self._ring
+                    if eff < s["to"] <= snap.version]
+            if (segs and segs[0]["base"] <= eff
+                    and segs[-1]["to"] == snap.version):
+                ru = np.concatenate([s["u"] for s in reversed(segs)])
+                rr = np.concatenate([s["r"] for s in reversed(segs)])
+                _, idx = np.unique(ru, return_index=True)
+                doc = encode_pull_doc(
+                    ru[idx], rr[idx], kind="delta", base=eff)
+        if doc is None:
+            from ..summaries.forest import resolve_flat_host
+
+            # straight off the historical payload — NOT via _table's
+            # single-slot host cache, which must stay hot for the head
+            canon = np.asarray(snap.payload["labels"])
+            vdict = snap.payload["vdict"]
+            lab = resolve_flat_host(canon)
+            n = min(int(lab.shape[0]), len(vdict))
+            slots = np.arange(n, dtype=np.int64)
+            raws = np.asarray(vdict.decode(slots), np.int64)
+            roots = np.asarray(
+                vdict.decode(lab[:n].astype(np.int64)), np.int64)
+            doc = encode_pull_doc(raws, roots, kind="full", why=why)
+        while len(self._hist_docs) >= 8:
+            self._hist_docs.pop(next(iter(self._hist_docs)))
+        self._hist_docs[hkey] = doc
+        return doc
 
     def _build_pull_doc(self, snap: PublishedSnapshot, since: int) -> dict:
         vdict = snap.payload["vdict"]
@@ -796,6 +877,7 @@ class QueryEngine:
                         value=doc, window=snap.window,
                         watermark=snap.watermark, staleness=staleness,
                         version=snap.version, event_ts=snap.event_ts,
+                        boot=getattr(snap, "boot", ""),
                     )
                 continue
             if qcls is ConnectedQuery:
@@ -815,6 +897,7 @@ class QueryEngine:
                     value=v, window=snap.window,
                     watermark=snap.watermark, staleness=staleness,
                     version=snap.version, event_ts=snap.event_ts,
+                    boot=getattr(snap, "boot", ""),
                 )
         return out  # type: ignore[return-value]
 

@@ -57,6 +57,7 @@ front end over the fan-out), the shape the sharded bench deploys.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 import time
@@ -83,10 +84,20 @@ from .query import (
     decode_pull_doc,
 )
 from .server import Overloaded
+from .txn import TxnSnapshotExpired
 
 #: hot-key LRU capacity default (answers, not bytes: each entry is one
 #: Answer + a version stamp)
 DEFAULT_CACHE_CAP = 8192
+
+#: pinned merged-forest LRU (ISSUE 20): one carried cross-shard forest
+#: per distinct transaction pin vector — transactions are short-lived,
+#: so a handful of concurrently-pinned vectors covers the working set
+PINNED_MERGED_CAP = 4
+
+#: fallback wall bound for a pinned CC gather when every requester is
+#: deadline-less — the worker must never block forever on a dead shard
+PINNED_PULL_TIMEOUT_S = 30.0
 
 #: query classes the router serves (fan-out or merged-forest path)
 ROUTED_CLASSES = (
@@ -118,11 +129,16 @@ def decode_pull(doc: dict) -> dict:
 
 
 class _Entry:
-    """One admitted query riding the router's pending queue."""
+    """One admitted query riding the router's pending queue. ``txn``
+    is the decoded transaction dict (``{"id", "pin", "vec"}``) the
+    entry rides under, None outside a transaction; ``pin`` is the
+    ``(version, boot)`` the fan-out resolved for the entry's routed
+    shard (split-ancestry walk included), None for unpinned."""
 
-    __slots__ = ("q", "f", "t0", "dl", "ctx", "grp", "key", "done")
+    __slots__ = ("q", "f", "t0", "dl", "ctx", "grp", "key", "done",
+                 "txn", "pin")
 
-    def __init__(self, q, f, t0, dl, ctx):
+    def __init__(self, q, f, t0, dl, ctx, txn=None):
         self.q = q
         self.f = f
         self.t0 = t0
@@ -131,6 +147,8 @@ class _Entry:
         self.grp = None
         self.key = None
         self.done = False
+        self.txn = txn
+        self.pin = None
 
 
 class _Group:
@@ -380,6 +398,10 @@ class ShardRouter:
         # (from_stamp, to_stamp, touched raw roots) per delta refresh —
         # the chain a stale cache entry revalidates against
         self._delta_hist: deque = deque(maxlen=DELTA_HIST)
+        # pinned merged forests (ISSUE 20): one carried cross-shard
+        # forest per transaction pin vector, LRU-bounded (under _mlock)
+        self._pinned_merged: "OrderedDict[tuple, _MergedCC]" = \
+            OrderedDict()
         # hot-path instruments resolved once (a cache hit should cost
         # a dict probe + a counter bump, not two registry lookups)
         reg = get_registry()
@@ -401,12 +423,16 @@ class ShardRouter:
         *,
         deadline_s: Optional[float] = None,
         ctx=None,
+        txn=None,
     ) -> "Future[Answer]":
         """Admit one query; resolves to a merged :class:`Answer`.
         Raises :class:`~.server.Overloaded` at the admission limit and
         ``TypeError`` for classes the router cannot merge. The deadline
         is a TOTAL budget pinned here: cache lookup, fan-out, shard
-        retries, and merge all spend the one clock."""
+        retries, and merge all spend the one clock. ``txn`` (ISSUE 20)
+        is the decoded transaction dict whose ``vec`` pins per-shard
+        reads — owner-routed classes are answered at the pinned
+        shard snapshot, CC classes from a pinned merged forest."""
         if not isinstance(query, ROUTED_CLASSES):
             raise TypeError(
                 f"ShardRouter routes "
@@ -417,7 +443,7 @@ class ShardRouter:
         dl = None if deadline_s is None else t0 + float(deadline_s)
         if ctx is None and _trace.on():
             ctx = _trace.current_context()
-        e = _Entry(query, Future(), t0, dl, ctx)
+        e = _Entry(query, Future(), t0, dl, ctx, txn=txn)
         with self._lock:
             if self._closing:
                 raise RuntimeError("router is closed")
@@ -438,6 +464,7 @@ class ShardRouter:
         *,
         deadline_s: Optional[float] = None,
         ctx=None,
+        txn=None,
     ) -> list:
         """Admit a whole wire batch under ONE lock acquisition (the
         RPC front end's fast path; all-or-nothing admission, like
@@ -453,7 +480,9 @@ class ShardRouter:
         dl = None if deadline_s is None else t0 + float(deadline_s)
         if ctx is None and _trace.on():
             ctx = _trace.current_context()
-        entries = [_Entry(q, Future(), t0, dl, ctx) for q in queries]
+        entries = [
+            _Entry(q, Future(), t0, dl, ctx, txn=txn) for q in queries
+        ]
         with self._lock:
             if self._closing:
                 raise RuntimeError("router is closed")
@@ -618,7 +647,25 @@ class ShardRouter:
         misses: List[_Entry] = []
         n_hits = 0
         for e in live:
-            hit = self._cache_get(e.key) if self.cache_enabled else None
+            hit = None
+            if self.cache_enabled:
+                vec = None if e.txn is None else e.txn.get("vec")
+                if vec:
+                    # pinned lookup: the cache is consulted with a
+                    # VERSION COMPARE against the pin, not bypassed —
+                    # a hit re-serves the answer only when it was
+                    # computed at exactly the pinned snapshot
+                    pin = None
+                    if isinstance(e.q, (DegreeQuery, RankQuery)):
+                        s = int(vertex_owner_epoch(
+                            np.asarray([e.q.v], np.int64),
+                            self._hash_shards, self._splits,
+                        )[0])
+                        _rs, pin = self._pin_route(vec, s)
+                    if pin is not None:
+                        hit = self._cache_get(e.key, pin=pin)
+                else:
+                    hit = self._cache_get(e.key)
             if hit is not None:
                 if e.grp is not None:
                     e.grp.hits += 1
@@ -637,14 +684,21 @@ class ShardRouter:
         reg.counter("router.fanouts").inc()
         # ---- split by path ------------------------------------------- #
         dr: List[_Entry] = []      # owner fan-out classes
-        cc: List[_Entry] = []      # merged-forest classes
+        cc: List[_Entry] = []      # merged-forest classes (fresh)
+        ccp: List[_Entry] = []     # merged-forest classes, PINNED
         for e in misses:
-            (dr if isinstance(e.q, (DegreeQuery, RankQuery))
-             else cc).append(e)
+            if isinstance(e.q, (DegreeQuery, RankQuery)):
+                dr.append(e)
+            elif e.txn is not None and e.txn.get("vec"):
+                ccp.append(e)
+            else:
+                cc.append(e)
         if dr:
             self._fan_out(dr)
         if cc:
             self._route_cc(cc)
+        if ccp:
+            self._route_cc_pinned(ccp)
 
     # ------------------------------------------------------------------ #
     # Elastic resharding: epoch adoption (worker thread only)
@@ -708,6 +762,26 @@ class ShardRouter:
     # ------------------------------------------------------------------ #
     # Degree / rank: owner fan-out
     # ------------------------------------------------------------------ #
+    def _pin_route(self, vec: dict, shard: int):
+        """``(route_shard, pin)`` for an owner-routed key under a
+        transaction vector. A pin on the owner itself routes there; an
+        unpinned CHILD of a live split walks the ancestry child→parent
+        looking for a pinned ancestor — a parent-version pin predates
+        the split, and the parent's snapshot (a superset table) is the
+        only replica that HOLDS it, so the pinned read routes to the
+        ancestor shard. No pin anywhere on the chain: unpinned."""
+        pin = vec.get(shard)
+        if pin is not None:
+            return shard, pin
+        cur = shard
+        for p in reversed(self._splits):
+            if p["child"] == cur:
+                cur = p["parent"]
+                pin = vec.get(cur)
+                if pin is not None:
+                    return cur, pin
+        return shard, None
+
     def _fan_out(self, entries: List[_Entry]) -> None:
         # ownership = boot hash + adopted split generations: the hash
         # base NEVER changes (self._hash_shards), splits move only the
@@ -716,18 +790,24 @@ class ShardRouter:
             np.asarray([e.q.v for e in entries], np.int64),
             self._hash_shards, self._splits,
         )
-        # sub-batch per (shard, trace group, has-deadline): untraced
-        # entries coalesce per shard; traced ones split per group so
-        # every shard batch stays on exactly one trace; deadline-less
-        # entries ride their own sub-batch so they neither STRIP the
-        # wire deadline from bounded peers (which would let a wedged
-        # shard hang them past their budget) nor inherit one
+        # sub-batch per (shard, trace group, has-deadline, pin):
+        # untraced entries coalesce per shard; traced ones split per
+        # group so every shard batch stays on exactly one trace;
+        # deadline-less entries ride their own sub-batch so they
+        # neither STRIP the wire deadline from bounded peers (which
+        # would let a wedged shard hang them past their budget) nor
+        # inherit one; pinned entries (ISSUE 20) sub-batch per pin so
+        # one wire txn field speaks for the whole sub-batch
         subs: dict = {}
         for e, s in zip(entries, owners.tolist()):
+            vec = None if e.txn is None else e.txn.get("vec")
+            if vec:
+                s, e.pin = self._pin_route(vec, s)
             subs.setdefault(
-                (s, id(e.grp) if e.grp else None, e.dl is None),
+                (s, id(e.grp) if e.grp else None, e.dl is None,
+                 e.pin),
                 []).append(e)
-        for (s, _gk, dl_free), es in subs.items():
+        for (s, _gk, dl_free, pin), es in subs.items():
             grp = es[0].grp
             if grp is not None:
                 grp.shards.add(s)
@@ -743,9 +823,18 @@ class ShardRouter:
                 ctx2 = _trace.TraceContext(
                     trace_id=grp.ctx.trace_id, parent_sid=grp.sid
                 )
+            txn_doc = None
+            if pin is not None:
+                # the per-owner wire form: ONE pin the shard must
+                # honor or expire honestly (serving/txn.py codec)
+                txn_doc = {
+                    "id": es[0].txn.get("id", ""),
+                    "pin": [int(pin[0]), str(pin[1])],
+                }
             try:
                 futs = self._clients[s].submit_batch(
-                    [e.q for e in es], deadline_s=remaining, ctx=ctx2
+                    [e.q for e in es], deadline_s=remaining, ctx=ctx2,
+                    txn=txn_doc,
                 )
             except BaseException as exc:
                 # a synchronously-failing shard client (closed mid-
@@ -767,12 +856,29 @@ class ShardRouter:
         up answers that already arrived from faster shards."""
         exc = fut.exception()
         if exc is not None:
-            get_registry().counter(
-                "router.shard_errors", shard=str(shard)
-            ).inc()
+            if not isinstance(exc, TxnSnapshotExpired):
+                # a typed pin expiry is the transaction's honest
+                # outcome (already counted at its raise/detect site),
+                # not a shard failure
+                get_registry().counter(
+                    "router.shard_errors", shard=str(shard)
+                ).inc()
             self._settle(e, exc=exc)
             return
         ans = fut.result()
+        if ans.shard < 0:
+            # stamp the routed shard so the client's TxnContext pins
+            # (and its monotonic floor tracks) per shard, even when
+            # the replica did not know its own index
+            ans = dataclasses.replace(ans, shard=shard)
+        if e.pin is not None:
+            # a pinned answer is deliberately OLD: it must neither
+            # seed the hot-key cache (a fresh lookup would re-serve
+            # the pinned past) nor drive _observe_version (its low
+            # version would read as a shard restart and reset the
+            # router's high-water adoption state)
+            self._settle(e, ans=ans)
+            return
         self._observe_version(shard, ans.version)
         if self.cache_enabled:
             self._cache_put(e.key, ans, (int(ans.version),),
@@ -1136,6 +1242,197 @@ class ShardRouter:
                                 roots=roots_of.get(i))
             self._settle(e, ans=ans)
 
+    # ------------------------------------------------------------------ #
+    # Connected / component size under a transaction vector (ISSUE 20)
+    # ------------------------------------------------------------------ #
+    def _route_cc_pinned(self, entries: List[_Entry]) -> None:
+        """Merged-forest classes pinned by a transaction vector.
+
+        The shared carried forest (:meth:`_route_cc`) is always-fresh
+        by design, so pinned requests build their OWN merged forest
+        from per-shard pulls issued AT the pinned versions (the pin
+        rides the pull as the per-shard wire form), kept in a small
+        LRU keyed by the vector — a repeated read inside one
+        transaction reuses the same forest object and is byte-identical
+        by construction. Shards the vector does not pin are pulled
+        fresh ONCE and baked into that forest (partial pins stay
+        self-consistent across repeats while the LRU holds the entry —
+        the documented best-effort residual). Any shard that cannot
+        serve its pin fails the whole group with the shard's own typed
+        :class:`~.txn.TxnSnapshotExpired` — never a fresher merge."""
+        groups: "OrderedDict[tuple, List[_Entry]]" = OrderedDict()
+        for e in entries:
+            vec = e.txn.get("vec") or {}
+            key = tuple(sorted(
+                (int(s), int(p[0]), str(p[1])) for s, p in vec.items()
+            ))
+            groups.setdefault(key, []).append(e)
+        for _key, es in groups.items():
+            vec = es[0].txn.get("vec") or {}
+            now = time.perf_counter()
+            dls = [e.dl for e in es if e.dl is not None]
+            remaining = max(0.001, max(dls) - now) if dls else None
+            try:
+                m = self._pinned_forest(
+                    vec, es[0].txn.get("id", ""), remaining)
+            except BaseException as exc:
+                if not isinstance(exc, TxnSnapshotExpired):
+                    get_registry().counter(
+                        "router.pinned_pull_errors").inc()
+                for e in es:
+                    self._settle(e, exc=exc)
+                continue
+            self._answer_cc_pinned(es, m)
+
+    def _pinned_forest(self, vec: dict, txn_id: str,
+                       remaining: Optional[float]) -> _MergedCC:
+        """The merged forest at one transaction vector (LRU-cached,
+        cap ``PINNED_MERGED_CAP``). Pulls run SYNCHRONOUSLY on the
+        router worker bounded by the requesters' deadlines (else
+        ``PINNED_PULL_TIMEOUT_S``) — the client io threads complete
+        the futures, so the wait cannot deadlock; a pinned refresh
+        deliberately does not share the fresh path's rendezvous
+        machinery (its state is per-vector, not per-router)."""
+        from ..summaries.forest import merge_forest_tables_host
+
+        key = tuple(sorted(
+            (int(s), int(p[0]), str(p[1])) for s, p in vec.items()
+        ))
+        with self._mlock:
+            m = self._pinned_merged.get(key)
+            if m is not None:
+                self._pinned_merged.move_to_end(key)
+                return m
+        reg = get_registry()
+        # target shards: every current shard EXCEPT a split child
+        # whose pinned ancestor is being pulled — a parent-version
+        # pin predates the split, so the parent's pinned table is a
+        # superset of the rows the child held at that version
+        targets: List[tuple] = []
+        for s in range(self.nshards):
+            rs, _pin = self._pin_route(vec, s)
+            if rs != s:
+                continue
+            targets.append((s, vec.get(s)))
+        if remaining is None:
+            remaining = PINNED_PULL_TIMEOUT_S
+        futs: List[tuple] = []
+        for s, pin in targets:
+            since, base = -1, None
+            if pin is not None and self.delta:
+                with self._mlock:
+                    pulled = self._pulled_vers[s]
+                    if (0 <= pulled < int(pin[0])
+                            and self._rows[s] is not None):
+                        # the fresh path's carried baseline PRECEDES
+                        # the pin: ask for only the rows changed since
+                        # it (the shard's ring-backed delta chain
+                        # serves historical ``since`` — the PR 17
+                        # residual this closes); copy the rows NOW,
+                        # under the lock, before the fresh path can
+                        # advance them past the baseline we claim
+                        since = pulled
+                        base = dict(self._rows[s])
+            tdoc = None
+            if pin is not None:
+                tdoc = {"id": str(txn_id),
+                        "pin": [int(pin[0]), str(pin[1])]}
+            reg.counter("router.pinned_pulls").inc()
+            try:
+                fut = self._clients[s].submit(
+                    SummaryPullQuery(since_version=since),
+                    deadline_s=remaining, txn=tdoc,
+                )
+            except BaseException as exc:
+                # deferred, not swallowed: the gather below re-raises
+                # it for the whole group (counted here so a dead
+                # client still leaves wire-side evidence)
+                reg.counter("router.swallowed",
+                            site="pinned_pull_submit").inc()
+                fut = _FailedFuture(exc)
+            futs.append((s, pin, since, base, fut))
+        cols: List[tuple] = []
+        metas: List[tuple] = []
+        vers_sum = 0
+        deadline = time.perf_counter() + remaining
+        for s, pin, since, base, fut in futs:
+            ans = fut.result(max(0.001, deadline - time.perf_counter()))
+            dec = decode_pull(ans.value)
+            if dec["kind"] == "delta":
+                if base is None or dec["base"] != since:
+                    raise MalformedPull(
+                        "base",
+                        f"pinned delta pull base {dec['base']} does "
+                        f"not match the carried baseline {since}",
+                    )
+                rows = base
+                rows.update(
+                    zip(dec["u"].tolist(), dec["r"].tolist()))
+            else:
+                rows = dict(
+                    zip(dec["u"].tolist(), dec["r"].tolist()))
+            u = np.fromiter(rows.keys(), np.int64, len(rows))
+            r = np.fromiter(rows.values(), np.int64, len(rows))
+            cols.append((u, r))
+            metas.append((int(ans.window), int(ans.watermark),
+                          int(ans.staleness), int(ans.event_ts)))
+            vers_sum += int(pin[0]) if pin is not None \
+                else max(0, int(ans.version))
+        uniq = np.unique(np.concatenate([c[0] for c in cols])) \
+            if cols else np.zeros(0, np.int64)
+        n = len(uniq)
+        tables = []
+        for u, r in cols:
+            t = np.arange(n, dtype=np.int64)
+            t[np.searchsorted(uniq, u)] = np.searchsorted(uniq, r)
+            tables.append(t)
+        lab = merge_forest_tables_host(tables)
+        sizes = np.bincount(lab, minlength=n) if n else \
+            np.zeros(0, np.int64)
+        stamped = [m[3] for m in metas if m[3] >= 0]
+        meta = (
+            min(m[0] for m in metas) if metas else -1,
+            sum(m[1] for m in metas),
+            max(m[2] for m in metas) if metas else 0,
+            vers_sum,
+            min(stamped) if stamped else -1,
+        )
+        m = _MergedCC(uniq, lab, sizes, meta, key)
+        with self._mlock:
+            self._pinned_merged[key] = m
+            self._pinned_merged.move_to_end(key)
+            while len(self._pinned_merged) > PINNED_MERGED_CAP:
+                self._pinned_merged.popitem(last=False)
+        reg.counter("router.pinned_merges").inc()
+        return m
+
+    def _answer_cc_pinned(self, entries: List[_Entry],
+                          m: _MergedCC) -> None:
+        """Answer merged-forest entries from one PINNED forest — the
+        :meth:`_answer_cc` lookup semantics, minus the cache (the
+        pinned-forest LRU is the reuse path; the router cache serves
+        fresh readers) and minus ``_mlock`` (a pinned forest is
+        immutable once built — deltas never apply to it)."""
+        window, watermark, staleness, version, event_ts = m.meta
+        for e in entries:
+            q = e.q
+            if isinstance(q, ConnectedQuery):
+                iu, fu = m.lookup(np.asarray([q.u], np.int64))
+                iv, fv = m.lookup(np.asarray([q.v], np.int64))
+                if fu[0] and fv[0]:
+                    val: object = bool(
+                        m.roots(iu)[0] == m.roots(iv)[0])
+                else:
+                    val = bool(int(q.u) == int(q.v))
+            else:
+                iv, fv = m.lookup(np.asarray([q.v], np.int64))
+                val = int(m.sizes[m.roots(iv)[0]]) if fv[0] else 0
+            self._settle(e, ans=Answer(
+                value=val, window=window, watermark=watermark,
+                staleness=staleness, version=version,
+                event_ts=event_ts,
+            ))
+
     @staticmethod
     def _lookup(uniq: np.ndarray, raw: np.ndarray
                 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -1161,10 +1458,24 @@ class ShardRouter:
                ComponentSizeQuery: "S"}[type(q)]
         return (tag, int(q.v))
 
-    def _cache_get(self, key: tuple) -> Optional[Answer]:
+    def _cache_get(self, key: tuple,
+                   pin: Optional[tuple] = None) -> Optional[Answer]:
         with self._lock:
             entry = self._cache.get(key)
             if entry is None:
+                return None
+            if pin is not None:
+                # pinned lookup (ISSUE 20): serve the entry ONLY when
+                # it was computed at exactly the pinned snapshot — an
+                # exact (version, boot) compare, never the freshness
+                # rules (a pinned hit is deliberately old and must not
+                # be invalidated for it; a mismatch is a plain miss,
+                # the fan-out answers at the pin)
+                if (entry.owner is not None
+                        and (entry.ans.version, entry.ans.boot)
+                        == (int(pin[0]), str(pin[1]))):
+                    self._cache.move_to_end(key)
+                    return entry.ans
                 return None
             if self.cache_ttl_s is not None and \
                     time.monotonic() - entry.ts > self.cache_ttl_s:
@@ -1362,7 +1673,7 @@ class _FailedFuture:
     def exception(self):
         return self._exc
 
-    def result(self):
+    def result(self, timeout: Optional[float] = None):
         raise self._exc
 
 
@@ -1498,7 +1809,8 @@ def router_main(cfg: dict) -> None:
         delta=bool(cfg.get("delta", True)),
         **kw,
     )
-    rpc = RpcServer(router, epoch=lambda: router._epoch).start()
+    rpc = RpcServer(router, epoch=lambda: router._epoch,
+                    txn_narrow=False).start()
     if cfg.get("portfile"):
         from ..resilience import integrity
 

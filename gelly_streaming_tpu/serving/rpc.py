@@ -84,6 +84,7 @@ from .snapshot_store import (
     SnapshotStore,
     follow_snapshots,
 )
+from .txn import TxnSnapshotExpired, active_txn_count, decode_txn, note_txn
 
 # --------------------------------------------------------------------- #
 # Wire format — the GSRP framing moved into the cluster fabric
@@ -238,17 +239,23 @@ def decode_queries(items) -> List[Query]:
     return out
 
 
-def encode_answer(ans: Answer) -> list:
+def encode_answer(ans: Answer, shard: Optional[int] = None) -> list:
     v = ans.value
     if hasattr(v, "item"):
         v = v.item()
     # the trailing snapshot version is what a routing tier keys its
     # hot-key cache invalidation on; the event-time watermark stamp
-    # after it says how far behind the WORLD the answer is (decoders
-    # tolerate the absence of either, so v1 peers stay interoperable —
-    # GL011: written here, read in client._settle_ok)
+    # after it says how far behind the WORLD the answer is; the shard
+    # index + boot lineage after THAT complete the reply stamp a
+    # snapshot-pinned transaction pins its vector from (ISSUE 20) —
+    # decoders tolerate the absence of any trailing field, so v1
+    # peers stay interoperable (GL011: written here, read in
+    # client._settle_ok)
+    s = int(ans.shard)
+    if s < 0 and shard is not None:
+        s = int(shard)
     return ["ok", v, ans.window, ans.watermark, ans.staleness,
-            ans.version, ans.event_ts]
+            ans.version, ans.event_ts, s, ans.boot]
 
 
 # --------------------------------------------------------------------- #
@@ -304,6 +311,8 @@ class RpcServer:
         max_frame: int = DEFAULT_MAX_FRAME,
         dedupe_cap: int = 1024,
         epoch: Optional[Callable[[], int]] = None,
+        shard: Optional[int] = None,
+        txn_narrow: bool = True,
     ):
         self.server = server
         self.host = host
@@ -313,6 +322,31 @@ class RpcServer:
         # > 0, reply frames carry the epoch so routers learn of live
         # splits from ordinary traffic, no control channel needed
         self.epoch = epoch
+        # this replica's shard index: stamps every reply answer (the
+        # pin source a TxnContext observes) and narrows an inbound txn
+        # VECTOR down to the one pin this shard must honor (ISSUE 20)
+        self.shard = None if shard is None else int(shard)
+        # False for a ROUTER front end: a router fans a txn VECTOR out
+        # across shards itself, so the decoded txn must pass through
+        # un-narrowed (narrowing here would drop a multi-shard vector
+        # on the floor — the front end has no single shard identity)
+        self.txn_narrow = bool(txn_narrow)
+        # one-time probe: does the inner server's submit path accept
+        # the txn kwarg? A server without it IS a v1 txn-unaware peer
+        # — the pin is dropped here and the CLIENT detects the unpinned
+        # answer from the reply stamp, failing the read honestly
+        import inspect
+
+        self._txn_kwarg = False
+        try:
+            target = getattr(server, "submit_many", None) \
+                or getattr(server, "submit", None)
+            if target is not None:
+                self._txn_kwarg = (
+                    "txn" in inspect.signature(target).parameters
+                )
+        except (TypeError, ValueError):
+            pass
         self.max_frame = int(max_frame)
         self.dedupe_cap = int(dedupe_cap)
         self._lock = threading.Lock()
@@ -465,8 +499,12 @@ class RpcServer:
                             parent=ctx.parent_sid,
                             attrs={"id": qid},
                         )
+                # the txn field is OPTIONAL and tolerant: absent or
+                # garbage decodes as None (unpinned request); a v1
+                # client never sends it, a v1 server never reads it
+                txn = decode_txn(doc.get("txn"))
                 self._serve_batch(conn, qid, queries, deadline_s,
-                                  ctx, t_recv, decode_s)
+                                  ctx, t_recv, decode_s, txn=txn)
         finally:
             with self._lock:
                 self._conns.discard(conn)
@@ -475,8 +513,12 @@ class RpcServer:
 
     def _serve_batch(self, conn: Wire, qid: str, queries: list,
                      deadline_s, ctx=None, t_recv: float = 0.0,
-                     decode_s: float = 0.0) -> None:
+                     decode_s: float = 0.0, txn=None) -> None:
         reg = get_registry()
+        if txn is not None:
+            note_txn(txn.get("id", ""))
+            if self.txn_narrow:
+                txn = self._narrow_txn(txn)
         with self._lock:
             cached = self._done.get(qid)
             if cached is not None:
@@ -508,14 +550,21 @@ class RpcServer:
         # whole-frame fast path); the per-query loop stays the
         # compatibility path for bare submit-only servers
         many = getattr(self.server, "submit_many", None)
+        # the txn kwarg rides only when the probe found it: a server
+        # without it is a v1 peer — the pin is DROPPED here and the
+        # client fails the unpinned answer honestly via the reply stamp
+        kw = {}
+        if txn is not None and self._txn_kwarg:
+            kw["txn"] = txn
         try:
             if many is not None:
-                futures = many(queries, deadline_s=deadline_s, ctx=ctx)
+                futures = many(queries, deadline_s=deadline_s, ctx=ctx,
+                               **kw)
             else:
                 for q in queries:
                     futures.append(
                         self.server.submit(q, deadline_s=deadline_s,
-                                           ctx=ctx)
+                                           ctx=ctx, **kw)
                     )
         except Shed as e:
             self._cancel(futures)
@@ -561,6 +610,30 @@ class RpcServer:
         reg.counter("rpc.queries").inc(len(queries))
         for i, f in enumerate(futures):
             f.add_done_callback(partial(self._one_done, batch, i))
+
+    def _narrow_txn(self, txn: dict) -> Optional[dict]:
+        """Narrow a wire txn down to THIS shard's single pin.
+
+        A router-directed sub-request already carries ``pin``; a
+        client's direct request carries the full ``vec`` — only the
+        entry for this replica's shard (or the sole entry, for an
+        unsharded deployment) applies here. A vector with no entry for
+        this shard means the transaction has not pinned it yet: the
+        request runs unpinned and the ANSWER's stamp does the pinning.
+        """
+        if txn.get("pin") is not None:
+            return txn
+        vec = txn.get("vec")
+        if not vec:
+            return None  # bare id: nothing pinned yet
+        pin = None
+        if self.shard is not None:
+            pin = vec.get(self.shard)
+        elif len(vec) == 1:
+            pin = next(iter(vec.values()))
+        if pin is None:
+            return None
+        return {"id": txn.get("id", ""), "pin": pin, "vec": None}
 
     @staticmethod
     def _cancel(futures: list) -> None:
@@ -618,8 +691,7 @@ class RpcServer:
                 },
             )
 
-    @staticmethod
-    def _encode_result(fut) -> list:
+    def _encode_result(self, fut) -> list:
         from concurrent.futures import CancelledError
 
         from ..resilience.errors import DeadlineExceeded
@@ -630,10 +702,17 @@ class RpcServer:
             return ["deadline", str(e)[:200]]
         except CancelledError:
             return ["error", "cancelled"]
+        except TxnSnapshotExpired as e:
+            # typed HONEST expiry on the wire: the client re-raises it
+            # per answer — a pinned read whose snapshot is gone fails,
+            # it is never quietly handed a fresher answer (already
+            # counted txn.snapshot_expired at the raise site)
+            return ["txn_expired", str(e)[:200],
+                    getattr(e, "kind", "expired")]
         except BaseException as e:
             get_registry().counter("rpc.answer_errors").inc()
             return ["error", repr(e)[:200]]
-        return encode_answer(ans)
+        return encode_answer(ans, shard=self.shard)
 
     # ------------------------------------------------------------------ #
     def _respond(self, conn: Wire, qid, status: str,
@@ -918,14 +997,19 @@ class ReplicaServer:
             )
         else:
             self.mirror = None
+            # carry_version: the follower mirrors the PRIMARY's version
+            # sequence and boot lineage into this store, so a standby's
+            # ring holds the same (version, boot) addresses a client's
+            # transaction pinned — promotion preserves pins (ISSUE 20)
             follower = follow_snapshots(
-                dirpath, self._stop_follow, poll_s=self._poll_s
+                dirpath, self._stop_follow, poll_s=self._poll_s,
+                carry_version=True,
             )
             self.server = StreamServer(follower, None, **server_kwargs)
             self.store = self.server.store
         self.rpc = RpcServer(
             self.server, host=host, port=port, gate=self._gate,
-            epoch=self._epoch,
+            epoch=self._epoch, shard=self.shard,
         )
 
     # ------------------------------------------------------------------ #
@@ -1085,6 +1169,11 @@ class ReplicaServer:
                 reg.counter("serving.failover", reason=reason).inc()
                 self.role = "primary"  # the gate reads this: queries flow
                 self.promoted = True
+                # pinned reads this promoted standby cannot satisfy
+                # from its mirrored ring are failover expiries from
+                # here on (txn.failover_expired) — counted differently
+                # because they tell the lost-trailing-state story
+                self.server.txn_failover = True
             # the heartbeat takeover is shared-directory file I/O:
             # committed outside _plock (GL009) so health probes and
             # close() never queue behind a disk write
@@ -1123,6 +1212,16 @@ class ReplicaServer:
             "heartbeat_age_s": self.heartbeat_age_s(),
             "rpc_port": self.rpc.port,
             "epoch": self._epoch(),
+            # the transaction probe surface (ISSUE 20): how deep the
+            # pinned-readable ring is, the OLDEST version a pin can
+            # still be answered at, and how many transactions touched
+            # this replica within the tracker TTL
+            "txn": {
+                "retention": self.store.retention,
+                "ring_depth": self.store.ring_depth(),
+                "oldest_pinned": self.store.oldest_retained(),
+                "active": active_txn_count(),
+            },
         }
         rec = HeartbeatLease.read(self.dirpath)
         if rec is not None:
@@ -1305,9 +1404,15 @@ def replica_main(cfg: dict) -> None:
 
             doc = load_newest_snapshot(cfg["dir"])
             if doc is not None:
+                # boot lineage rides the mirror: a restart-adopted
+                # snapshot keeps its ORIGINAL (version, boot) address,
+                # so an exact-version pin on it stays satisfiable (the
+                # content is identical); absent boot = old mirror =
+                # fresh lineage, pins reset honestly
                 rep.server.publish_boot(
                     doc["payload"], int(doc["watermark"]),
                     version=int(doc["version"]),
+                    boot=doc.get("boot"),
                 )
                 if cfg.get("pullring"):
                     from .query import load_pull_ring

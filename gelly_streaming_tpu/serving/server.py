@@ -40,6 +40,12 @@ from ..resilience.retry import RetryPolicy
 from .query import Answer, Query, QueryEngine
 from .snapshot_store import PublishedSnapshot, SnapshotStore
 from .stats import ServingStats
+from .txn import PinnedQuery, TxnSnapshotExpired
+
+
+def _unwrap(q):
+    """The engine-facing query behind a possibly-pinned entry."""
+    return q.q if isinstance(q, PinnedQuery) else q
 
 
 class Overloaded(RuntimeError):
@@ -194,21 +200,29 @@ class StreamServer:
         self._window = -1  # last published live window
         self._ingest_thread: Optional[threading.Thread] = None
         self._worker_thread: Optional[threading.Thread] = None
+        # flipped by a failover promotion (ReplicaServer.promote): a
+        # pinned read expiring AFTER promotion is a failover casualty
+        # and is additionally counted txn.failover_expired — the storm
+        # gate separates those honest expiries from ring churn
+        self.txn_failover = False
 
     # ------------------------------------------------------------------ #
     # Lifecycle
     # ------------------------------------------------------------------ #
     def publish_boot(self, payload: dict, watermark: int = 0,
-                     version: Optional[int] = None) -> None:
+                     version: Optional[int] = None,
+                     boot: Optional[str] = None) -> None:
         """Publish a pre-ingest snapshot (window -1): the checkpoint-boot
         path serves the restored summary immediately, before the first
         catch-up window folds. Must run before :meth:`start`.
         ``version`` carries the mirrored snapshot's original version
-        through a restart (see :meth:`SnapshotStore.publish`)."""
+        through a restart (see :meth:`SnapshotStore.publish`); ``boot``
+        carries its lineage nonce the same way, so a restart-adopted
+        replica stays addressable by pinned transactions."""
         if self._ingest_thread is not None:
             raise RuntimeError("publish_boot must precede start()")
         self.store.publish(payload, window=-1, watermark=watermark,
-                           version=version)
+                           version=version, boot=boot)
 
     def start(self) -> "StreamServer":
         if self._ingest_thread is not None:
@@ -256,6 +270,16 @@ class StreamServer:
                 if payload is None:  # a window with nothing servable
                     continue
                 self._window += 1
+                # a mirror follower smuggles the PRIMARY's version and
+                # boot lineage through the payload (carry_version) so a
+                # standby's ring mirrors the primary's stamps; pop the
+                # smuggled keys off a COPY — the published payload must
+                # look like any other servable payload
+                version = boot = None
+                if hasattr(payload, "get") and "snap_version" in payload:
+                    payload = dict(payload)
+                    version = int(payload.pop("snap_version"))
+                    boot = payload.pop("snap_boot", None)
                 # an event-time pipeline's servable carries its
                 # watermark stamp in the payload; count windows do not
                 # (-1 = "no event time", the Answer default)
@@ -263,6 +287,7 @@ class StreamServer:
                     payload, self._window, int(watermark),
                     event_ts=int(payload.get("event_ts", -1))
                     if hasattr(payload, "get") else -1,
+                    version=version, boot=boot,
                 )
         except BaseException as e:  # surfaced via join()/close()
             self._ingest_error = e
@@ -292,6 +317,7 @@ class StreamServer:
         deadline_s: Optional[float] = None,
         retry_policy: Optional[RetryPolicy] = None,
         ctx=None,
+        txn=None,
     ) -> "Future[Answer]":
         """Admit one query; resolves to an :class:`~.query.Answer`.
         Raises :class:`Overloaded` at the admission limit — immediately,
@@ -315,7 +341,18 @@ class StreamServer:
         adoption, so a re-answered query stays on its original trace.
         When omitted (and tracing is on) the submitting thread's active
         context is captured — same-process callers inside a span get
-        joined-up traces for free."""
+        joined-up traces for free.
+
+        ``txn`` is a decoded transaction doc (see
+        :func:`~gelly_streaming_tpu.serving.txn.decode_txn`): when it
+        carries a ``pin``, the query is answered AT that pinned
+        ``(version, boot)`` snapshot from the retention ring, or fails
+        with a typed
+        :class:`~gelly_streaming_tpu.serving.txn.TxnSnapshotExpired` —
+        never a silently fresher answer."""
+        pin = None if txn is None else txn.get("pin")
+        if pin is not None:
+            query = PinnedQuery(query, pin[0], pin[1])
         policy = retry_policy if retry_policy is not None else self.retry_policy
         attempt = 0
         # the deadline is a TOTAL budget (GL008): pin it to a wall
@@ -344,7 +381,7 @@ class StreamServer:
         self, query: Query, deadline_s: Optional[float], ctx=None
     ) -> "Future[Answer]":
         declared = getattr(self._servable, "query_classes", ())
-        if declared and not isinstance(query, tuple(declared)):
+        if declared and not isinstance(_unwrap(query), tuple(declared)):
             # reject the wrong class SYNCHRONOUSLY on the caller's
             # thread: batched answering would otherwise fail the whole
             # drained sweep (hundreds of valid concurrent queries) on
@@ -352,7 +389,7 @@ class StreamServer:
             raise TypeError(
                 f"{type(self._servable).__name__} serves "
                 f"{[c.__name__ for c in declared]}, not "
-                f"{type(query).__name__}"
+                f"{type(_unwrap(query)).__name__}"
             )
         f: "Future[Answer]" = Future()
         with self._lock:
@@ -376,18 +413,19 @@ class StreamServer:
                     self._pressure_t0 = now
             else:
                 self._pressure_t0 = None
+            qname = type(_unwrap(query)).__name__
             if (
                 self._shed_names
                 and self._pressure_t0 is not None
                 and now - self._pressure_t0 >= self.shed_after_s
-                and type(query).__name__ in self._shed_names
+                and qname in self._shed_names
             ):
                 self.stats.record_rejected()
                 get_registry().counter(
-                    "serving.shed", cls=type(query).__name__
+                    "serving.shed", cls=qname
                 ).inc()
                 raise Shed(
-                    f"{type(query).__name__} shed under sustained "
+                    f"{qname} shed under sustained "
                     f"pressure ({admitted}/{self.max_pending} in flight)"
                 )
             if admitted >= self.max_pending:
@@ -411,6 +449,7 @@ class StreamServer:
         *,
         deadline_s: Optional[float] = None,
         ctx=None,
+        txn=None,
     ) -> list:
         """Admit a whole wire batch under ONE lock acquisition — the
         RPC front end's fast path (a 32-query frame previously paid 32
@@ -418,7 +457,8 @@ class StreamServer:
         rejected batch leaves nothing half-admitted, exactly the
         cancel-the-partial-batch semantics the wire already promises).
         Raises like :meth:`submit`; no retry-policy absorption (the
-        wire client owns retry pacing)."""
+        wire client owns retry pacing). ``txn`` pins the whole batch
+        at one snapshot, as in :meth:`submit`."""
         declared = getattr(self._servable, "query_classes", ())
         if declared:
             for q in queries:
@@ -428,6 +468,9 @@ class StreamServer:
                         f"{[c.__name__ for c in declared]}, not "
                         f"{type(q).__name__}"
                     )
+        pin = None if txn is None else txn.get("pin")
+        if pin is not None:
+            queries = [PinnedQuery(q, pin[0], pin[1]) for q in queries]
         futures = [Future() for _ in queries]
         t0 = time.perf_counter()
         deadline = None if deadline_s is None \
@@ -452,18 +495,19 @@ class StreamServer:
                         self._pressure_t0 = now
                 else:
                     self._pressure_t0 = None
+                qname = type(_unwrap(q)).__name__
                 if (
                     self._shed_names
                     and self._pressure_t0 is not None
                     and now - self._pressure_t0 >= self.shed_after_s
-                    and type(q).__name__ in self._shed_names
+                    and qname in self._shed_names
                 ):
                     self.stats.record_rejected()
                     get_registry().counter(
-                        "serving.shed", cls=type(q).__name__
+                        "serving.shed", cls=qname
                     ).inc()
                     raise Shed(
-                        f"{type(q).__name__} shed under sustained "
+                        f"{qname} shed under sustained "
                         f"pressure ({cur}/{self.max_pending} "
                         "in flight)"
                     )
@@ -575,6 +619,22 @@ class StreamServer:
             for _, f, *_rest in batch:
                 f.set_exception(err)
             return
+        # partition pinned transactional reads out of the sweep: each
+        # distinct (version, boot) pin answers from ITS ring snapshot
+        # (or expires typed), the rest from the freshest as ever
+        pinned: dict = {}
+        plain = []
+        for entry in batch:
+            q = entry[0]
+            if isinstance(q, PinnedQuery):
+                pinned.setdefault((q.version, q.boot), []).append(entry)
+            else:
+                plain.append(entry)
+        for (ver, boot), group in pinned.items():
+            self._answer_pinned(ver, boot, group)
+        if not plain:
+            return
+        batch = plain
         queries = [q for q, *_rest in batch]
         tracing = _trace.on()
         t_dispatch = time.perf_counter()
@@ -663,6 +723,55 @@ class StreamServer:
                         "window": snap.window,
                     },
                 )
+
+    def _answer_pinned(self, version: int, boot: str,
+                       group: list) -> None:
+        """Answer one pinned group AT its ``(version, boot)`` snapshot.
+        An expired pin fails the whole group with the typed error it
+        deserves — the honesty contract: a transaction is told its
+        snapshot is gone, never handed a fresher answer. After a
+        failover promotion the expiry is additionally counted
+        ``txn.failover_expired`` (the storm gate's honest-expiry lane)."""
+        try:
+            psnap = self.store.at_version(version, boot)
+        except TxnSnapshotExpired as e:
+            if self.txn_failover:
+                get_registry().counter("txn.failover_expired").inc()
+            for _q, f, *_rest in group:
+                if not f.done():
+                    try:
+                        f.set_exception(e)
+                    except InvalidStateError:
+                        get_registry().counter(
+                            "serving.swallowed",
+                            site="answer_settle_race",
+                        ).inc()
+            return
+        queries = [entry[0].q for entry in group]
+        try:
+            answers = self.engine.answer_batch(
+                psnap, queries, head_window=self.store.head_window()
+            )
+        except Exception as e:
+            for _q, f, *_rest in group:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        get_registry().counter("txn.pinned_reads").inc(len(group))
+        now = time.perf_counter()
+        for (q, f, t0, dl, _ctx), ans in zip(group, answers):
+            if dl is not None and now > dl:
+                self._expire(q, f, t0, dl, "answered after")
+                continue
+            self.stats.record(type(q.q).__name__, now - t0,
+                              ans.staleness)
+            if not f.done():
+                try:
+                    f.set_result(ans)
+                except InvalidStateError:
+                    get_registry().counter(
+                        "serving.swallowed", site="answer_settle_race"
+                    ).inc()
 
     def _worker(self) -> None:
         try:

@@ -81,6 +81,13 @@ class PublishedSnapshot:
     at (``-1`` when the pipeline carries no event time) — the stamp
     answers forward so a consumer can tell "how far behind the world"
     an answer is, next to ``staleness``'s "how far behind the head".
+    ``boot`` is the store's CROSS-PROCESS lineage nonce (ISSUE 20):
+    ``epoch`` is process-local, so a snapshot-pinned transaction
+    talking through the wire needs a stamp that survives serialization
+    and distinguishes a restarted store whose version counter happens
+    to pass the pinned number. A standby following a mirror ADOPTS the
+    primary's boot, so promotion preserves the lineage a pin names;
+    a cold restart mints a new one and honestly expires old pins.
     """
 
     payload: Mapping[str, Any]
@@ -90,6 +97,7 @@ class PublishedSnapshot:
     published_at: float = field(default_factory=time.monotonic)
     epoch: int = 0
     event_ts: int = -1
+    boot: str = ""
 
 
 class SnapshotStore:
@@ -110,8 +118,18 @@ class SnapshotStore:
     #: nonce so (epoch, version) pairs never collide across store swaps
     _epochs = itertools.count(1)
 
-    def __init__(self):
+    def __init__(self, *, retention: Optional[int] = None):
         self.epoch = next(SnapshotStore._epochs)
+        # cross-process lineage nonce (see PublishedSnapshot.boot);
+        # adopted wholesale when a publish carries the upstream boot
+        self.boot = os.urandom(4).hex()
+        # how many snapshots BEHIND the head stay version-addressable
+        # for pinned transactional reads; defaults to the prefer_ready
+        # lookback so the knob never shrinks what latest() could serve
+        self.retention = (
+            self.READY_LOOKBACK if retention is None
+            else max(1, int(retention))
+        )
         self._current: Optional[PublishedSnapshot] = None
         self._recent: tuple = ()  # newest-first, immutable (atomic swap)
         self._cond = threading.Condition()
@@ -141,6 +159,55 @@ class SnapshotStore:
     @staticmethod
     def payload_ready(payload) -> bool:
         return _payload_ready(payload)
+
+    def at_version(
+        self, version: int, boot: Optional[str] = None
+    ) -> PublishedSnapshot:
+        """The snapshot PINNED at ``(version, boot)`` — the transactional
+        read path (ISSUE 20). Returns the exact version from the
+        retention ring or raises a counted, typed
+        :class:`~gelly_streaming_tpu.serving.txn.TxnSnapshotExpired`;
+        it NEVER substitutes a fresher snapshot — a transaction is told
+        its snapshot is gone, not quietly handed different data.
+
+        ``boot`` (when given) must match the snapshot's lineage nonce:
+        version numbers restart across cold store swaps, so a
+        numerically-equal version from a different lineage is a
+        different graph and expires the pin (``kind="lineage"``)."""
+        from .txn import TxnSnapshotExpired
+
+        version = int(version)
+        head = self._current
+        for snap in self._recent:
+            if snap.version == version:
+                if boot and snap.boot and snap.boot != boot:
+                    break  # same number, different lineage: not it
+                return snap
+        if boot and boot != self.boot:
+            kind = "lineage"
+            msg = (f"pinned v{version} names lineage {boot!r}; this "
+                   f"store is lineage {self.boot!r} (restarted?)")
+        elif head is None or version > head.version:
+            kind = "ahead"
+            msg = (f"pinned v{version} is ahead of this store "
+                   f"(head v{0 if head is None else head.version})")
+        else:
+            kind = "ring_slid"
+            msg = (f"pinned v{version} slid out of the retention ring "
+                   f"(oldest retained v{self.oldest_retained()}, "
+                   f"retention {self.retention})")
+        get_registry().counter("txn.snapshot_expired", reason=kind).inc()
+        raise TxnSnapshotExpired(msg, kind=kind)
+
+    def oldest_retained(self) -> int:
+        """Oldest version still version-addressable (``-1`` before any
+        publish) — the health surface's oldest-pinned-readable stamp."""
+        recent = self._recent
+        return recent[-1].version if recent else -1
+
+    def ring_depth(self) -> int:
+        """How many snapshots the retention ring currently holds."""
+        return len(self._recent)
 
     def head_window(self) -> int:
         """Window index of the newest snapshot; -2 before any publish
@@ -175,6 +242,7 @@ class SnapshotStore:
     def publish(
         self, payload: Mapping[str, Any], window: int, watermark: int,
         event_ts: int = -1, version: Optional[int] = None,
+        boot: Optional[str] = None,
     ) -> PublishedSnapshot:
         """Swap in a new snapshot and wake waiters. The assignment to
         ``_current`` IS the publication point; the lock below only
@@ -185,10 +253,16 @@ class SnapshotStore:
         snapshot under its original version so downstream delta
         baselines (routers, the persisted pull ring) stay valid
         instead of watching versions restart from 1. Later publishes
-        continue from the override."""
+        continue from the override. ``boot`` likewise ADOPTS an
+        upstream store's lineage nonce: a standby mirroring its
+        primary publishes under the primary's boot, so a pinned
+        ``(version, boot)`` survives promotion; absent, the store
+        keeps its own lineage."""
         prev = self._current
         if version is None:
             version = 1 if prev is None else prev.version + 1
+        if boot is not None and boot:
+            self.boot = str(boot)
         snap = PublishedSnapshot(
             payload=payload,
             window=window,
@@ -196,10 +270,12 @@ class SnapshotStore:
             version=int(version),
             epoch=self.epoch,
             event_ts=int(event_ts),
+            boot=self.boot,
         )
         # both swaps are single reference assignments (atomic under the
         # GIL); _recent is an immutable tuple rebuilt per publish
-        self._recent = (snap, *self._recent)[: self.READY_LOOKBACK + 1]
+        keep = max(self.retention, self.READY_LOOKBACK) + 1
+        self._recent = (snap, *self._recent)[:keep]
         self._current = snap
         with self._cond:
             self._cond.notify_all()
@@ -337,6 +413,7 @@ class SnapshotMirror:
             "window": snap.window,
             "watermark": snap.watermark,
             "version": snap.version,
+            "boot": snap.boot,
             "payload": payload,
         }
         data = integrity.wrap_checksummed(pickle.dumps(doc, protocol=4))
@@ -411,13 +488,22 @@ def follow_snapshots(
     stop: threading.Event,
     *,
     poll_s: float = 0.05,
+    carry_version: bool = False,
 ) -> Iterator[Tuple[dict, int]]:
     """Standby-side emission iterator over a shared snapshot store:
     yields ``(payload, watermark)`` once per NEW committed snapshot
     version until ``stop`` is set. Plug it into a ``StreamServer`` as a
     bare servable (``source=None``) and the standby serves whatever the
     primary last mirrored — including after the primary dies (the
-    keep-serving-from-final-state contract, now across processes)."""
+    keep-serving-from-final-state contract, now across processes).
+
+    ``carry_version=True`` smuggles the PRIMARY's version and boot
+    lineage through the payload (``snap_version``/``snap_boot`` keys,
+    popped by the ingest loop before publish): the standby's ring then
+    mirrors the primary's stamps, so a promotion answers pinned
+    transactional reads from the mirrored ring instead of restarting
+    versions from 1 (which would both expire every pin and trip the
+    router's restart-adoption slack)."""
     from ..fabric import as_transport
 
     tr = as_transport(dirpath)
@@ -428,4 +514,11 @@ def follow_snapshots(
             stop.wait(poll_s)
             continue
         last = int(doc["version"])
-        yield doc["payload"], int(doc["watermark"])
+        payload = doc["payload"]
+        if carry_version and isinstance(payload, dict):
+            payload = dict(
+                payload,
+                snap_version=last,
+                snap_boot=str(doc.get("boot", "")),
+            )
+        yield payload, int(doc["watermark"])

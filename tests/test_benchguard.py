@@ -314,11 +314,24 @@ def test_storm_watch_list_matches_the_storm_artifact():
         assert isinstance(value, (int, float)), metric
     assert "min:load_total.qps" in WATCHED_STORM
     assert "min:load_total.zero_failures" in WATCHED_STORM
+    # the transactional lane (ISSUE 20) is watched the same way: the
+    # zero-consistency-violations 1/0 indicator plus its throughput
+    assert "min:txn.zero_violations" in WATCHED_STORM
+    assert "min:txn.qps" in WATCHED_STORM
     assert committed["load_total"]["failures"] == 0
     assert committed["load_total"]["zero_failures"] == 1
     assert committed["oracle"]["mismatches"] == 0
     assert committed["storm"]["promoted"] is True
     assert committed["storm"]["split_adopted"] is True
+    # the committed storm must prove the txn contract: zero violations,
+    # >=1 committed txn spanning EACH chaos phase, and any failures
+    # being typed honest expiries (no driver errors)
+    assert committed["txn"]["zero_violations"] == 1
+    assert committed["txn"]["violations"] == 0
+    assert committed["txn"]["driver_errors"] == []
+    assert committed["txn"]["committed"] >= 1
+    for ph in ("kill_router", "kill_shard", "split"):
+        assert committed["txn"]["spanning"][ph] >= 1, ph
     assert committed["ok"] is True
 
 
@@ -327,14 +340,20 @@ def test_storm_watch_directions():
 
     base = {"load_total": {"qps": 1000.0, "zero_failures": 1},
             "load": {"kill_router": {"p50_ms": 5.0},
-                     "kill_shard": {"p50_ms": 5.0}}}
+                     "kill_shard": {"p50_ms": 5.0}},
+            "txn": {"zero_violations": 1, "qps": 500.0}}
     # ONE client-visible failure must regress the indicator even when
-    # every latency metric stayed flat — the contract is the zero
+    # every latency metric stayed flat — the contract is the zero;
+    # same shape for the txn lane: one consistency violation (or a
+    # missing phase-spanning txn) flips ITS indicator
     bad = {"load_total": {"qps": 900.0, "zero_failures": 0},
            "load": {"kill_router": {"p50_ms": 5.0},
-                    "kill_shard": {"p50_ms": 5.0}}}
+                    "kill_shard": {"p50_ms": 5.0}},
+           "txn": {"zero_violations": 0, "qps": 450.0}}
     by = {v["metric"]: v for v in
           compare(base, bad, ratio=3.0, watched=WATCHED_STORM)}
     assert by["min:load_total.zero_failures"]["ok"] is False
     assert by["min:load_total.qps"]["ok"] is True
     assert by["load.kill_router.p50_ms"]["ok"] is True
+    assert by["min:txn.zero_violations"]["ok"] is False
+    assert by["min:txn.qps"]["ok"] is True

@@ -145,6 +145,13 @@ WATCHED_STORM = (
     "min:load_total.zero_failures",
     "load.kill_router.p50_ms",
     "load.kill_shard.p50_ms",
+    # the transactional lane (ISSUE 20): the zero-consistency-
+    # violations contract rides as the same 1/0 indicator shape
+    # (zero repeated-read/oracle violations AND >=1 committed txn
+    # spanning each chaos phase), and pinned-read throughput is
+    # guarded like any other throughput cell
+    "min:txn.zero_violations",
+    "min:txn.qps",
 )
 
 #: a fresh value may be up to this many times the committed one

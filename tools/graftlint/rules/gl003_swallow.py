@@ -72,6 +72,7 @@ THREADED_SOCKET_MODULES = (
     "fabric/exchange.py",
     "eventtime/stream.py",
     "serving/reshard.py",
+    "serving/txn.py",
 )
 
 #: calls that count as "left registry evidence": instrument factories
